@@ -1,0 +1,43 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Same capability surface as the reference (ray-project/ray fork at
+/root/reference): Core tasks/actors/objects + Data/Train/Tune/Serve/RLlib —
+re-designed for TPU: a single-controller runtime orchestrates hosts while
+JAX/XLA SPMD over `jax.sharding.Mesh` does all on-chip compute and ICI
+collectives.
+
+Subpackages are imported lazily so `import ray_tpu` stays light (no jax
+import until the compute path is touched).
+"""
+from __future__ import annotations
+
+import importlib
+
+from .api import (init, shutdown, is_initialized, remote, get, put, wait,
+                  kill, cancel, get_actor, free, cluster_resources,
+                  available_resources, get_runtime_context)
+from .core.object_ref import ObjectRef
+from .core.actor import ActorHandle
+from . import exceptions
+
+__version__ = "0.1.0"
+
+_LAZY_SUBMODULES = ("data", "train", "tune", "serve", "rllib", "util",
+                    "models", "ops", "parallel", "observability", "dag",
+                    "workflow")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "free", "cluster_resources",
+    "available_resources", "get_runtime_context", "ObjectRef", "ActorHandle",
+    "exceptions", "__version__", *_LAZY_SUBMODULES,
+]

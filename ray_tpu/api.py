@@ -1,0 +1,238 @@
+"""Public ray_tpu API.
+
+Reference parity: python/ray/__init__.py + python/ray/_private/worker.py
+(init/shutdown/remote/get/put/wait/kill/cancel, get_actor, is_initialized).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .core import runtime as runtime_mod
+from .core import serialization
+from .core import resources as res_mod
+from .core.actor import ActorClass, ActorHandle
+from .core.object_ref import ObjectRef
+from .core.runtime import DriverRuntime
+from .core.task import make_task_spec
+from .exceptions import RuntimeNotInitializedError
+
+_init_lock = threading.Lock()
+
+AUTO_PUT_THRESHOLD = 256 * 1024  # large ndarray args go through the store
+
+
+def init(*, num_cpus=None, num_tpus=None, resources=None,
+         object_store_memory=None, namespace="default",
+         max_workers=None, ignore_reinit_error=True, **_ignored):
+    """Start the ray_tpu runtime in this (driver) process."""
+    with _init_lock:
+        if runtime_mod.runtime_initialized():
+            if ignore_reinit_error:
+                return runtime_mod.get_runtime()
+            raise RuntimeError("ray_tpu.init() already called")
+        rt = DriverRuntime(num_cpus=num_cpus, num_tpus=num_tpus,
+                           resources=resources,
+                           object_store_memory=object_store_memory,
+                           namespace=namespace, max_workers=max_workers)
+        runtime_mod.set_runtime(rt)
+        return rt
+
+
+def shutdown():
+    if runtime_mod.runtime_initialized():
+        runtime_mod.get_runtime().shutdown()
+
+
+def is_initialized() -> bool:
+    return runtime_mod.runtime_initialized()
+
+
+def _ensure_init():
+    if not runtime_mod.runtime_initialized():
+        init()
+    return runtime_mod.get_runtime()
+
+
+def _auto_put_large_args(rt, args, kwargs):
+    """Large array args are placed in the object store and passed by ref
+    (reference: put_threshold in core_worker task arg inlining)."""
+    def conv(a):
+        if isinstance(a, np.ndarray) and a.nbytes > AUTO_PUT_THRESHOLD:
+            return rt.put(a)
+        return a
+    return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+
+
+class RemoteFunction:
+    def __init__(self, fn, *, num_cpus=None, num_tpus=None, resources=None,
+                 num_returns=1, max_retries=0, retry_exceptions=False,
+                 placement_group=None, bundle_index=-1,
+                 scheduling_strategy=None):
+        self._fn = fn
+        functools.update_wrapper(self, fn)
+        self._opts = dict(num_cpus=num_cpus, num_tpus=num_tpus,
+                          resources=resources, num_returns=num_returns,
+                          max_retries=max_retries,
+                          retry_exceptions=retry_exceptions,
+                          placement_group=placement_group,
+                          bundle_index=bundle_index,
+                          scheduling_strategy=scheduling_strategy)
+        self._func_bytes: Optional[bytes] = None
+        self._func_id: str = ""
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update({k: v for k, v in opts.items() if k in merged})
+        rf = RemoteFunction(self._fn, **merged)
+        rf._func_bytes, rf._func_id = self._func_bytes, self._func_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        rt = _ensure_init()
+        if self._func_bytes is None:
+            self._func_bytes = serialization.dumps_call(self._fn)
+            self._func_id = hashlib.sha1(self._func_bytes).hexdigest()
+        args, kwargs = _auto_put_large_args(rt, args, kwargs)
+        o = self._opts
+        pg = o.get("placement_group")
+        spec = make_task_spec(
+            self._fn, args, kwargs,
+            name=getattr(self._fn, "__qualname__", "task"),
+            num_returns=o["num_returns"],
+            resources=res_mod.normalize_task_resources(
+                num_cpus=o["num_cpus"], num_tpus=o["num_tpus"],
+                resources=o["resources"]),
+            max_retries=o["max_retries"],
+            retry_exceptions=o["retry_exceptions"],
+            func_bytes=self._func_bytes, func_id=self._func_id,
+            placement_group_id=getattr(pg, "pg_id", None),
+            bundle_index=o.get("bundle_index", -1),
+            scheduling_strategy=o.get("scheduling_strategy"))
+        refs = rt.submit(spec)
+        return refs[0] if o["num_returns"] == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote functions must be invoked with `.remote()` "
+            f"(got direct call to {self.__name__})")
+
+
+def remote(*args, **kwargs):
+    """`@remote` decorator for tasks and actors, with or without options."""
+    def decorate(target, opts):
+        if isinstance(target, type):
+            allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
+                       "max_concurrency", "name", "namespace", "lifetime",
+                       "runtime_env", "placement_group", "bundle_index")
+            return ActorClass(target,
+                              **{k: v for k, v in opts.items()
+                                 if k in allowed})
+        allowed = ("num_cpus", "num_tpus", "resources", "num_returns",
+                   "max_retries", "retry_exceptions", "placement_group",
+                   "bundle_index", "scheduling_strategy")
+        return RemoteFunction(target,
+                              **{k: v for k, v in opts.items()
+                                 if k in allowed})
+
+    if len(args) == 1 and not kwargs and (callable(args[0])
+                                          or isinstance(args[0], type)):
+        return decorate(args[0], {})
+    opts = kwargs
+    return lambda target: decorate(target, opts)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    rt = runtime_mod.get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout=timeout)[0]
+    return rt.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _ensure_init().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return runtime_mod.get_runtime().wait(
+        list(refs), num_returns=num_returns, timeout=timeout,
+        fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    runtime_mod.get_runtime().kill_actor(actor.actor_id,
+                                         no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    runtime_mod.get_runtime().cancel(ref, force=force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    rt = runtime_mod.get_runtime()
+    if not rt.is_driver:
+        raise RuntimeNotInitializedError(
+            "get_actor from workers not yet supported")
+    ns = namespace or rt.namespace
+    # Creation registers the name asynchronously in the dispatcher; poll
+    # briefly so `Actor.options(name=...).remote(); get_actor(name)` works.
+    import time as _time
+    deadline = _time.time() + 2.0
+    while True:
+        aid = rt.gcs.lookup_named_actor(ns, name)
+        if aid is not None:
+            entry = rt.gcs.actors[aid]
+            return ActorHandle(aid, entry.class_name)
+        if _time.time() > deadline:
+            raise ValueError(f"no actor named {name!r} in namespace {ns!r}")
+        _time.sleep(0.01)
+
+
+def free(refs: Sequence[ObjectRef]):
+    runtime_mod.get_runtime().free(list(refs))
+
+
+def cluster_resources() -> Dict[str, float]:
+    return runtime_mod.get_runtime().get_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return runtime_mod.get_runtime().available_resources()
+
+
+class RuntimeContext:
+    """Parity: python/ray/runtime_context.py."""
+
+    def __init__(self, rt):
+        self._rt = rt
+
+    @property
+    def job_id(self):
+        return getattr(self._rt, "job_id", "job-default")
+
+    @property
+    def node_id(self):
+        return getattr(self._rt, "node_id", "node-local")
+
+    def get_task_id(self):
+        return getattr(self._rt, "current_task_id", None)
+
+    def get_actor_id(self):
+        return getattr(self._rt, "current_actor_id", None)
+
+    @property
+    def was_current_actor_reconstructed(self):
+        return False
+
+    def get_resources(self):
+        return self._rt.get_resources() if self._rt.is_driver else {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(runtime_mod.get_runtime())

@@ -1,0 +1,134 @@
+"""Actor API: ActorClass (decorated class) and ActorHandle.
+
+Reference parity: python/ray/actor.py (ActorClass.remote, ActorHandle
+method invocation, .options, named actors, max_restarts/max_concurrency).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+from . import runtime as runtime_mod
+from . import serialization
+from .ids import new_actor_id, new_task_id, new_object_id
+from .object_ref import ObjectRef
+from .task import TaskSpec, ActorCreationSpec, extract_arg_deps
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method_name, args, kwargs,
+                                    self._num_returns)
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor methods cannot be called directly; use "
+            f"`.{self._method_name}.remote()`")
+
+
+class ActorHandle:
+    """Serializable handle to a running actor (pass freely between tasks)."""
+
+    def __init__(self, actor_id: str, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _invoke(self, method_name: str, args, kwargs,
+                num_returns: int = 1) -> Any:
+        rt = runtime_mod.get_runtime()
+        spec = TaskSpec(
+            task_id=new_task_id(),
+            name=f"{self._class_name}.{method_name}",
+            func_bytes=b"",
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            num_returns=num_returns,
+            return_ids=[new_object_id() for _ in range(max(num_returns, 1))],
+            resources={},
+            actor_id=self._actor_id,
+            method_name=method_name,
+            dep_object_ids=extract_arg_deps(args, kwargs),
+        )
+        refs = rt.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id})"
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
+                 max_restarts=0, max_concurrency=1, name=None,
+                 namespace=None, lifetime=None, runtime_env=None,
+                 placement_group=None, bundle_index=-1):
+        self._cls = cls
+        self._default_opts = dict(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            name=name, namespace=namespace, lifetime=lifetime,
+            runtime_env=runtime_env, placement_group=placement_group,
+            bundle_index=bundle_index)
+        self._class_bytes: Optional[bytes] = None
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._default_opts)
+        merged.update(opts)
+        ac = ActorClass(self._cls, **{k: v for k, v in merged.items()
+                                      if k in self._default_opts})
+        ac._class_bytes = self._class_bytes
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from . import resources as res_mod  # noqa: PLC0415
+        rt = runtime_mod.get_runtime()
+        opts = self._default_opts
+        if self._class_bytes is None:
+            self._class_bytes = serialization.dumps_call(self._cls)
+        actor_id = new_actor_id()
+        pg = opts.get("placement_group")
+        req = res_mod.normalize_task_resources(
+            num_cpus=opts["num_cpus"], num_tpus=opts["num_tpus"],
+            resources=opts["resources"], default_cpus=1.0)
+        acspec = ActorCreationSpec(
+            actor_id=actor_id,
+            class_bytes=self._class_bytes,
+            class_name=self._cls.__name__,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            resources={} if pg is not None else req,
+            max_restarts=opts["max_restarts"] or 0,
+            max_concurrency=opts["max_concurrency"] or 1,
+            name=opts["name"],
+            namespace=opts["namespace"] or getattr(rt, "namespace", "default"),
+            placement_group_id=getattr(pg, "pg_id", None),
+            bundle_index=opts.get("bundle_index", -1),
+            runtime_env=opts["runtime_env"],
+            dep_object_ids=extract_arg_deps(args, kwargs),
+        )
+        rt.create_actor(acspec)
+        return ActorHandle(actor_id, self._cls.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Actor classes must be instantiated with `.remote()`")

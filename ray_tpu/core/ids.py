@@ -1,0 +1,44 @@
+"""Unique identifiers for tasks / actors / objects / nodes.
+
+Reference parity: src/ray/common/id.h defines binary TaskID/ObjectID/ActorID
+with lineage encoded in the bytes. We keep ids opaque 16-byte hex strings —
+lineage lives in the GCS tables instead, which is simpler and sufficient for
+a single-controller runtime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+
+
+def _rand_hex(nbytes: int = 12) -> str:
+    global _counter
+    with _lock:
+        _counter += 1
+        c = _counter
+    # pid + counter prefix keeps ids unique across forked workers without
+    # coordination; random suffix guards against pid reuse.
+    return f"{os.getpid():08x}{c:08x}" + os.urandom(nbytes - 8).hex()
+
+
+def new_object_id() -> str:
+    return "obj-" + _rand_hex()
+
+
+def new_task_id() -> str:
+    return "tsk-" + _rand_hex()
+
+
+def new_actor_id() -> str:
+    return "act-" + _rand_hex()
+
+
+def new_node_id() -> str:
+    return "nod-" + _rand_hex()
+
+
+def new_placement_group_id() -> str:
+    return "pgr-" + _rand_hex()

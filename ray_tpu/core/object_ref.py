@@ -1,0 +1,63 @@
+"""ObjectRef — distributed future handle.
+
+Reference parity: ObjectRef in python/ray/_raylet.pyx plus ownership notes
+in python/ray/includes/object_ref.pxi. Refs are cheap, picklable, hashable,
+awaitable, and resolve through whichever runtime (driver or worker) the
+current process hosts.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner_hint")
+
+    def __init__(self, object_id: str, owner_hint: str = ""):
+        self.id = object_id
+        self._owner_hint = owner_hint
+
+    def hex(self) -> str:
+        return self.id
+
+    def binary(self) -> bytes:
+        return self.id.encode()
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id})"
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self._owner_hint))
+
+    # Support `await ref` inside async actors / drivers.
+    def __await__(self):
+        return self.as_future().__await__()
+
+    def as_future(self) -> "asyncio.Future":
+        from . import runtime  # noqa: PLC0415
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def _resolve():
+            rt = runtime.get_runtime()
+            try:
+                val = rt.get([self], timeout=None)[0]
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(val))
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_exception(e))
+
+        import threading  # noqa: PLC0415
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def future(self) -> "asyncio.Future":
+        return self.as_future()

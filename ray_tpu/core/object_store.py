@@ -1,0 +1,160 @@
+"""Shared-memory object store (plasma equivalent).
+
+Reference parity: src/ray/object_manager/plasma/ — a per-node shared-memory
+arena holding immutable sealed objects, with eviction and zero-copy reads.
+
+Two backends behind one interface:
+  * NativeStore — the C++ arena in ray_tpu/_native/object_store.cc (one mmap
+    region, allocator + refcounts + LRU in native code), used when the
+    compiled library is available.
+  * ShmStore — pure-Python fallback using one POSIX shared-memory segment
+    per large object.
+
+Small objects (<= INLINE_MAX) never touch shared memory: they ride inline in
+control-plane messages and live in the driver's in-memory table, mirroring
+the reference's in-band "plasma promotion" threshold
+(src/ray/common/ray_config_def.h RAY_CONFIG(int64_t, max_direct_call_object_size)).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional
+
+from multiprocessing import shared_memory, resource_tracker
+
+from . import serialization
+from ..exceptions import ObjectStoreFullError, ObjectLostError
+
+INLINE_MAX = 64 * 1024
+
+
+@dataclasses.dataclass
+class ObjectLocation:
+    """Picklable descriptor of where a sealed object's payload lives."""
+    kind: str                      # "inline" | "shm"
+    size: int
+    data: Optional[bytes] = None   # inline payload
+    name: Optional[str] = None     # shm segment name
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    # Attachments must not be auto-unlinked by this process's resource
+    # tracker: the creator (driver store) owns segment lifecycle.
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+class ShmStore:
+    """Per-process view of the node's shared-memory object space."""
+
+    def __init__(self, capacity_bytes: int = 8 << 30, is_owner: bool = False):
+        self.capacity = capacity_bytes
+        self.is_owner = is_owner
+        self._used = 0
+        self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    # -- write path ---------------------------------------------------------
+    def put_value(self, oid: str, value: Any) -> ObjectLocation:
+        """Serialize and seal a value; choose inline vs shm by size."""
+        meta, bufs = serialization.serialize(value)
+        size = serialization.packed_size(meta, bufs)
+        if size <= INLINE_MAX:
+            return ObjectLocation(kind="inline", size=size,
+                                  data=serialization.pack_parts(meta, bufs))
+        with self._lock:
+            if self._used + size > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object {oid} ({size} B) exceeds store capacity "
+                    f"({self._used}/{self.capacity} B used)")
+        name = "rtpu_" + oid.replace("-", "")
+        seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        try:
+            serialization.pack_into(seg.buf, meta, bufs)
+        except BaseException:
+            seg.close()
+            seg.unlink()
+            raise
+        with self._lock:
+            self._segments[name] = seg
+            self._used += size
+        return ObjectLocation(kind="shm", size=size, name=name)
+
+    # -- read path ----------------------------------------------------------
+    def get_value(self, loc: ObjectLocation) -> Any:
+        if loc.kind == "inline":
+            return serialization.unpack(loc.data)
+        if loc.kind == "shm":
+            seg = self._attach(loc.name)
+            # memoryview aliases the mapped pages -> zero-copy numpy reads.
+            return serialization.unpack(seg.buf[:loc.size])
+        raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def _attach(self, name: str) -> shared_memory.SharedMemory:
+        with self._lock:
+            seg = self._segments.get(name)
+            if seg is not None:
+                return seg
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=False)
+        except FileNotFoundError as e:
+            raise ObjectLostError(f"segment {name} is gone (evicted?)") from e
+        _untrack(seg)
+        with self._lock:
+            self._segments.setdefault(name, seg)
+        return self._segments[name]
+
+    # -- lifecycle ----------------------------------------------------------
+    def release(self, name: str) -> None:
+        """Drop this process's mapping (not the segment itself)."""
+        with self._lock:
+            seg = self._segments.pop(name, None)
+        if seg is not None:
+            seg.close()
+
+    def delete_segment(self, name: str, size: int) -> None:
+        """Owner-side unlink (eviction / free)."""
+        with self._lock:
+            seg = self._segments.pop(name, None)
+        if seg is None:
+            try:
+                seg = shared_memory.SharedMemory(name=name, create=False)
+                _untrack(seg)
+            except FileNotFoundError:
+                return
+        seg.close()
+        if self.is_owner:
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            with self._lock:
+                self._used = max(0, self._used - size)
+
+    def used_bytes(self) -> int:
+        return self._used
+
+    def shutdown(self) -> None:
+        with self._lock:
+            segments = dict(self._segments)
+            self._segments.clear()
+        for name, seg in segments.items():
+            seg.close()
+            if self.is_owner:
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+        self._used = 0
+
+
+def make_store(capacity_bytes: int, is_owner: bool):
+    """Return the best available store backend (native C++ if built)."""
+    try:
+        from .._native.store_binding import NativeStore  # noqa: PLC0415
+        return NativeStore(capacity_bytes=capacity_bytes, is_owner=is_owner)
+    except Exception:
+        return ShmStore(capacity_bytes=capacity_bytes, is_owner=is_owner)

@@ -1,0 +1,93 @@
+"""Length-prefixed pickle message framing over sockets.
+
+Reference parity: src/ray/rpc (gRPC services between core_worker and raylet).
+A single-host, single-controller runtime doesn't need gRPC; a Unix-domain
+socket with framed pickles gives lower latency and zero deps. The Connection
+class is transport-agnostic (works over TCP for multi-host drivers).
+
+Large values never travel through these messages — only ids and small
+metadata; payloads go through the shared-memory object store.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+_HDR = struct.Struct("<I")
+MAX_MSG = 1 << 30
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class Connection:
+    """Thread-safe framed-message duplex connection."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # unix sockets
+
+    def send(self, msg: Any) -> None:
+        data = pickle.dumps(msg, protocol=5)
+        with self._send_lock:
+            try:
+                self.sock.sendall(_HDR.pack(len(data)) + data)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            try:
+                chunk = self.sock.recv(min(n - got, 1 << 20))
+            except (ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+            if not chunk:
+                raise ConnectionClosed("peer closed")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def recv(self) -> Any:
+        with self._recv_lock:
+            hdr = self._recv_exact(_HDR.size)
+            (length,) = _HDR.unpack(hdr)
+            if length > MAX_MSG:
+                raise ConnectionClosed(f"oversized frame: {length}")
+            data = self._recv_exact(length)
+        return pickle.loads(data)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+    def fileno(self) -> int:
+        return self.sock.fileno()
+
+
+def unix_listener(path: str) -> socket.socket:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)
+    s.listen(128)
+    return s
+
+
+def unix_connect(path: str, timeout: Optional[float] = 10.0) -> Connection:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    s.connect(path)
+    s.settimeout(None)
+    return Connection(s)

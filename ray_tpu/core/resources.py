@@ -1,0 +1,77 @@
+"""Resource model with TPU as a first-class accelerator.
+
+Reference parity: python/ray/_private/resource_spec.py and
+python/ray/_private/accelerators/tpu.py (TPU pod/slice detection, the
+"TPU-<version>-head" resource). Here TPU chips are native schedulable
+resources ("TPU") plus topology labels, so placement can be ICI-aware.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def detect_node_resources(num_cpus: Optional[int] = None,
+                          num_tpus: Optional[int] = None) -> Dict[str, float]:
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+        # The runtime itself needs headroom; still expose at least 4 virtual
+        # CPU slots so task-parallel libraries (data/tune) can overlap work —
+        # CPUs in ray (and here) are scheduling tokens, not pinned cores.
+        num_cpus = max(num_cpus, 4)
+    res: Dict[str, float] = {"CPU": float(num_cpus)}
+    if num_tpus is None:
+        num_tpus = _detect_tpu_chips()
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    res["memory"] = float(_detect_memory_bytes())
+    return res
+
+
+def _detect_tpu_chips() -> int:
+    # Avoid importing jax here (heavy, and workers may be CPU-only); trust
+    # the environment first, mirroring reference TPU detection via env/
+    # metadata (python/ray/_private/accelerators/tpu.py).
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env:
+        return int(env)
+    try:
+        import jax  # noqa: PLC0415
+        return sum(1 for d in jax.devices() if d.platform == "tpu")
+    except Exception:
+        return 0
+
+
+def _detect_memory_bytes() -> int:
+    try:
+        import psutil  # noqa: PLC0415
+        return int(psutil.virtual_memory().total * 0.7)
+    except Exception:
+        return 8 << 30
+
+
+def fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items() if v > 0)
+
+
+def acquire(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    for k, v in req.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def release(avail: Dict[str, float], req: Dict[str, float]) -> None:
+    for k, v in req.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+def normalize_task_resources(num_cpus=None, num_tpus=None, resources=None,
+                             memory=None, default_cpus: float = 1.0) -> Dict[str, float]:
+    req: Dict[str, float] = dict(resources or {})
+    req["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    if num_tpus:
+        req["TPU"] = float(num_tpus)
+    if memory:
+        req["memory"] = float(memory)
+    return {k: v for k, v in req.items() if v > 0}

@@ -1,0 +1,971 @@
+"""Driver runtime: single-controller scheduler + object directory.
+
+Reference parity (collapsed into one process, by design):
+  * raylet local scheduler  — src/ray/raylet/local_task_manager.cc
+  * GCS server              — src/ray/gcs/gcs_server/
+  * ownership/object dir    — src/ray/core_worker/reference_count.cc,
+                              src/ray/object_manager/ownership_based_object_directory.cc
+  * worker pool             — src/ray/raylet/worker_pool.cc
+
+Concurrency model: every state mutation flows through one dispatcher thread
+consuming an inbox queue (worker messages, API calls, timers). API threads
+block on events; worker connections get one reader thread each. This is the
+TPU-friendly single-controller analogue of the reference's distributed
+raylet protocol — on a TPU pod, one driver per slice controls all hosts, and
+the data plane (XLA collectives over ICI) never touches this control plane.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import resources as res_mod
+from .gcs import GCS, ActorEntry, TaskEntry, NodeEntry
+from .ids import new_node_id, new_object_id
+from .object_ref import ObjectRef
+from .object_store import make_store
+from .protocol import Connection, ConnectionClosed, unix_listener
+from .task import TaskSpec, ActorCreationSpec
+from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
+                          RuntimeNotInitializedError, TaskCancelledError,
+                          TaskError, WorkerCrashedError)
+
+_runtime: Optional[Any] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime():
+    if _runtime is None:
+        raise RuntimeNotInitializedError(
+            "ray_tpu.init() must be called first")
+    return _runtime
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def runtime_initialized() -> bool:
+    return _runtime is not None
+
+
+class WorkerState:
+    __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
+                 "actor_id", "held_resources", "blocked", "started_at",
+                 "purpose")
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen, purpose=None):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[Connection] = None
+        self.pid: Optional[int] = None
+        self.state = "starting"        # starting|idle|busy|actor|dead
+        self.current_task: Optional[str] = None
+        self.actor_id: Optional[str] = None
+        self.held_resources: Dict[str, float] = {}
+        self.blocked = False
+        self.started_at = time.time()
+        self.purpose = purpose         # None (general) | actor_id
+
+
+class Waiter:
+    """A pending get/wait. Satisfied (and its callback fired) exactly once,
+    from the dispatcher thread."""
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, oids: List[str], num_returns: Optional[int],
+                 callback: Callable[[Dict[str, Tuple[str, Any]], List[str]], None]):
+        self.waiter_id = next(Waiter._ids)
+        self.oids = oids
+        self.num_returns = len(oids) if num_returns is None else num_returns
+        self.callback = callback
+        self.done = False
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"         # PENDING|CREATED|REMOVED
+        self.ready_ref: Optional[str] = None
+
+
+class DriverRuntime:
+    is_driver = True
+
+    def __init__(self, *, num_cpus=None, num_tpus=None, resources=None,
+                 object_store_memory=None, max_workers=None, namespace="default",
+                 job_id=None, log_to_driver=True):
+        self.namespace = namespace
+        self.job_id = job_id or f"job-{os.getpid()}"
+        self.gcs = GCS()
+        self.node_id = new_node_id()
+        node_res = res_mod.detect_node_resources(num_cpus, num_tpus)
+        if resources:
+            node_res.update(resources)
+        self.total_resources = dict(node_res)
+        self.avail = dict(node_res)
+        self.gcs.nodes[self.node_id] = NodeEntry(
+            node_id=self.node_id, hostname=os.uname().nodename,
+            resources=dict(node_res))
+
+        cap = object_store_memory or int(
+            os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30)))
+        self.store = make_store(capacity_bytes=cap, is_owner=True)
+        self.max_workers = max_workers or int(
+            os.environ.get("RAY_TPU_MAX_WORKERS", "16"))
+
+        self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_")
+        self.socket_path = os.path.join(self._tmpdir, "driver.sock")
+        self._listener = unix_listener(self.socket_path)
+
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.workers: Dict[str, WorkerState] = {}
+        self.pending_tasks: collections.deque = collections.deque()
+        self.pending_actors: collections.deque = collections.deque()
+        self.actor_queues: Dict[str, collections.deque] = {}
+        self.actor_inflight: Dict[str, int] = {}
+        self.actor_max_conc: Dict[str, int] = {}
+        self.waiters: Dict[int, Waiter] = {}
+        self.object_waiters: Dict[str, List[int]] = {}
+        self.report_handlers: Dict[str, Callable] = {}
+        self.placement_groups: Dict[str, PlacementGroupState] = {}
+        self._task_events: Dict[str, List[Tuple[float, str]]] = {}
+        self._actor_create_specs: Dict[str, ActorCreationSpec] = {}
+        self._respawnable_specs: Dict[str, TaskSpec] = {}
+        self._wid_counter = 0
+        self._shutdown = threading.Event()
+        self._conn_by_wid: Dict[str, Connection] = {}
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="rtpu-dispatch")
+        self._dispatcher.start()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rtpu-accept")
+        self._acceptor.start()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, daemon=True, name="rtpu-reaper")
+        self._reaper.start()
+
+    # ================= threads =================
+    def _accept_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = Connection(sock)
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: Connection):
+        wid = None
+        try:
+            msg = conn.recv()
+            if msg[0] != "register":
+                conn.close()
+                return
+            wid = msg[1]
+            self.inbox.put(("register", wid, conn, msg[2]))
+            while True:
+                m = conn.recv()
+                self.inbox.put(("worker_msg", wid, m))
+        except ConnectionClosed:
+            if wid is not None:
+                self.inbox.put(("worker_dead", wid))
+
+    def _reap_loop(self):
+        while not self._shutdown.is_set():
+            time.sleep(0.5)
+            for w in list(self.workers.values()):
+                if w.state == "starting" and w.proc.poll() is not None:
+                    self.inbox.put(("worker_dead", w.worker_id))
+
+    def _dispatch_loop(self):
+        while True:
+            item = self.inbox.get()
+            if item is None:
+                return
+            try:
+                self._handle(item)
+                self._schedule()
+            except Exception:
+                sys.stderr.write("ray_tpu dispatcher error:\n"
+                                 + traceback.format_exc())
+
+    # ================= event handling =================
+    def _handle(self, item):
+        kind = item[0]
+        if kind == "register":
+            _, wid, conn, pid = item
+            w = self.workers.get(wid)
+            if w is None:
+                conn.close()
+                return
+            w.conn, w.pid = conn, pid
+            self._conn_by_wid[wid] = conn
+            if w.purpose is not None:
+                w.state = "actor"
+                acspec = self._actor_create_specs.get(w.purpose)
+                if acspec is not None:
+                    w.actor_id = acspec.actor_id
+                    conn.send(("create_actor", acspec))
+            else:
+                w.state = "idle"
+        elif kind == "worker_msg":
+            _, wid, m = item
+            self._handle_worker_msg(wid, m)
+        elif kind == "worker_dead":
+            self._on_worker_dead(item[1])
+        elif kind == "api_submit":
+            self._register_task(item[1])
+        elif kind == "api_submit_actor":
+            self._register_actor_creation(item[1])
+        elif kind == "api_seal":
+            _, oid, loc = item
+            self._seal(oid, loc)
+        elif kind == "api_waiter":
+            self._add_waiter(item[1])
+        elif kind == "waiter_timeout":
+            self._fire_waiter(item[1], timed_out=True)
+        elif kind == "api_cancel":
+            self._cancel(item[1], item[2])
+        elif kind == "api_cancel_obj":
+            # Resolve object -> producing task here in the dispatcher, after
+            # any preceding submit in the FIFO inbox has been processed.
+            e = self.gcs.objects.get(item[1])
+            if e is not None and e.owner_task:
+                self._cancel(e.owner_task, item[2])
+        elif kind == "api_kill_actor":
+            self._kill_actor(item[1], item[2])
+        elif kind == "api_free":
+            self._free(item[1])
+        elif kind == "api_create_pg":
+            self._create_pg(item[1])
+        elif kind == "api_remove_pg":
+            self._remove_pg(item[1])
+
+    def _handle_worker_msg(self, wid: str, m):
+        w = self.workers.get(wid)
+        mtype = m[0]
+        if mtype == "task_done":
+            self._on_task_done(wid, m[1], m[2], m[3])
+        elif mtype == "actor_created":
+            self._on_actor_created(wid, m[1], m[2], m[3])
+        elif mtype == "put":
+            self._seal(m[1], m[2])
+        elif mtype == "submit":
+            self._register_task(m[1])
+        elif mtype == "submit_actor":
+            self._register_actor_creation(m[1])
+        elif mtype == "get_request":
+            _, rid, oids, timeout = m
+            self._worker_get(w, rid, oids, timeout)
+        elif mtype == "wait_request":
+            _, rid, oids, num_returns, timeout = m
+            self._worker_wait(w, rid, oids, num_returns, timeout)
+        elif mtype == "kill_actor":
+            self._kill_actor(m[1], m[2])
+        elif mtype == "cancel":
+            self._cancel(m[1], m[2])
+        elif mtype == "report":
+            h = self.report_handlers.get(m[1])
+            if h:
+                try:
+                    h(wid, m[2])
+                except Exception:
+                    traceback.print_exc()
+        elif mtype == "report_sync":
+            _, rid, channel, payload = m
+            h = self.report_handlers.get(channel)
+            result = None
+            if h:
+                try:
+                    result = h(wid, payload)
+                except Exception:
+                    traceback.print_exc()
+            if w and w.conn:
+                w.conn.send(("get_reply", rid, result))
+
+    # ---------------- objects ----------------
+    def _seal(self, oid: str, loc) -> None:
+        e = self.gcs.seal_object(oid, loc)
+        self._notify_object(oid)
+
+    def _fail_object(self, oid: str, err) -> None:
+        self.gcs.fail_object(oid, err)
+        self._notify_object(oid)
+
+    def _notify_object(self, oid: str) -> None:
+        for waiter_id in self.object_waiters.pop(oid, []):
+            w = self.waiters.get(waiter_id)
+            if w and not w.done:
+                self._check_waiter(w)
+
+    def _object_settled(self, oid: str) -> bool:
+        e = self.gcs.objects.get(oid)
+        return e is not None and e.state in ("ready", "error")
+
+    def _add_waiter(self, w: Waiter, timeout: Optional[float] = None):
+        self.waiters[w.waiter_id] = w
+        pending = False
+        for oid in w.oids:
+            if oid not in self.gcs.objects:
+                self.gcs.add_pending_object(oid)
+            if not self._object_settled(oid):
+                self.object_waiters.setdefault(oid, []).append(w.waiter_id)
+                pending = True
+        self._check_waiter(w)
+        if not w.done and timeout is not None:
+            t = threading.Timer(
+                timeout, lambda: self.inbox.put(("waiter_timeout", w.waiter_id)))
+            t.daemon = True
+            t.start()
+
+    def _check_waiter(self, w: Waiter):
+        settled = [oid for oid in w.oids if self._object_settled(oid)]
+        if len(settled) >= w.num_returns:
+            self._fire_waiter(w.waiter_id, timed_out=False)
+
+    def _fire_waiter(self, waiter_id: int, timed_out: bool):
+        w = self.waiters.pop(waiter_id, None)
+        if w is None or w.done:
+            return
+        w.done = True
+        results: Dict[str, Tuple[str, Any]] = {}
+        ready: List[str] = []
+        for oid in w.oids:
+            e = self.gcs.objects.get(oid)
+            if e is None or e.state == "pending":
+                continue
+            ready.append(oid)
+            if e.state == "ready":
+                results[oid] = ("loc", e.loc)
+            else:
+                results[oid] = ("error", e.error)
+        try:
+            w.callback(results, ready)
+        except Exception:
+            traceback.print_exc()
+
+    # ---------------- tasks ----------------
+    def _register_task(self, spec: TaskSpec):
+        te = TaskEntry(task_id=spec.task_id, name=spec.name,
+                       actor_id=spec.actor_id, submitted_at=time.time(),
+                       retries_left=spec.max_retries)
+        self.gcs.tasks[spec.task_id] = te
+        for oid in spec.return_ids:
+            self.gcs.add_pending_object(oid, owner_task=spec.task_id)
+        if spec.actor_id is not None:
+            aentry = self.gcs.actors.get(spec.actor_id)
+            if aentry is None or aentry.state == "DEAD":
+                err = ActorDiedError(
+                    f"actor {spec.actor_id} is dead"
+                    + (f": {aentry.death_cause}" if aentry else ""))
+                te.state = "FAILED"
+                for oid in spec.return_ids:
+                    self._fail_object(oid, err)
+                return
+            self.actor_queues.setdefault(spec.actor_id,
+                                         collections.deque()).append(spec)
+        else:
+            self.pending_tasks.append(spec)
+
+    def _register_actor_creation(self, acspec: ActorCreationSpec):
+        ae = ActorEntry(actor_id=acspec.actor_id, name=acspec.name,
+                        namespace=acspec.namespace,
+                        class_name=acspec.class_name,
+                        resources=dict(acspec.resources),
+                        max_restarts=acspec.max_restarts,
+                        create_spec=acspec)
+        self.gcs.actors[acspec.actor_id] = ae
+        if acspec.name:
+            ok = self.gcs.register_named_actor(
+                acspec.namespace, acspec.name, acspec.actor_id)
+            if not ok:
+                ae.state = "DEAD"
+                ae.death_cause = f"name {acspec.name!r} already taken"
+                return
+        self.actor_max_conc[acspec.actor_id] = acspec.max_concurrency
+        self.pending_actors.append(acspec)
+
+    # ---------------- scheduling ----------------
+    def _deps_ready(self, dep_ids: List[str]) -> Optional[bool]:
+        """True = all ready; False = still pending; None = a dep errored."""
+        ok = True
+        for oid in dep_ids:
+            e = self.gcs.objects.get(oid)
+            if e is None or e.state == "pending":
+                ok = False
+            elif e.state == "error":
+                return None
+        return ok
+
+    @staticmethod
+    def _pg_total(bundles) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    def _schedule(self):
+        # 0. pending placement groups admit as resources free up
+        for pg in list(self.placement_groups.values()):
+            if pg.state == "PENDING":
+                total = self._pg_total(pg.bundles)
+                if res_mod.fits(self.avail, total):
+                    res_mod.acquire(self.avail, total)
+                    pg.state = "CREATED"
+                    self._seal(pg.ready_ref,
+                               self.store.put_value(pg.ready_ref, True))
+
+        # 1. actor creations (dedicated worker each)
+        still = collections.deque()
+        while self.pending_actors:
+            acspec = self.pending_actors.popleft()
+            dr = self._deps_ready(acspec.dep_object_ids)
+            if dr is None:
+                ae = self.gcs.actors[acspec.actor_id]
+                ae.state, ae.death_cause = "DEAD", "constructor arg errored"
+                continue
+            if dr is False or not res_mod.fits(self.avail, acspec.resources):
+                still.append(acspec)
+                continue
+            res_mod.acquire(self.avail, acspec.resources)
+            wid = self._spawn_worker(purpose=acspec.actor_id)
+            self._actor_create_specs[acspec.actor_id] = acspec
+            w = self.workers[wid]
+            w.held_resources = dict(acspec.resources)
+            w.actor_id = acspec.actor_id
+        self.pending_actors = still
+
+        # 2. normal tasks
+        still = collections.deque()
+        while self.pending_tasks:
+            spec = self.pending_tasks.popleft()
+            te = self.gcs.tasks[spec.task_id]
+            if te.state == "CANCELLED":
+                continue
+            dr = self._deps_ready(spec.dep_object_ids)
+            if dr is None:
+                te.state = "FAILED"
+                self._respawnable_specs.pop(spec.task_id, None)
+                err = TaskError("upstream dependency failed", "", spec.name)
+                for oid in spec.return_ids:
+                    self._fail_object(oid, err)
+                continue
+            if dr is False:
+                still.append(spec)
+                continue
+            need = spec.resources if spec.placement_group_id is None else {}
+            if not res_mod.fits(self.avail, need):
+                still.append(spec)
+                continue
+            w = self._find_idle_worker()
+            if w is None:
+                if self._can_spawn():
+                    self._spawn_worker(purpose=None)
+                still.append(spec)
+                continue
+            try:
+                w.conn.send(("exec_task", spec))
+            except ConnectionClosed:
+                # Worker socket just broke: its death event will arrive via
+                # the reader thread; requeue the spec and keep scheduling.
+                w.state = "dying"
+                still.append(spec)
+                continue
+            res_mod.acquire(self.avail, need)
+            w.state, w.current_task = "busy", spec.task_id
+            w.held_resources = dict(need)
+            te.state, te.worker_id, te.started_at = ("RUNNING", w.worker_id,
+                                                     time.time())
+        self.pending_tasks = still
+
+        # 3. actor tasks
+        for aid, q in list(self.actor_queues.items()):
+            ae = self.gcs.actors.get(aid)
+            if ae is None:
+                continue
+            if ae.state == "DEAD":
+                while q:
+                    spec = q.popleft()
+                    err = ActorDiedError(f"actor {aid} died: {ae.death_cause}")
+                    self.gcs.tasks[spec.task_id].state = "FAILED"
+                    for oid in spec.return_ids:
+                        self._fail_object(oid, err)
+                continue
+            if ae.state != "ALIVE":
+                continue
+            w = self._worker_for_actor(aid)
+            if w is None or w.conn is None:
+                continue
+            maxc = self.actor_max_conc.get(aid, 1)
+            while q and self.actor_inflight.get(aid, 0) < maxc:
+                spec = q[0]
+                dr = self._deps_ready(spec.dep_object_ids)
+                if dr is False:
+                    break
+                q.popleft()
+                if dr is None:
+                    err = TaskError("upstream dependency failed", "", spec.name)
+                    self.gcs.tasks[spec.task_id].state = "FAILED"
+                    for oid in spec.return_ids:
+                        self._fail_object(oid, err)
+                    continue
+                te = self.gcs.tasks[spec.task_id]
+                if te.state == "CANCELLED":
+                    continue
+                try:
+                    w.conn.send(("exec_actor_task", spec))
+                except ConnectionClosed:
+                    q.appendleft(spec)
+                    break
+                self.actor_inflight[aid] = self.actor_inflight.get(aid, 0) + 1
+                te.state, te.worker_id, te.started_at = ("RUNNING",
+                                                         w.worker_id,
+                                                         time.time())
+
+    def _find_idle_worker(self) -> Optional[WorkerState]:
+        for w in self.workers.values():
+            if w.state == "idle" and w.conn is not None:
+                return w
+        return None
+
+    def _can_spawn(self) -> bool:
+        live = sum(1 for w in self.workers.values()
+                   if w.state in ("starting", "idle"))
+        return live == 0 or len([w for w in self.workers.values()
+                                 if w.state != "dead"]) < self.max_workers
+
+    def _spawn_worker(self, purpose) -> str:
+        self._wid_counter += 1
+        wid = f"w{self._wid_counter:04d}"
+        env = dict(os.environ)
+        env["RAY_TPU_JOB_ID"] = self.job_id
+        env.setdefault("PYTHONPATH", "")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
+        # Workers default to CPU JAX unless told otherwise: the real TPU chip
+        # belongs to the driver-side SPMD step (single-controller model).
+        env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker",
+             self.socket_path, wid],
+            env=env, cwd=os.getcwd())
+        self.workers[wid] = WorkerState(wid, proc, purpose=purpose)
+        return wid
+
+    def _worker_for_actor(self, aid: str) -> Optional[WorkerState]:
+        for w in self.workers.values():
+            if w.actor_id == aid and w.state == "actor":
+                return w
+        return None
+
+    # ---------------- completions ----------------
+    def _on_task_done(self, wid: str, task_id: str, sealed, error):
+        te = self.gcs.tasks.get(task_id)
+        w = self.workers.get(wid)
+        if te is None:
+            return
+        spec_returns = []
+        if error is None:
+            te.state = "FINISHED"
+            for oid, loc in sealed:
+                self._seal(oid, loc)
+                spec_returns.append(oid)
+        elif error == "cancelled":
+            te.state = "CANCELLED"
+            err = TaskCancelledError(f"task {task_id} cancelled")
+            for oid in self._return_ids_of(task_id):
+                self._fail_object(oid, err)
+        else:
+            te.state = "FAILED"
+            for oid in self._return_ids_of(task_id):
+                self._fail_object(oid, error)
+        te.finished_at = time.time()
+        self._respawnable_specs.pop(task_id, None)
+        if te.actor_id is not None:
+            aid = te.actor_id
+            self.actor_inflight[aid] = max(
+                0, self.actor_inflight.get(aid, 0) - 1)
+        elif w is not None:
+            res_mod.release(self.avail, w.held_resources)
+            w.held_resources = {}
+            w.state, w.current_task, w.blocked = "idle", None, False
+
+    def _return_ids_of(self, task_id: str) -> List[str]:
+        return [oid for oid, e in self.gcs.objects.items()
+                if e.owner_task == task_id]
+
+    def _on_actor_created(self, wid: str, actor_id: str, ok: bool, err):
+        ae = self.gcs.actors.get(actor_id)
+        if ae is None:
+            return
+        if ok:
+            ae.state, ae.worker_id = "ALIVE", wid
+        else:
+            ae.state, ae.death_cause = "DEAD", repr(err)
+            w = self.workers.get(wid)
+            if w is not None:
+                res_mod.release(self.avail, w.held_resources)
+                w.held_resources = {}
+                self._terminate_worker(w)
+            # propagate the constructor error to queued method calls
+            for spec in self.actor_queues.get(actor_id, []):
+                self.gcs.tasks[spec.task_id].state = "FAILED"
+                for oid in spec.return_ids:
+                    self._fail_object(oid, err)
+            self.actor_queues.pop(actor_id, None)
+
+    def _on_worker_dead(self, wid: str):
+        w = self.workers.get(wid)
+        if w is None or w.state == "dead":
+            return
+        w.state = "dead"
+        if not w.blocked:
+            # Blocked workers already returned their resources when they
+            # entered get() — releasing again would inflate capacity.
+            res_mod.release(self.avail, w.held_resources)
+        w.held_resources = {}
+        w.blocked = False
+        self._conn_by_wid.pop(wid, None)
+        # running normal task -> retry or fail
+        if w.current_task:
+            te = self.gcs.tasks.get(w.current_task)
+            if te is not None and te.state == "RUNNING":
+                spec = self._respawnable_specs.get(w.current_task)
+                if te.retries_left > 0 and spec is not None:
+                    te.retries_left -= 1
+                    te.state = "PENDING"
+                    self.pending_tasks.append(spec)
+                else:
+                    te.state = "FAILED"
+                    err = WorkerCrashedError(
+                        f"worker {wid} died while running {te.name}")
+                    for oid in self._return_ids_of(w.current_task):
+                        self._fail_object(oid, err)
+        # actor hosted here -> restart or mark dead
+        if w.actor_id:
+            self._on_actor_worker_dead(w.actor_id, wid)
+
+    def _on_actor_worker_dead(self, aid: str, wid: str):
+        ae = self.gcs.actors.get(aid)
+        if ae is None or ae.state == "DEAD":
+            return
+        # fail in-flight tasks on that actor
+        for task_id, te in self.gcs.tasks.items():
+            if te.actor_id == aid and te.state == "RUNNING":
+                te.state = "FAILED"
+                err = ActorDiedError(f"actor {aid} worker died")
+                for oid in self._return_ids_of(task_id):
+                    self._fail_object(oid, err)
+        self.actor_inflight[aid] = 0
+        if ae.num_restarts < ae.max_restarts:
+            ae.num_restarts += 1
+            ae.state = "RESTARTING"
+            acspec: ActorCreationSpec = ae.create_spec
+            res_mod.acquire(self.avail, acspec.resources)
+            new_wid = self._spawn_worker(purpose=aid)
+            nw = self.workers[new_wid]
+            nw.held_resources = dict(acspec.resources)
+            nw.actor_id = aid
+            # _on_actor_created flips state back to ALIVE on success.
+        else:
+            ae.state = "DEAD"
+            ae.death_cause = ae.death_cause or f"worker {wid} died"
+            for spec in self.actor_queues.get(aid, []):
+                self.gcs.tasks[spec.task_id].state = "FAILED"
+                err = ActorDiedError(f"actor {aid} died")
+                for oid in spec.return_ids:
+                    self._fail_object(oid, err)
+            self.actor_queues.pop(aid, None)
+
+    # ---------------- worker-side blocking verbs ----------------
+    def _worker_get(self, w: Optional[WorkerState], rid, oids, timeout):
+        def cb(results, ready, w=w, rid=rid, oids=oids):
+            full = {}
+            for oid in oids:
+                full[oid] = results.get(
+                    oid, ("error", ObjectLostError(f"{oid} unavailable")))
+            if w is not None and w.conn is not None:
+                try:
+                    w.conn.send(("get_reply", rid, full))
+                except ConnectionClosed:
+                    pass
+            if w is not None and w.blocked:
+                w.blocked = False
+                res_mod.acquire(self.avail, w.held_resources)
+        waiter = Waiter(oids, None, cb)
+        if w is not None and w.state == "busy" and not w.blocked:
+            # Worker blocks in user get(): release its resources so other
+            # tasks can run (reference: raylet "blocked worker" CPU release,
+            # src/ray/raylet/node_manager.cc HandleTaskBlocked).
+            w.blocked = True
+            res_mod.release(self.avail, w.held_resources)
+        self._add_waiter(waiter, timeout=timeout)
+
+    def _worker_wait(self, w, rid, oids, num_returns, timeout):
+        def cb(results, ready, w=w, rid=rid):
+            if w is not None and w.conn is not None:
+                try:
+                    w.conn.send(("get_reply", rid, ready))
+                except ConnectionClosed:
+                    pass
+        waiter = Waiter(oids, num_returns, cb)
+        self._add_waiter(waiter, timeout=timeout)
+
+    # ---------------- control ----------------
+    def _cancel(self, task_id: str, force: bool):
+        te = self.gcs.tasks.get(task_id)
+        if te is None or te.state in ("FINISHED", "FAILED", "CANCELLED"):
+            return
+        if te.state in ("PENDING", "SCHEDULED"):
+            te.state = "CANCELLED"
+            self._respawnable_specs.pop(task_id, None)
+            err = TaskCancelledError(f"task {task_id} cancelled")
+            for oid in self._return_ids_of(task_id):
+                self._fail_object(oid, err)
+        elif te.state == "RUNNING":
+            w = self.workers.get(te.worker_id or "")
+            if w and w.conn:
+                try:
+                    w.conn.send(("cancel", task_id))
+                except ConnectionClosed:
+                    pass
+            if force and w is not None and te.actor_id is None:
+                # Mark terminal first so the death handler neither retries
+                # nor double-fails this task.
+                te.state = "CANCELLED"
+                self._respawnable_specs.pop(task_id, None)
+                err = TaskCancelledError(f"task {task_id} cancelled (force)")
+                for oid in self._return_ids_of(task_id):
+                    self._fail_object(oid, err)
+                w.current_task = None
+                self._terminate_worker(w)
+
+    def _kill_actor(self, actor_id: str, no_restart: bool):
+        ae = self.gcs.actors.get(actor_id)
+        if ae is None or ae.state == "DEAD":
+            return
+        if no_restart:
+            ae.max_restarts = ae.num_restarts  # block further restarts
+            ae.death_cause = "killed via ray_tpu.kill"
+        w = self._worker_for_actor(actor_id)
+        if w is not None:
+            # The death handler (run inline by _terminate_worker) fails
+            # in-flight tasks and either restarts the actor or marks it DEAD,
+            # honoring the remaining restart budget.
+            self._terminate_worker(w)
+        else:
+            ae.state = "DEAD"
+            ae.death_cause = ae.death_cause or "killed before start"
+            for spec in self.actor_queues.pop(actor_id, []):
+                self.gcs.tasks[spec.task_id].state = "FAILED"
+                err = ActorDiedError(f"actor {actor_id} was killed")
+                for oid in spec.return_ids:
+                    self._fail_object(oid, err)
+
+    def _terminate_worker(self, w: WorkerState):
+        """Forcefully stop a worker process and run its death cleanup inline.
+
+        The reader thread will also post a worker_dead event when the socket
+        drops; _on_worker_dead dedupes on state == "dead"."""
+        try:
+            if w.conn:
+                w.conn.close()
+        except Exception:
+            pass
+        try:
+            w.proc.terminate()
+        except Exception:
+            pass
+        self._on_worker_dead(w.worker_id)
+
+    def _free(self, oids: List[str]):
+        for oid in oids:
+            e = self.gcs.objects.pop(oid, None)
+            if e is not None and e.loc is not None and e.loc.kind == "shm":
+                self.store.delete_segment(e.loc.name, e.loc.size)
+
+    def _create_pg(self, pg: PlacementGroupState):
+        # Registration only; admission happens in _schedule phase 0.
+        self.placement_groups[pg.pg_id] = pg
+
+    def _remove_pg(self, pg_id: str):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is not None and pg.state == "CREATED":
+            res_mod.release(self.avail, self._pg_total(pg.bundles))
+
+    # ================= public API (called from any thread) =================
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._respawnable_specs[spec.task_id] = spec
+        self.inbox.put(("api_submit", spec))
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.submit(spec)
+
+    def create_actor(self, acspec: ActorCreationSpec) -> None:
+        self.inbox.put(("api_submit_actor", acspec))
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = new_object_id()
+        loc = self.store.put_value(oid, value)
+        self.inbox.put(("api_seal", oid, loc))
+        return ObjectRef(oid)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        oids = [r.id for r in refs]
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+
+        def cb(results, ready):
+            box.update(results)
+            ev.set()
+
+        waiter = Waiter(oids, None, cb)
+        self.inbox.put(("api_waiter", waiter))
+        if not ev.wait(timeout):
+            waiter.done = True
+            raise GetTimeoutError(
+                f"get() timed out after {timeout}s on {len(oids)} objects")
+        out = []
+        for oid in oids:
+            kind, payload = box.get(oid, ("error",
+                                          ObjectLostError(f"{oid} missing")))
+            if kind == "error":
+                if isinstance(payload, BaseException):
+                    raise payload
+                raise TaskError(str(payload))
+            out.append(self.store.get_value(payload))
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ev = threading.Event()
+        box: Dict[str, Any] = {"ready": []}
+
+        def cb(results, ready):
+            box["ready"] = ready
+            ev.set()
+
+        waiter = Waiter([r.id for r in refs], num_returns, cb)
+        self.inbox.put(("api_waiter", waiter))
+        # emulate timeout by a timer event so the dispatcher fires partial
+        if timeout is not None:
+            t = threading.Timer(timeout, lambda: self.inbox.put(
+                ("waiter_timeout", waiter.waiter_id)))
+            t.daemon = True
+            t.start()
+        ev.wait(None if timeout is None else timeout + 1.0)
+        ready_ids = set(box["ready"])
+        ready = [r for r in refs if r.id in ready_ids]
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.inbox.put(("api_kill_actor", actor_id, no_restart))
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self.inbox.put(("api_cancel_obj", ref.id, force))
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        self.inbox.put(("api_free", [r.id for r in refs]))
+
+    def report(self, channel: str, payload: Any) -> None:
+        h = self.report_handlers.get(channel)
+        if h:
+            h("driver", payload)
+
+    def register_report_handler(self, channel: str, fn: Callable) -> None:
+        self.report_handlers[channel] = fn
+
+    def placement_group(self, bundles, strategy="PACK", name="") -> "PlacementGroupState":
+        from .ids import new_placement_group_id  # noqa: PLC0415
+        pg = PlacementGroupState(new_placement_group_id(), bundles, strategy,
+                                 name)
+        pg.ready_ref = new_object_id()
+        self.gcs.add_pending_object(pg.ready_ref)
+        self.inbox.put(("api_create_pg", pg))
+        return pg
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        self.inbox.put(("api_remove_pg", pg_id))
+
+    def get_resources(self) -> Dict[str, float]:
+        return dict(self.total_resources)
+
+    def available_resources(self) -> Dict[str, float]:
+        return dict(self.avail)
+
+    def actor_state(self, actor_id: str) -> Optional[str]:
+        ae = self.gcs.actors.get(actor_id)
+        return ae.state if ae else None
+
+    def wait_actor_alive(self, actor_id: str, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ae = self.gcs.actors.get(actor_id)
+            if ae is not None and ae.state == "ALIVE":
+                return
+            if ae is not None and ae.state == "DEAD":
+                raise ActorDiedError(
+                    f"actor failed to start: {ae.death_cause}")
+            time.sleep(0.005)
+        raise GetTimeoutError(f"actor {actor_id} not alive in {timeout}s")
+
+    # ---------------- shutdown ----------------
+    def shutdown(self) -> None:
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        for w in list(self.workers.values()):
+            try:
+                if w.conn:
+                    w.conn.send(("shutdown",))
+            except Exception:
+                pass
+        time.sleep(0.05)
+        for w in list(self.workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        deadline = time.time() + 2.0
+        for w in list(self.workers.values()):
+            try:
+                w.proc.wait(timeout=max(0.01, deadline - time.time()))
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        self.inbox.put(None)
+        self.store.shutdown()
+        try:
+            os.unlink(self.socket_path)
+            os.rmdir(self._tmpdir)
+        except OSError:
+            pass
+        global _runtime
+        with _runtime_lock:
+            if _runtime is self:
+                _runtime = None

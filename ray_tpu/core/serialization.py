@@ -1,0 +1,118 @@
+"""Value serialization for the object store.
+
+Reference parity: python/ray/_private/serialization.py — cloudpickle for
+arbitrary Python, pickle protocol 5 out-of-band buffers for zero-copy numpy.
+
+Wire format of a sealed object:
+    [u32 meta_len][meta pickle][u32 nbufs][u64 len_i ... aligned buffers]
+
+Buffers are 64-byte aligned inside the payload so readers can map numpy
+arrays directly onto shared memory with no copy.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + ALIGN - 1) // ALIGN * ALIGN
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize to (meta, out_of_band_buffers).
+
+    numpy arrays (and anything implementing __reduce_ex__ with protocol 5
+    buffer support) ship their payload out-of-band; jax.Array is converted
+    to numpy by the caller before it reaches here.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return meta, buffers
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into a single contiguous payload (small-object path)."""
+    meta, bufs = serialize(value)
+    return pack_parts(meta, bufs)
+
+
+def pack_parts(meta: bytes, bufs: List[pickle.PickleBuffer]) -> bytes:
+    raws = [b.raw() for b in bufs]
+    header = bytearray()
+    header += struct.pack("<I", len(meta))
+    header += meta
+    header += struct.pack("<I", len(raws))
+    for r in raws:
+        header += struct.pack("<Q", r.nbytes)
+    out = bytearray(header)
+    for r in raws:
+        pad = _align(len(out)) - len(out)
+        out += b"\x00" * pad
+        out += r
+    return bytes(out)
+
+
+def packed_size(meta: bytes, bufs: List[pickle.PickleBuffer]) -> int:
+    n = 4 + len(meta) + 4 + 8 * len(bufs)
+    for b in bufs:
+        n = _align(n) + b.raw().nbytes
+    return n
+
+
+def pack_into(mv: memoryview, meta: bytes, bufs: List[pickle.PickleBuffer]) -> int:
+    """Write the wire format into a writable memoryview (shm path). Returns
+    bytes written."""
+    off = 0
+    mv[off:off + 4] = struct.pack("<I", len(meta)); off += 4
+    mv[off:off + len(meta)] = meta; off += len(meta)
+    raws = [b.raw() for b in bufs]
+    mv[off:off + 4] = struct.pack("<I", len(raws)); off += 4
+    for r in raws:
+        mv[off:off + 8] = struct.pack("<Q", r.nbytes); off += 8
+    for r in raws:
+        aligned = _align(off)
+        if aligned != off:
+            mv[off:aligned] = b"\x00" * (aligned - off)
+            off = aligned
+        mv[off:off + r.nbytes] = r
+        off += r.nbytes
+    return off
+
+
+def unpack(payload) -> Any:
+    """Deserialize from bytes or a memoryview.
+
+    When given a memoryview over shared memory, numpy buffers alias the shm
+    pages (zero-copy); callers must keep the segment mapped while the value
+    lives. bytes input always owns its data.
+    """
+    mv = memoryview(payload)
+    off = 0
+    (meta_len,) = struct.unpack_from("<I", mv, off); off += 4
+    meta = bytes(mv[off:off + meta_len]); off += meta_len
+    (nbufs,) = struct.unpack_from("<I", mv, off); off += 4
+    sizes = []
+    for _ in range(nbufs):
+        (sz,) = struct.unpack_from("<Q", mv, off); off += 8
+        sizes.append(sz)
+    bufs = []
+    for sz in sizes:
+        aligned = _align(off)
+        bufs.append(mv[aligned:aligned + sz])
+        off = aligned + sz
+    return pickle.loads(meta, buffers=bufs)
+
+
+def dumps_call(obj: Any) -> bytes:
+    """Serialize task functions / actor classes by value (cloudpickle)."""
+    return cloudpickle.dumps(obj)
+
+
+def loads_call(data: bytes) -> Any:
+    return cloudpickle.loads(data)

@@ -1,0 +1,92 @@
+"""Task & actor specs plus the user-facing RemoteFunction wrapper.
+
+Reference parity: python/ray/remote_function.py (RemoteFunction, .options),
+src/ray/common/task/task_spec.h (TaskSpec fields).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .ids import new_task_id, new_object_id
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: str
+    name: str
+    func_bytes: bytes                  # cloudpickled callable (None for actor methods)
+    args: Tuple = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_returns: int = 1
+    return_ids: List[str] = dataclasses.field(default_factory=list)
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor fields
+    actor_id: Optional[str] = None
+    method_name: Optional[str] = None
+    # placement
+    placement_group_id: Optional[str] = None
+    bundle_index: int = -1
+    scheduling_strategy: Optional[str] = None
+    # bookkeeping
+    func_id: str = ""                  # cache key for deserialized functions
+    dep_object_ids: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ActorCreationSpec:
+    actor_id: str
+    class_bytes: bytes
+    class_name: str
+    args: Tuple = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: Optional[str] = None
+    namespace: str = "default"
+    placement_group_id: Optional[str] = None
+    bundle_index: int = -1
+    runtime_env: Optional[dict] = None
+    dep_object_ids: List[str] = dataclasses.field(default_factory=list)
+
+
+def extract_arg_deps(args: Tuple, kwargs: Dict[str, Any]) -> List[str]:
+    """Top-level ObjectRef args become scheduling dependencies; the worker
+    substitutes their values before invoking the function (same contract as
+    the reference: nested refs are passed through un-resolved)."""
+    from .object_ref import ObjectRef  # noqa: PLC0415
+    deps = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, ObjectRef):
+            deps.append(a.id)
+    return deps
+
+
+def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
+                   resources=None, max_retries=0, retry_exceptions=False,
+                   func_bytes=None, func_id="", placement_group_id=None,
+                   bundle_index=-1, scheduling_strategy=None) -> TaskSpec:
+    tid = new_task_id()
+    spec = TaskSpec(
+        task_id=tid,
+        name=name or getattr(func, "__qualname__", "anonymous"),
+        func_bytes=func_bytes if func_bytes is not None
+        else serialization.dumps_call(func),
+        args=tuple(args),
+        kwargs=dict(kwargs or {}),
+        num_returns=num_returns,
+        return_ids=[new_object_id() for _ in range(max(num_returns, 1))],
+        resources=dict(resources or {"CPU": 1.0}),
+        max_retries=max_retries,
+        retry_exceptions=retry_exceptions,
+        func_id=func_id,
+        placement_group_id=placement_group_id,
+        bundle_index=bundle_index,
+        scheduling_strategy=scheduling_strategy,
+        dep_object_ids=extract_arg_deps(args, kwargs or {}),
+    )
+    return spec

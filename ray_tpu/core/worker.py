@@ -1,0 +1,330 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference parity: src/ray/core_worker/core_worker.cc (task execution,
+arg resolution, return-object sealing) + python/ray/_private/worker.py
+(the Python worker loop). One OS process per worker; a reader thread
+demultiplexes driver messages into an execution queue and reply slots, so
+user code can block in `get()` while new messages keep flowing.
+
+Run as: python -m ray_tpu.core.worker <socket_path> <worker_id>
+"""
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from . import serialization
+from .ids import new_object_id
+from .object_ref import ObjectRef
+from .object_store import ShmStore, ObjectLocation, INLINE_MAX, make_store
+from .protocol import Connection, ConnectionClosed, unix_connect
+from .task import TaskSpec, ActorCreationSpec
+from ..exceptions import TaskError, GetTimeoutError, ObjectLostError
+
+
+class WorkerRuntime:
+    """The runtime visible to user code running inside this worker.
+
+    Implements the same verbs as the driver runtime so `ray_tpu.get/put/
+    remote` work transparently in nested tasks.
+    """
+
+    is_driver = False
+
+    def __init__(self, conn: Connection, worker_id: str, store: ShmStore):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.store = store
+        self._replies: Dict[str, queue.Queue] = {}
+        self._replies_lock = threading.Lock()
+        self._req_counter = 0
+        self._func_cache: Dict[str, Any] = {}
+        self.current_task_id: Optional[str] = None
+        self.current_actor_id: Optional[str] = None
+        self.job_id = os.environ.get("RAY_TPU_JOB_ID", "job-default")
+
+    # ---- request/reply over the driver connection -------------------------
+    def _new_req(self) -> str:
+        with self._replies_lock:
+            self._req_counter += 1
+            rid = f"{self.worker_id}:{self._req_counter}"
+            q: queue.Queue = queue.Queue(maxsize=1)
+            self._replies[rid] = q
+        return rid
+
+    def _take_reply(self, rid: str, timeout: Optional[float]) -> Any:
+        q = self._replies[rid]
+        try:
+            return q.get(timeout=timeout)
+        except queue.Empty:
+            raise GetTimeoutError(f"request {rid} timed out") from None
+        finally:
+            with self._replies_lock:
+                self._replies.pop(rid, None)
+
+    def deliver_reply(self, rid: str, payload: Any) -> None:
+        with self._replies_lock:
+            q = self._replies.get(rid)
+        if q is not None:
+            q.put(payload)
+
+    # ---- core verbs -------------------------------------------------------
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        oids = [r.id for r in refs]
+        rid = self._new_req()
+        self.conn.send(("get_request", rid, oids, timeout))
+        results = self._take_reply(rid, timeout)  # {oid: ("loc"|"error", payload)}
+        out = []
+        for oid in oids:
+            kind, payload = results[oid]
+            if kind == "error":
+                raise payload if isinstance(payload, BaseException) else TaskError(str(payload))
+            out.append(self.store.get_value(payload))
+        return out
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = new_object_id()
+        loc = self.store.put_value(oid, value)
+        self.conn.send(("put", oid, loc))
+        return ObjectRef(oid)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        rid = self._new_req()
+        self.conn.send(("wait_request", rid, [r.id for r in refs],
+                        num_returns, timeout))
+        ready_ids = set(self._take_reply(rid, None))
+        ready = [r for r in refs if r.id in ready_ids]
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.conn.send(("submit", spec))
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def create_actor(self, acspec: ActorCreationSpec) -> None:
+        self.conn.send(("submit_actor", acspec))
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.conn.send(("submit", spec))
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.conn.send(("kill_actor", actor_id, no_restart))
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self.conn.send(("cancel", ref.id, force))
+
+    def report(self, channel: str, payload: Any) -> None:
+        """Out-of-band message to the driver (train session, metrics...)."""
+        self.conn.send(("report", channel, payload))
+
+    def report_sync(self, channel: str, payload: Any, timeout=None) -> Any:
+        rid = self._new_req()
+        self.conn.send(("report_sync", rid, channel, payload))
+        return self._take_reply(rid, timeout)
+
+    def get_resources(self) -> Dict[str, float]:
+        return {}
+
+    def shutdown(self) -> None:
+        pass
+
+    # ---- function cache ---------------------------------------------------
+    def load_func(self, spec: TaskSpec):
+        if spec.func_id and spec.func_id in self._func_cache:
+            return self._func_cache[spec.func_id]
+        fn = serialization.loads_call(spec.func_bytes)
+        if spec.func_id:
+            self._func_cache[spec.func_id] = fn
+        return fn
+
+
+def _resolve_args(rt: WorkerRuntime, args, kwargs):
+    """Fetch top-level ObjectRef args (deps are ready by scheduling time)."""
+    refs = [a for a in list(args) + list(kwargs.values())
+            if isinstance(a, ObjectRef)]
+    if not refs:
+        return args, kwargs
+    vals = rt.get(refs)
+    table = {r.id: v for r, v in zip(refs, vals)}
+    new_args = tuple(table[a.id] if isinstance(a, ObjectRef) else a
+                     for a in args)
+    new_kwargs = {k: (table[v.id] if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+    return new_args, new_kwargs
+
+
+class WorkerLoop:
+    def __init__(self, socket_path: str, worker_id: str):
+        self.conn = unix_connect(socket_path)
+        self.store = make_store(capacity_bytes=int(
+            os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30))), is_owner=False)
+        self.rt = WorkerRuntime(self.conn, worker_id, self.store)
+        self.worker_id = worker_id
+        self._task_q: "queue.Queue" = queue.Queue()
+        self._shutdown = threading.Event()
+        self._actor_instance: Any = None
+        self._actor_spec: Optional[ActorCreationSpec] = None
+        self._actor_pool: Optional[ThreadPoolExecutor] = None
+        self._async_loop = None
+        self._cancelled: set = set()
+
+    # ---- main -------------------------------------------------------------
+    def run(self) -> None:
+        from . import runtime as runtime_mod  # noqa: PLC0415
+        runtime_mod.set_runtime(self.rt)
+        self.conn.send(("register", self.worker_id, os.getpid()))
+        reader = threading.Thread(target=self._read_loop, daemon=True)
+        reader.start()
+        while not self._shutdown.is_set():
+            try:
+                item = self._task_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            kind, payload = item
+            if kind == "task":
+                self._run_task(payload)
+            elif kind == "create_actor":
+                self._create_actor(payload)
+            elif kind == "actor_task":
+                self._dispatch_actor_task(payload)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except ConnectionClosed:
+                self._shutdown.set()
+                os._exit(0)
+            mtype = msg[0]
+            if mtype == "exec_task":
+                self._task_q.put(("task", msg[1]))
+            elif mtype == "create_actor":
+                self._task_q.put(("create_actor", msg[1]))
+            elif mtype == "exec_actor_task":
+                self._task_q.put(("actor_task", msg[1]))
+            elif mtype == "get_reply":
+                self.rt.deliver_reply(msg[1], msg[2])
+            elif mtype == "cancel":
+                self._cancelled.add(msg[1])
+            elif mtype == "shutdown":
+                self._shutdown.set()
+
+    # ---- execution --------------------------------------------------------
+    def _seal_returns(self, spec: TaskSpec, result: Any):
+        """Pack return values; small ones ride inline in task_done."""
+        n = spec.num_returns
+        values = (result,) if n == 1 else tuple(result)
+        if n > 1 and len(values) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{len(values)} values")
+        sealed = []
+        for oid, val in zip(spec.return_ids, values):
+            loc = self.store.put_value(oid, val)
+            sealed.append((oid, loc))
+        return sealed
+
+    def _run_task(self, spec: TaskSpec) -> None:
+        if spec.task_id in self._cancelled:
+            self.conn.send(("task_done", spec.task_id, [], "cancelled"))
+            return
+        self.rt.current_task_id = spec.task_id
+        try:
+            fn = self.rt.load_func(spec)
+            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
+            result = fn(*args, **kwargs)
+            sealed = self._seal_returns(spec, result)
+            self.conn.send(("task_done", spec.task_id, sealed, None))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(), spec.name)
+            self.conn.send(("task_done", spec.task_id, [], err))
+        finally:
+            self.rt.current_task_id = None
+
+    def _create_actor(self, acspec: ActorCreationSpec) -> None:
+        try:
+            cls = serialization.loads_call(acspec.class_bytes)
+            args, kwargs = _resolve_args(self.rt, acspec.args, acspec.kwargs)
+            self._actor_instance = cls(*args, **kwargs)
+            self._actor_spec = acspec
+            self.rt.current_actor_id = acspec.actor_id
+            if acspec.max_concurrency > 1:
+                self._actor_pool = ThreadPoolExecutor(
+                    max_workers=acspec.max_concurrency,
+                    thread_name_prefix="actor")
+            self.conn.send(("actor_created", acspec.actor_id, True, None))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(),
+                            f"{acspec.class_name}.__init__")
+            self.conn.send(("actor_created", acspec.actor_id, False, err))
+
+    def _dispatch_actor_task(self, spec: TaskSpec) -> None:
+        import inspect  # noqa: PLC0415
+        method = getattr(self._actor_instance, spec.method_name, None)
+        if method is not None and inspect.iscoroutinefunction(
+                getattr(method, "__func__", method)):
+            self._ensure_async_loop()
+            import asyncio  # noqa: PLC0415
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_async(spec), self._async_loop)
+        elif self._actor_pool is not None:
+            self._actor_pool.submit(self._run_actor_task, spec)
+        else:
+            self._run_actor_task(spec)
+
+    def _run_actor_task(self, spec: TaskSpec) -> None:
+        try:
+            method = getattr(self._actor_instance, spec.method_name)
+            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
+            result = method(*args, **kwargs)
+            sealed = self._seal_returns(spec, result)
+            self.conn.send(("task_done", spec.task_id, sealed, None))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(),
+                            f"{type(self._actor_instance).__name__}."
+                            f"{spec.method_name}")
+            self.conn.send(("task_done", spec.task_id, [], err))
+
+    async def _run_actor_task_async(self, spec: TaskSpec) -> None:
+        try:
+            method = getattr(self._actor_instance, spec.method_name)
+            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
+            result = await method(*args, **kwargs)
+            sealed = self._seal_returns(spec, result)
+            self.conn.send(("task_done", spec.task_id, sealed, None))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(),
+                            f"async.{spec.method_name}")
+            self.conn.send(("task_done", spec.task_id, [], err))
+
+    def _ensure_async_loop(self):
+        if self._async_loop is None:
+            import asyncio  # noqa: PLC0415
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._async_loop.run_forever,
+                                 daemon=True, name="actor-asyncio")
+            t.start()
+
+
+def main() -> None:
+    socket_path, worker_id = sys.argv[1], sys.argv[2]
+    try:
+        loop = WorkerLoop(socket_path, worker_id)
+    except (ConnectionRefusedError, FileNotFoundError):
+        # Driver died between spawning us and our connect: exit quietly.
+        sys.exit(0)
+    loop.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Exception hierarchy for ray_tpu.
+
+Parity: python/ray/exceptions.py in the reference (RayError, RayTaskError,
+RayActorError, GetTimeoutError, ObjectLostError, TaskCancelledError).
+"""
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task.
+
+    Re-raised at the `get()` call site with the worker-side traceback
+    attached, mirroring RayTaskError (reference python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause_repr: str, traceback_str: str = "",
+                 task_name: str = ""):
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        self.task_name = task_name
+        super().__init__(
+            f"task {task_name or '<unknown>'} failed: {cause_repr}\n"
+            f"{traceback_str}")
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor process died (crash or kill) before/while serving a call."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted or its producing worker died irrecoverably."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get(timeout=...)` expired before the object became available."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled with `ray_tpu.cancel`."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """A worker process died unexpectedly while executing a task."""
+
+
+class RuntimeNotInitializedError(RayTpuError):
+    """An API call was made before `ray_tpu.init()`."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory object store could not satisfy an allocation."""

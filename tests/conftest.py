@@ -1,0 +1,23 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+Must set env before jax is imported anywhere (SURVEY.md §4).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_STORE_BYTES", str(1 << 30))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def rt():
+    """A shared driver runtime per test module."""
+    import ray_tpu
+    handle = ray_tpu.init(num_cpus=8)
+    yield handle
+    ray_tpu.shutdown()

@@ -1,0 +1,136 @@
+"""Actor API tests (parity model: python/ray/tests/test_actor.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def crash(self):
+        import os
+        os._exit(1)
+
+
+@ray_tpu.remote
+class BadInit:
+    def __init__(self):
+        raise RuntimeError("ctor fail")
+
+    def ping(self):
+        return "pong"
+
+
+def test_actor_basic(rt):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.read.remote()) == 16
+
+
+def test_actor_method_ordering(rt):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(10)]
+    assert ray_tpu.get(refs) == list(range(1, 11))
+
+
+def test_actor_handle_passed_to_task(rt):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter, k):
+        return ray_tpu.get(counter.inc.remote(k))
+
+    assert ray_tpu.get(bump.remote(c, 7)) == 7
+    assert ray_tpu.get(c.read.remote()) == 7
+
+
+def test_named_actor(rt):
+    Counter.options(name="global_counter").remote(100)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.inc.remote()) == 101
+
+
+def test_actor_ctor_failure(rt):
+    b = BadInit.remote()
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray_tpu.get(b.ping.remote(), timeout=10)
+
+
+def test_kill_actor(rt):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=10)
+
+
+def test_actor_crash_gives_died_error(rt):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    crash_ref = c.crash.remote()
+    with pytest.raises((ActorDiedError, Exception)):
+        ray_tpu.get(crash_ref, timeout=10)
+
+
+def test_actor_restart(rt):
+    c = Counter.options(max_restarts=1).remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    try:
+        ray_tpu.get(c.crash.remote(), timeout=10)
+    except Exception:
+        pass
+    # actor restarts with fresh state
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(c.inc.remote(), timeout=10) == 1
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_max_concurrency(rt):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(0.0))  # warm-up: wait for process spawn
+    t0 = time.time()
+    refs = [s.nap.remote(0.3) for _ in range(4)]
+    ray_tpu.get(refs)
+    # 4 overlapping 0.3s naps should take well under 1.2s total
+    assert time.time() - t0 < 1.0
+
+
+def test_async_actor(rt):
+    @ray_tpu.remote(max_concurrency=8)
+    class AsyncWorker:
+        async def work(self, t):
+            import asyncio
+            await asyncio.sleep(t)
+            return t
+
+    a = AsyncWorker.remote()
+    ray_tpu.get(a.work.remote(0.0))  # warm-up: wait for process spawn
+    t0 = time.time()
+    refs = [a.work.remote(0.3) for _ in range(6)]
+    assert ray_tpu.get(refs) == [0.3] * 6
+    assert time.time() - t0 < 1.2

@@ -1,0 +1,123 @@
+"""Core task API tests (parity model: python/ray/tests/test_basic.py)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError, GetTimeoutError
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def fail():
+    raise ValueError("boom")
+
+
+@ray_tpu.remote
+def big_array(n):
+    return np.arange(n, dtype=np.float32)
+
+
+@ray_tpu.remote
+def nested(n):
+    refs = [add.remote(i, i) for i in range(n)]
+    return sum(ray_tpu.get(refs))
+
+
+@ray_tpu.remote(num_returns=2)
+def two():
+    return 1, 2
+
+
+def test_simple_task(rt):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(rt):
+    refs = [add.remote(i, 1) for i in range(20)]
+    assert ray_tpu.get(refs) == [i + 1 for i in range(20)]
+
+
+def test_task_chaining_by_ref(rt):
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)   # ObjectRef as arg -> resolved by worker
+    assert ray_tpu.get(r2) == 13
+
+
+def test_large_array_roundtrip(rt):
+    arr = ray_tpu.get(big_array.remote(500_000))
+    assert arr.shape == (500_000,)
+    assert arr[123] == 123.0
+
+
+def test_put_get(rt):
+    x = np.random.randn(1000, 100).astype(np.float32)
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_put_ref_as_task_arg(rt):
+    ref = ray_tpu.put(40)
+    assert ray_tpu.get(add.remote(ref, 2)) == 42
+
+
+def test_error_propagation(rt):
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(fail.remote())
+    assert "boom" in str(ei.value)
+
+
+def test_error_in_dependency_fails_downstream(rt):
+    bad = fail.remote()
+    downstream = add.remote(bad, 1)
+    with pytest.raises(Exception):
+        ray_tpu.get(downstream)
+
+
+def test_nested_tasks(rt):
+    # Worker submits sub-tasks and blocks on them -> resource release path.
+    assert ray_tpu.get(nested.remote(4)) == sum(2 * i for i in range(4))
+
+
+def test_num_returns(rt):
+    r1, r2 = two.remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.01)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=3.0)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.2)
+
+
+def test_options_override(rt):
+    f = add.options(num_cpus=0.5)
+    assert ray_tpu.get(f.remote(2, 3)) == 5
+
+
+def test_cluster_resources(rt):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] >= 1
