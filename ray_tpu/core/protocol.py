@@ -14,10 +14,18 @@ import pickle
 import socket
 import struct
 import threading
+import traceback
 from typing import Any, Optional
+
+import cloudpickle
 
 _HDR = struct.Struct("<I")
 MAX_MSG = 1 << 30
+
+# Marker message returned when a frame arrives intact but fails to
+# deserialize (e.g. a by-reference pickle whose module only exists on the
+# sender). Receivers log and continue instead of killing the read loop.
+RECV_ERROR = "__recv_error__"
 
 
 class ConnectionClosed(Exception):
@@ -37,7 +45,10 @@ class Connection:
             pass  # unix sockets
 
     def send(self, msg: Any) -> None:
-        data = pickle.dumps(msg, protocol=5)
+        # cloudpickle, not pickle: messages carry user callables (actor task
+        # args, data-stage fns) that plain pickle serializes by reference —
+        # unpicklable in a worker that can't import the sender's __main__.
+        data = cloudpickle.dumps(msg, protocol=5)
         with self._send_lock:
             try:
                 self.sock.sendall(_HDR.pack(len(data)) + data)
@@ -65,7 +76,10 @@ class Connection:
             if length > MAX_MSG:
                 raise ConnectionClosed(f"oversized frame: {length}")
             data = self._recv_exact(length)
-        return pickle.loads(data)
+        try:
+            return pickle.loads(data)
+        except BaseException:  # noqa: BLE001 — framing is intact; keep going
+            return (RECV_ERROR, traceback.format_exc())
 
     def close(self) -> None:
         try:

@@ -61,9 +61,10 @@ def runtime_initialized() -> bool:
 class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
                  "actor_id", "held_resources", "blocked", "started_at",
-                 "purpose")
+                 "purpose", "tpu_capable")
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen, purpose=None):
+    def __init__(self, worker_id: str, proc: subprocess.Popen, purpose=None,
+                 tpu_capable: bool = False):
         self.worker_id = worker_id
         self.proc = proc
         self.conn: Optional[Connection] = None
@@ -75,6 +76,7 @@ class WorkerState:
         self.blocked = False
         self.started_at = time.time()
         self.purpose = purpose         # None (general) | actor_id
+        self.tpu_capable = tpu_capable
 
 
 class Waiter:
@@ -258,8 +260,14 @@ class DriverRuntime:
             self._remove_pg(item[1])
 
     def _handle_worker_msg(self, wid: str, m):
+        from .protocol import RECV_ERROR  # noqa: PLC0415
         w = self.workers.get(wid)
         mtype = m[0]
+        if mtype == RECV_ERROR:
+            sys.stderr.write(
+                f"[ray_tpu driver] dropped undeserializable message from "
+                f"{wid}:\n{m[1]}")
+            return
         if mtype == "task_done":
             self._on_task_done(wid, m[1], m[2], m[3])
         elif mtype == "actor_created":
@@ -445,8 +453,8 @@ class DriverRuntime:
                 still.append(acspec)
                 continue
             res_mod.acquire(self.avail, acspec.resources)
-            wid = self._spawn_worker(purpose=acspec.actor_id)
             self._actor_create_specs[acspec.actor_id] = acspec
+            wid = self._spawn_worker(purpose=acspec.actor_id)
             w = self.workers[wid]
             w.held_resources = dict(acspec.resources)
             w.actor_id = acspec.actor_id
@@ -474,10 +482,12 @@ class DriverRuntime:
             if not res_mod.fits(self.avail, need):
                 still.append(spec)
                 continue
-            w = self._find_idle_worker()
+            task_needs_tpu = spec.resources.get("TPU", 0) > 0
+            w = self._find_idle_worker(needs_tpu=task_needs_tpu)
             if w is None:
                 if self._can_spawn():
-                    self._spawn_worker(purpose=None)
+                    self._spawn_worker(purpose=None,
+                                       tpu_capable=task_needs_tpu)
                 still.append(spec)
                 continue
             try:
@@ -539,9 +549,10 @@ class DriverRuntime:
                                                          w.worker_id,
                                                          time.time())
 
-    def _find_idle_worker(self) -> Optional[WorkerState]:
+    def _find_idle_worker(self, needs_tpu: bool = False) -> Optional[WorkerState]:
         for w in self.workers.values():
-            if w.state == "idle" and w.conn is not None:
+            if (w.state == "idle" and w.conn is not None
+                    and w.tpu_capable == needs_tpu):
                 return w
         return None
 
@@ -551,7 +562,7 @@ class DriverRuntime:
         return live == 0 or len([w for w in self.workers.values()
                                  if w.state != "dead"]) < self.max_workers
 
-    def _spawn_worker(self, purpose) -> str:
+    def _spawn_worker(self, purpose, tpu_capable: bool = False) -> str:
         self._wid_counter += 1
         wid = f"w{self._wid_counter:04d}"
         env = dict(os.environ)
@@ -559,15 +570,31 @@ class DriverRuntime:
         env.setdefault("PYTHONPATH", "")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
-        # Workers default to CPU JAX unless told otherwise: the real TPU chip
-        # belongs to the driver-side SPMD step (single-controller model).
-        env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
+        # Propagate the driver's full sys.path so by-reference pickles of
+        # driver-side modules (test files, user scripts next to the driver)
+        # resolve in workers — the single-host analogue of the reference's
+        # runtime_env working_dir shipping (python/ray/runtime_env).
+        driver_paths = [p for p in sys.path
+                        if p and os.path.isdir(p) and p != repo_root]
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root, *driver_paths,
+             *[p for p in env["PYTHONPATH"].split(os.pathsep) if p]])
+        # Workers run CPU JAX unless the actor explicitly holds TPU
+        # resources: the chip belongs to the driver-side SPMD step
+        # (single-controller model), and letting every worker claim the
+        # backend would deadlock the TPU tunnel.
+        acspec = self._actor_create_specs.get(purpose) if purpose else None
+        if acspec is not None and acspec.resources.get("TPU", 0) > 0:
+            tpu_capable = True
+        if not tpu_capable:
+            from ..util.jaxenv import subprocess_env_cpu  # noqa: PLC0415
+            subprocess_env_cpu(env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker",
              self.socket_path, wid],
             env=env, cwd=os.getcwd())
-        self.workers[wid] = WorkerState(wid, proc, purpose=purpose)
+        self.workers[wid] = WorkerState(wid, proc, purpose=purpose,
+                                        tpu_capable=tpu_capable)
         return wid
 
     def _worker_for_actor(self, aid: str) -> Optional[WorkerState]:

@@ -199,6 +199,7 @@ class WorkerLoop:
             pass
 
     def _read_loop(self) -> None:
+        from .protocol import RECV_ERROR  # noqa: PLC0415
         while True:
             try:
                 msg = self.conn.recv()
@@ -206,6 +207,11 @@ class WorkerLoop:
                 self._shutdown.set()
                 os._exit(0)
             mtype = msg[0]
+            if mtype == RECV_ERROR:
+                sys.stderr.write(
+                    f"[ray_tpu worker {self.worker_id}] dropped "
+                    f"undeserializable message:\n{msg[1]}")
+                continue
             if mtype == "exec_task":
                 self._task_q.put(("task", msg[1]))
             elif mtype == "create_actor":

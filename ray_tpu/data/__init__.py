@@ -1,0 +1,18 @@
+"""ray_tpu.data — scalable datasets for ML (reference: python/ray/data).
+
+Numpy-columnar blocks, lazy fused plans, a streaming executor over the
+core runtime, and a device loader that prefetches batches into TPU HBM.
+"""
+from .block import Block
+from .dataset import (Dataset, from_items, from_blocks, from_numpy, range_,
+                      read_text, read_jsonl, read_csv, read_npy, AggregateFn)
+from .device_loader import device_put_iterator
+from . import preprocessors
+
+# ray.data.range parity name
+range = range_  # noqa: A001
+
+__all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
+           "range", "range_", "read_text", "read_jsonl", "read_csv",
+           "read_npy", "AggregateFn", "device_put_iterator",
+           "preprocessors"]

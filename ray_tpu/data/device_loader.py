@@ -1,0 +1,61 @@
+"""Host -> HBM double-buffered batch loader.
+
+Reference parity: ray.train.torch.prepare_data_loader's device-mover +
+iter_torch_batches prefetching. TPU version: a background thread stages the
+NEXT batch's jax.device_put (optionally with a NamedSharding spanning the
+mesh) while the current step runs, so HBM fill rides behind compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+def device_put_iterator(host_batches: Iterator[Dict[str, np.ndarray]],
+                        *, sharding=None, prefetch: int = 2,
+                        dtypes: Optional[Dict[str, Any]] = None):
+    import jax
+    import jax.numpy as jnp
+
+    def convert(batch):
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if dtypes and k in dtypes:
+                arr = arr.astype(dtypes[k])
+            elif arr.dtype == np.int64:
+                arr = arr.astype(np.int32)
+            elif arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            out[k] = (jax.device_put(arr, sharding)
+                      if sharding is not None else jnp.asarray(arr))
+        return out
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+    err: list = []
+
+    def producer():
+        try:
+            for batch in host_batches:
+                q.put(convert(batch))
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True,
+                         name="rtpu-device-loader")
+    t.start()
+
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if err:
+                raise err[0]
+            return
+        yield item

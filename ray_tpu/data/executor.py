@@ -1,0 +1,161 @@
+"""Streaming executor for Dataset plans.
+
+Reference parity: python/ray/data/_internal/execution/streaming_executor.py
+— per-block tasks flow through the stage chain with bounded in-flight
+parallelism (backpressure), stateful stages run on an actor pool, shuffle
+stages act as barriers. Runs over the ray_tpu core runtime when
+initialized; otherwise executes inline (local mode), which is also the
+fast path for small datasets.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+from .block import Block
+from .plan import Stage, fuse_stages
+
+MAX_IN_FLIGHT = 8
+
+
+class DatasetStats:
+    def __init__(self):
+        self.stage_wall: Dict[str, float] = {}
+        self.stage_blocks: Dict[str, int] = {}
+
+    def record(self, name: str, dt: float, nblocks: int = 1):
+        self.stage_wall[name] = self.stage_wall.get(name, 0.0) + dt
+        self.stage_blocks[name] = self.stage_blocks.get(name, 0) + nblocks
+
+    def summary(self) -> str:
+        lines = ["Dataset execution stats:"]
+        for name, wall in self.stage_wall.items():
+            lines.append(f"  {name}: {wall*1000:.1f} ms over "
+                         f"{self.stage_blocks.get(name, 0)} blocks")
+        return "\n".join(lines)
+
+
+def _runtime():
+    from ..core import runtime as runtime_mod
+    if runtime_mod.runtime_initialized():
+        return runtime_mod.get_runtime()
+    return None
+
+
+def _apply_map(fn: Callable[[Block], Block], block: Block) -> Block:
+    return fn(block)
+
+
+class _StatefulMapActor:
+    """Actor wrapper for map_batches(compute="actors") with a class fn."""
+
+    def __init__(self, ctor_bytes):
+        import cloudpickle
+        ctor = cloudpickle.loads(ctor_bytes)
+        self.fn = ctor()
+
+    def apply(self, block: Block) -> Block:
+        return self.fn(block)
+
+
+def execute_plan(source_blocks: Iterator[Block], stages: Sequence[Stage],
+                 stats: Optional[DatasetStats] = None,
+                 parallelism: int = MAX_IN_FLIGHT) -> Iterator[Block]:
+    """Stream blocks through the fused stage chain."""
+    stats = stats or DatasetStats()
+    stages = fuse_stages(list(stages))
+    stream: Iterator[Block] = source_blocks
+    for stage in stages:
+        stream = _apply_stage(stream, stage, stats, parallelism)
+    return stream
+
+
+def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
+                 parallelism: int) -> Iterator[Block]:
+    if stage.kind == "map_block":
+        if stage.compute == "actors" and stage.fn_constructor is not None:
+            return _actor_pool_map(stream, stage, stats, parallelism)
+        return _task_map(stream, stage, stats, parallelism)
+    if stage.kind == "shuffle":
+        def shuffled() -> Iterator[Block]:
+            t0 = time.time()
+            blocks = list(stream)
+            out = stage.shuffle_fn(blocks)
+            stats.record(stage.name, time.time() - t0, len(out))
+            yield from out
+        return shuffled()
+    raise ValueError(f"unknown stage kind {stage.kind}")
+
+
+def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
+              parallelism: int) -> Iterator[Block]:
+    rt = _runtime()
+    if rt is None:
+        def local() -> Iterator[Block]:
+            for block in stream:
+                t0 = time.time()
+                out = stage.fn(block)
+                stats.record(stage.name, time.time() - t0)
+                yield out
+        return local()
+
+    from .. import api
+
+    remote_fn = api.remote(num_cpus=1)(_apply_map)
+
+    def distributed() -> Iterator[Block]:
+        import collections
+        t_start = time.time()
+        window: "collections.deque" = collections.deque()
+        fn_ref = api.put(stage.fn)  # ship the (possibly fused) fn once
+        for block in stream:
+            window.append(remote_fn.remote(fn_ref, block))
+            while len(window) >= parallelism:
+                yield api.get(window.popleft())
+        while window:
+            yield api.get(window.popleft())
+        stats.record(stage.name, time.time() - t_start)
+    return distributed()
+
+
+def _actor_pool_map(stream: Iterator[Block], stage: Stage,
+                    stats: DatasetStats, parallelism: int) -> Iterator[Block]:
+    rt = _runtime()
+    import cloudpickle
+    ctor_bytes = cloudpickle.dumps(stage.fn_constructor)
+    if rt is None:
+        fn = stage.fn_constructor()
+
+        def local() -> Iterator[Block]:
+            for block in stream:
+                t0 = time.time()
+                out = fn(block)
+                stats.record(stage.name, time.time() - t0)
+                yield out
+        return local()
+
+    from .. import api
+    pool_size = min(2, parallelism)
+    actor_cls = api.remote(num_cpus=1)(_StatefulMapActor)
+    actors = [actor_cls.remote(ctor_bytes) for _ in range(pool_size)]
+
+    def distributed() -> Iterator[Block]:
+        import collections
+        t_start = time.time()
+        window: "collections.deque" = collections.deque()
+        i = 0
+        for block in stream:
+            actor = actors[i % pool_size]
+            i += 1
+            window.append(actor.apply.remote(block))
+            while len(window) >= parallelism:
+                yield api.get(window.popleft())
+        while window:
+            yield api.get(window.popleft())
+        stats.record(stage.name, time.time() - t_start)
+        for a in actors:
+            try:
+                api.kill(a)
+            except Exception:
+                pass
+    return distributed()

@@ -1,0 +1,31 @@
+"""Model zoo (SURVEY.md §2.2 P10).
+
+Registry mirrors what reference users reach for through HF/torch in Ray
+Train/Serve/RLlib examples, re-implemented TPU-first.
+"""
+from .llama import Llama, LlamaConfig
+from .gpt2 import GPT2, GPT2Config
+
+_REGISTRY = {
+    "llama3-8b": lambda **kw: Llama(LlamaConfig.llama3_8b(**kw)),
+    "llama3-1b": lambda **kw: Llama(LlamaConfig.llama3_1b(**kw)),
+    "llama-debug": lambda **kw: Llama(LlamaConfig.debug(**kw)),
+    "gpt2": lambda **kw: GPT2(GPT2Config.small(**kw)),
+    "gpt2-medium": lambda **kw: GPT2(GPT2Config.medium(**kw)),
+    "gpt2-large": lambda **kw: GPT2(GPT2Config.large(**kw)),
+    "gpt2-debug": lambda **kw: GPT2(GPT2Config.debug(**kw)),
+}
+
+
+def get_model(name: str, **kw):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kw)
+
+
+def register_model(name: str, builder) -> None:
+    _REGISTRY[name] = builder
+
+
+__all__ = ["Llama", "LlamaConfig", "GPT2", "GPT2Config", "get_model",
+           "register_model"]

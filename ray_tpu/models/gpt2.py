@@ -1,0 +1,113 @@
+"""GPT-2 family (124M "small" is the BASELINE.json reference config).
+
+From-scratch flax implementation: learned positional embeddings, pre-LN
+blocks, GELU MLP, tied LM head. HF weight import lives in
+ray_tpu/train/adapters.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops import layer_norm, multi_head_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def d_ff(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def small(**kw) -> "GPT2Config":      # 124M
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def medium(**kw) -> "GPT2Config":     # 350M
+        return GPT2Config(d_model=1024, n_layers=24, n_heads=16, **kw)
+
+    @staticmethod
+    def large(**kw) -> "GPT2Config":      # 774M
+        return GPT2Config(d_model=1280, n_layers=36, n_heads=20, **kw)
+
+    @staticmethod
+    def debug(**kw) -> "GPT2Config":
+        return GPT2Config(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                          max_seq_len=64, **kw)
+
+
+class GPT2Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln1_w = self.param("ln_1_scale", nn.initializers.ones, (cfg.d_model,))
+        ln1_b = self.param("ln_1_bias", nn.initializers.zeros, (cfg.d_model,))
+        ln2_w = self.param("ln_2_scale", nn.initializers.ones, (cfg.d_model,))
+        ln2_b = self.param("ln_2_bias", nn.initializers.zeros, (cfg.d_model,))
+
+        h = layer_norm(x, ln1_w, ln1_b, cfg.norm_eps)
+        qkv = nn.Dense(3 * cfg.d_model, name="qkv", dtype=cfg.dtype)(h)
+        b, s, _ = x.shape
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        att = multi_head_attention(q, k, v, causal=True)
+        att = att.reshape(b, s, cfg.d_model)
+        x = x + nn.Dense(cfg.d_model, name="attn_out", dtype=cfg.dtype)(att)
+
+        h = layer_norm(x, ln2_w, ln2_b, cfg.norm_eps)
+        h = nn.Dense(cfg.d_ff, name="fc_in", dtype=cfg.dtype)(h)
+        h = jax.nn.gelu(h)
+        x = x + nn.Dense(cfg.d_model, name="fc_out", dtype=cfg.dtype)(h)
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, name="wte",
+                       dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.02))
+        wpe = nn.Embed(cfg.max_seq_len, cfg.d_model, name="wpe",
+                       dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.01))
+        b, s = tokens.shape
+        x = wte(tokens) + wpe(jnp.arange(s)[None, :])
+        block_cls = nn.remat(GPT2Block) if cfg.remat else GPT2Block
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x)
+        lnf_w = self.param("ln_f_scale", nn.initializers.ones, (cfg.d_model,))
+        lnf_b = self.param("ln_f_bias", nn.initializers.zeros, (cfg.d_model,))
+        x = layer_norm(x, lnf_w, lnf_b, cfg.norm_eps)
+        # Tied head with true fp32 logits: Embed.attend would demote to the
+        # module dtype (bf16), so contract against the table explicitly.
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                            wte.embedding.astype(jnp.float32))
+        return logits
+
+    def init_params(self, rng, batch=1, seq=8):
+        tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]

@@ -1,0 +1,223 @@
+"""Llama-3-style decoder-only transformer, TPU-first.
+
+Built from scratch on flax.linen + ray_tpu.ops (not a port of any torch
+implementation; the reference trains Llama via HF torch models inside
+TorchTrainer — e.g. python/ray/train/examples and doc/source/train llm
+examples). Design notes:
+  * GQA attention, RoPE, RMSNorm, SwiGLU — all bf16 compute, fp32 norms.
+  * Pure-functional KV cache (pytree in/out) so the serve engine can jit
+    prefill/decode separately with static shapes.
+  * Optional `remat` applies jax.checkpoint per block (HBM <-> FLOPs trade).
+  * Module names line up with ray_tpu.parallel.sharding DEFAULT_RULES, so
+    tp/fsdp PartitionSpecs attach without model surgery.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops import (rms_norm, apply_rotary, rope_frequencies,
+                   multi_head_attention, swiglu)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 5632
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    remat: bool = False
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"          # "xla" | "pallas"
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model={self.d_model} must be divisible by "
+                f"n_heads={self.n_heads}")
+        if (self.d_model // self.n_heads) % 2:
+            raise ValueError(
+                f"head_dim={self.d_model // self.n_heads} must be even "
+                f"(RoPE rotates dimension pairs)")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads={self.n_heads} must be divisible by "
+                f"n_kv_heads={self.n_kv_heads} (GQA groups)")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # ---- presets (sizes follow the public Llama-3 family) ----
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           max_seq_len=8192, remat=True, **kw)
+
+    @staticmethod
+    def llama3_1b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, d_model=2048, n_layers=16,
+                           n_heads=32, n_kv_heads=8, d_ff=8192,
+                           max_seq_len=8192, **kw)
+
+    @staticmethod
+    def debug(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=128,
+                           max_seq_len=128, **kw)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, cache=None, positions=None):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        q = nn.Dense(cfg.n_heads * hd, use_bias=False, name="q_proj",
+                     dtype=cfg.dtype)(x)
+        k = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="k_proj",
+                     dtype=cfg.dtype)(x)
+        v = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="v_proj",
+                     dtype=cfg.dtype)(x)
+        b, s, _ = x.shape
+        q = q.reshape(b, s, cfg.n_heads, hd)
+        k = k.reshape(b, s, cfg.n_kv_heads, hd)
+        v = v.reshape(b, s, cfg.n_kv_heads, hd)
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+
+        new_cache = None
+        if cache is None:
+            out = multi_head_attention(q, k, v, causal=True,
+                                       impl=cfg.attn_impl)
+        else:
+            # Decode: write new k/v at `positions`, attend over prefix.
+            ck, cv, lengths = cache  # (B, L, Hkv, D) x2, (B,)
+            idx = jnp.arange(b)
+            ck = ck.at[idx[:, None], positions].set(k.astype(ck.dtype))
+            cv = cv.at[idx[:, None], positions].set(v.astype(cv.dtype))
+            new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+            # mask out slots beyond each row's length
+            L = ck.shape[1]
+            valid = jnp.arange(L)[None, :] < new_lengths[:, None]
+            logits_mask = jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kk = jnp.repeat(ck, rep, axis=2)
+            vv = jnp.repeat(cv, rep, axis=2)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                             preferred_element_type=jnp.float32) * hd ** -0.5
+            att = att + logits_mask[:, None, None, :]
+            # causal within the written span
+            pos_k = jnp.arange(L)[None, None, None, :]
+            pos_q = positions[:, None, :, None]
+            att = jnp.where(pos_k <= pos_q, att, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+            new_cache = (ck, cv, new_lengths)
+
+        out = out.reshape(b, s, cfg.n_heads * hd)
+        out = nn.Dense(cfg.d_model, use_bias=False, name="o_proj",
+                       dtype=cfg.dtype)(out)
+        return out, new_cache
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.d_ff, use_bias=False, name="gate_proj",
+                        dtype=cfg.dtype)(x)
+        up = nn.Dense(cfg.d_ff, use_bias=False, name="up_proj",
+                      dtype=cfg.dtype)(x)
+        return nn.Dense(cfg.d_model, use_bias=False, name="down_proj",
+                        dtype=cfg.dtype)(swiglu(gate, up))
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, cache=None, positions=None):
+        cfg = self.cfg
+        attn_norm_w = self.param("attn_norm", nn.initializers.ones,
+                                 (cfg.d_model,))
+        mlp_norm_w = self.param("mlp_norm", nn.initializers.ones,
+                                (cfg.d_model,))
+        h, new_cache = LlamaAttention(cfg, name="attention")(
+            rms_norm(x, attn_norm_w, cfg.norm_eps), cos, sin, cache,
+            positions)
+        x = x + h
+        x = x + LlamaMLP(cfg, name="mlp")(
+            rms_norm(x, mlp_norm_w, cfg.norm_eps))
+        return x, new_cache
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, cache=None, positions=None):
+        """tokens: (B, S) int32. cache: optional list of per-layer
+        (k, v, lengths). Returns (logits, new_cache)."""
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
+                         dtype=cfg.dtype,
+                         embedding_init=nn.initializers.normal(0.02))
+        x = embed(tokens)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        new_cache = []
+        # remat trades recompute for HBM on the train path only; the decode
+        # path (cache is not None) never checkpoints. Param paths stay
+        # "layer_{i}/..." under both classes, so one weight pytree serves
+        # train and serve.
+        block_cls = (nn.remat(LlamaBlock)
+                     if (cfg.remat and cache is None) else LlamaBlock)
+        for i in range(cfg.n_layers):
+            block = block_cls(cfg, name=f"layer_{i}")
+            x, c = block(x, cos, sin,
+                         None if cache is None else cache[i], positions)
+            new_cache.append(c)
+        final_w = self.param("final_norm", nn.initializers.ones,
+                             (cfg.d_model,))
+        x = rms_norm(x, final_w, cfg.norm_eps)
+        if cfg.tie_embeddings:
+            # Embed.attend would demote to bf16; contract in fp32 explicitly.
+            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                                embed.embedding.astype(jnp.float32))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              name="lm_head", dtype=jnp.float32)(
+                                  x.astype(jnp.float32))
+        return logits, (new_cache if cache is not None else None)
+
+    # ---- convenience ----
+    def init_params(self, rng, batch=1, seq=8):
+        tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+    def empty_cache(self, batch: int, max_len: int,
+                    dtype=jnp.bfloat16):
+        cfg = self.cfg
+        return [
+            (jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       dtype=dtype),
+             jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       dtype=dtype),
+             jnp.zeros((batch,), dtype=jnp.int32))
+            for _ in range(cfg.n_layers)
+        ]

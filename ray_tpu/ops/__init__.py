@@ -1,0 +1,15 @@
+"""TPU-native compute ops: the building blocks the reference gets from
+torch/CUDA kernels (apex, flash-attn), re-built on XLA + Pallas.
+
+XLA fuses elementwise chains into matmuls on its own; Pallas kernels are
+reserved for the patterns XLA won't fuse (flash attention inner loop).
+Every op here is jit-traceable with static shapes.
+"""
+from .norms import rms_norm, layer_norm
+from .rotary import apply_rotary, rope_frequencies
+from .attention import multi_head_attention, causal_attention_mask
+from .activations import swiglu, geglu
+
+__all__ = ["rms_norm", "layer_norm", "apply_rotary", "rope_frequencies",
+           "multi_head_attention", "causal_attention_mask", "swiglu",
+           "geglu"]

@@ -1,0 +1,61 @@
+"""Attention on TPU.
+
+Default path: `jax.nn.dot_product_attention`, which XLA lowers to an MXU-
+friendly fused kernel (and to TPU flash attention where supported). A Pallas
+flash-attention kernel (ray_tpu/ops/pallas/flash_attention.py) can be
+selected with impl="pallas" for long sequences.
+
+Replaces the reference's torch scaled_dot_product_attention / flash-attn
+dependency in its model code (e.g. rllib models and train examples).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention_mask(seq_len: int, dtype=jnp.bool_) -> jax.Array:
+    return jnp.tril(jnp.ones((seq_len, seq_len), dtype=dtype))
+
+
+def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         *, causal: bool = True,
+                         segment_ids: Optional[jax.Array] = None,
+                         impl: str = "xla",
+                         scale: Optional[float] = None) -> jax.Array:
+    """q: (B, Sq, Hq, D); k/v: (B, Sk, Hkv, D) with Hq % Hkv == 0 (GQA).
+
+    Returns (B, Sq, Hq, D).
+    """
+    if impl == "pallas":
+        from .pallas.flash_attention import flash_attention  # noqa: PLC0415
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    if hq != hkv:
+        # grouped-query: repeat kv heads
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = None
+    if causal:
+        mask = causal_attention_mask(sq)[None, None, :, :]
+        if sk != sq:  # decode with KV cache: offset the causal structure
+            mask = jnp.tril(jnp.ones((sq, sk), dtype=jnp.bool_),
+                            k=sk - sq)[None, None, :, :]
+    if segment_ids is not None:
+        seg_mask = (segment_ids[:, None, :, None]
+                    == segment_ids[:, None, None, :])
+        mask = seg_mask if mask is None else jnp.logical_and(mask, seg_mask)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — the hand-written hot ops (SURVEY.md §2.2 P9)."""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,40 @@
+"""Rotary position embeddings (RoPE), Llama-3 style with NTK scaling hook."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 500000.0,
+                     dtype=jnp.float32):
+    """Precompute cos/sin tables: shape (max_len, head_dim//2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 positions: jax.Array | None = None) -> jax.Array:
+    """Rotate pairs (x0,x1) -> (x0 cos - x1 sin, x0 sin + x1 cos).
+
+    x: (..., seq, heads, head_dim). cos/sin: (max_len, head_dim//2).
+    positions: optional (..., seq) int array for non-contiguous positions
+    (decode steps, packed sequences).
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]
+        s = sin[:seq]
+        # broadcast over leading batch dims and heads
+        c = c[None, :, None, :] if x.ndim == 4 else c[:, None, :]
+        s = s[None, :, None, :] if x.ndim == 4 else s[:, None, :]
+    else:
+        c = jnp.take(cos, positions, axis=0)[..., :, None, :]
+        s = jnp.take(sin, positions, axis=0)[..., :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cdt = c.astype(x.dtype)
+    sdt = s.astype(x.dtype)
+    return jnp.concatenate([x1 * cdt - x2 * sdt,
+                            x1 * sdt + x2 * cdt], axis=-1)

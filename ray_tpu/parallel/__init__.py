@@ -1,0 +1,15 @@
+"""Parallelism over TPU meshes.
+
+This package replaces the reference's NCCL/Gloo process-group layer
+(python/ray/train/torch/config.py, rllib's NCCL learner groups) with
+`jax.sharding.Mesh` + NamedSharding: the user picks axis sizes, every
+weight/activation gets a PartitionSpec, and XLA inserts the ICI collectives.
+"""
+from .mesh import MeshSpec, build_mesh, local_mesh_spec
+from .sharding import (ShardingRules, DEFAULT_RULES, partition_spec_for,
+                       shard_pytree, batch_sharding)
+from .precision import Precision
+
+__all__ = ["MeshSpec", "build_mesh", "local_mesh_spec", "ShardingRules",
+           "DEFAULT_RULES", "partition_spec_for", "shard_pytree",
+           "batch_sharding", "Precision"]

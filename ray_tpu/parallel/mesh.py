@@ -1,0 +1,77 @@
+"""Device mesh construction.
+
+Axes (any may be 1):
+  dp    — pure data parallel (params replicated)
+  fsdp  — data parallel with parameter/optimizer sharding (ZeRO-3)
+  tp    — tensor parallel (heads / hidden sharded)
+  sp    — sequence/context parallel (ring attention over this axis)
+  ep    — expert parallel (MoE experts sharded)
+  pp    — pipeline parallel (layer stages)
+
+Reference counterpart: ScalingConfig(num_workers, use_gpu) +
+torch DDP/FSDP wiring. Here the "scale" is the mesh shape, and the ICI
+topology determines which axes should map to which physical dims — tp/sp
+innermost (highest-bandwidth neighbors), dp/fsdp outermost.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> dict:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def nontrivial_axes(self) -> Sequence[str]:
+        return [a for a in AXIS_ORDER if getattr(self, a) > 1]
+
+    def validate(self, n_devices: int) -> None:
+        if self.size != n_devices:
+            raise ValueError(
+                f"MeshSpec {self.axis_sizes()} needs {self.size} devices, "
+                f"got {n_devices}")
+
+
+def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
+    """Arrange devices so the fastest-varying (innermost) mesh dims hold the
+    most communication-hungry axes (tp, then sp) — on a real slice those land
+    on nearest ICI neighbors; on CPU meshes order is irrelevant but harmless.
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec.validate(len(devices))
+    shape = tuple(getattr(spec, a) for a in AXIS_ORDER)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def local_mesh_spec(tp: Optional[int] = None) -> MeshSpec:
+    """A sensible single-host default: tensor-parallel over local chips."""
+    n = len(jax.devices())
+    return MeshSpec(tp=tp or n)
+
+
+def fsdp_mesh_spec(n_devices: Optional[int] = None) -> MeshSpec:
+    n = n_devices or len(jax.devices())
+    return MeshSpec(fsdp=n)

@@ -1,0 +1,30 @@
+"""Mixed-precision policy: bf16 compute, fp32 master weights & optimizer.
+
+Reference counterpart: torch AMP / `train.torch.prepare_model(...,
+parallel_strategy_kwargs={"mixed_precision": ...})`. On TPU, bf16 is the
+MXU-native input type; fp32 accumulation happens inside the MXU, so the only
+policy decisions are storage dtypes.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    param_dtype: jnp.dtype = jnp.float32     # master copy
+    compute_dtype: jnp.dtype = jnp.bfloat16  # matmul inputs
+    output_dtype: jnp.dtype = jnp.float32    # logits / loss
+
+    def cast_for_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, tree)
+
+
+BF16 = Precision()
+FP32 = Precision(compute_dtype=jnp.float32)

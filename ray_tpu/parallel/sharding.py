@@ -1,0 +1,153 @@
+"""Parameter & activation sharding rules.
+
+The reference shards with torch FSDP wrappers + megatron-style module
+surgery; here sharding is declarative: a table of (param-path regex ->
+PartitionSpec template) applied over the pytree. XLA then emits
+all-gather/reduce-scatter over `fsdp`, all-reduce over `dp`, and the
+megatron collectives over `tp` automatically.
+
+Conventions for decoder transformers (ray_tpu/models/*):
+  embed      (vocab, d)        -> P("tp", "fsdp")     vocab-sharded matmul
+  attn qkv   (d, heads*hd)     -> P("fsdp", "tp")     column parallel
+  attn out   (heads*hd, d)     -> P("tp", "fsdp")     row parallel
+  mlp gate/up(d, ff)           -> P("fsdp", "tp")     column parallel
+  mlp down   (ff, d)           -> P("tp", "fsdp")     row parallel
+  norms      (d,)              -> P(None)             replicated
+Activations: batch over ("dp","fsdp"), sequence over "sp", model dim
+unsharded (tp acts on weights; XLA keeps activations tp-sharded between the
+column/row pair without materializing the full hidden).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+Rule = Tuple[str, P]
+
+
+DEFAULT_RULES: Sequence[Rule] = (
+    (r".*(token_embed|embed_tokens|wte)\b.*embedding$", P("tp", "fsdp")),
+    # untied output head: (d_model, vocab) column-parallel over vocab
+    (r".*(lm_head|output_proj)\b.*kernel$", P("fsdp", "tp")),
+    (r".*(wq|wk|wv|qkv|q_proj|k_proj|v_proj)\b.*kernel$", P("fsdp", "tp")),
+    (r".*(wo|o_proj|out_proj|attn_out)\b.*kernel$", P("tp", "fsdp")),
+    (r".*(gate_proj|up_proj|w1|w3|fc_in)\b.*kernel$", P("fsdp", "tp")),
+    (r".*(down_proj|w2|fc_out)\b.*kernel$", P("tp", "fsdp")),
+    # MoE experts: leading expert dim over ep, then standard column/row
+    (r".*experts.*(gate|up)\b.*kernel$", P("ep", "fsdp", "tp")),
+    (r".*experts.*down\b.*kernel$", P("ep", "tp", "fsdp")),
+    (r".*router\b.*kernel$", P("fsdp", None)),
+    (r".*(pos_embed|wpe)\b.*embedding$", P(None, "fsdp")),
+    (r".*(norm|ln_f|ln_1|ln_2|layernorm).*$", P()),
+    (r".*bias$", P()),
+    (r".*scale$", P()),
+)
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    rules: Sequence[Rule] = DEFAULT_RULES
+    default: P = dataclasses.field(default_factory=P)
+
+    def spec_for(self, path: str, shape: Tuple[int, ...],
+                 mesh: Mesh) -> P:
+        spec = self._match(path)
+        return _clip_to_mesh(spec, shape, mesh)
+
+    def _match(self, path: str) -> P:
+        for pattern, spec in self.rules:
+            if re.match(pattern, path):
+                return spec
+        return self.default
+
+
+def _clip_to_mesh(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Drop axes not in the mesh / of size 1, and any axis that doesn't
+    divide the dimension — falling back to replication for that dim."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for i, entry in enumerate(spec):
+        if i >= len(shape):
+            break
+        dim = shape[i]
+        names = entry if isinstance(entry, tuple) else (
+            (entry,) if entry is not None else ())
+        kept = []
+        prod = 1
+        for name in names:
+            sz = axis_sizes.get(name, 1)
+            if sz > 1 and dim % (prod * sz) == 0:
+                kept.append(name)
+                prod *= sz
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def partition_spec_for(path: str, shape: Tuple[int, ...], mesh: Mesh,
+                       rules: Optional[ShardingRules] = None) -> P:
+    return (rules or ShardingRules()).spec_for(path, shape, mesh)
+
+
+def path_str(path) -> str:
+    """Canonical '/'-joined string for a jax key path (shared by the rule
+    table, optimizer masks, and state sharding)."""
+    return _path_str(path)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def sharding_tree(params, mesh: Mesh,
+                  rules: Optional[ShardingRules] = None):
+    """Pytree of NamedSharding matching `params` leaves."""
+    rules = rules or ShardingRules()
+
+    def leaf_sharding(path, leaf):
+        spec = rules.spec_for(_path_str(path), getattr(leaf, "shape", ()),
+                              mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def shard_pytree(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """device_put every leaf onto its NamedSharding (host -> mesh)."""
+    shardings = sharding_tree(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: Optional[str] = "sp") -> NamedSharding:
+    """Input batch (B, S, ...) sharded over data axes, seq over sp."""
+    data = tuple(a for a in ("dp", "fsdp")
+                 if dict(zip(mesh.axis_names,
+                             mesh.devices.shape)).get(a, 1) > 1)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    seq = seq_axis if seq_axis and axis_sizes.get(seq_axis, 1) > 1 else None
+    return NamedSharding(mesh, P(data if data else None, seq))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,29 @@
+"""ray_tpu.train — distributed training (reference: python/ray/train).
+
+Layer map:
+  spmd.py          the jitted SPMD step (replaces DDP/FSDP/NCCL wiring)
+  trainer.py       JaxTrainer: actor-per-host function trainer
+  spmd_trainer.py  SpmdTrainer: declarative model+mesh trainer
+  session.py       report()/get_context() worker session
+  checkpoint.py    orbax sharded checkpoints
+  config.py        ScalingConfig/RunConfig/FailureConfig/CheckpointConfig
+"""
+from .spmd import TrainState, make_train_step, next_token_loss, SpmdStep
+from .optim import make_optimizer, warmup_cosine
+from .config import (ScalingConfig, RunConfig, FailureConfig,
+                     CheckpointConfig)
+from .session import report, get_context, TrainContext
+from .checkpoint import (Checkpoint, CheckpointManager, save_pytree,
+                         restore_pytree)
+from .result import Result
+from .trainer import JaxTrainer
+from .spmd_trainer import SpmdTrainer, SpmdTrainerConfig
+
+__all__ = [
+    "TrainState", "make_train_step", "next_token_loss", "SpmdStep",
+    "make_optimizer", "warmup_cosine", "ScalingConfig", "RunConfig",
+    "FailureConfig", "CheckpointConfig", "report", "get_context",
+    "TrainContext", "Checkpoint", "CheckpointManager", "save_pytree",
+    "restore_pytree", "Result", "JaxTrainer", "SpmdTrainer",
+    "SpmdTrainerConfig",
+]

@@ -1,0 +1,105 @@
+"""Checkpointing: orbax-backed sharded state + a directory-based Checkpoint
+handle.
+
+Reference parity: python/ray/train/_checkpoint.py (Checkpoint.from_directory
+/ to_directory / as_directory) and torch state_dict saving; here the heavy
+path is orbax — each host writes its own shards of a NamedSharding'd
+TrainState, and restore re-shards onto the (possibly different) mesh.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (metrics sidecar + orbax state)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: str) -> str:
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def metadata(self) -> Dict[str, Any]:
+        meta = os.path.join(self.path, "ckpt_meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(state: Any, path: str, *, step: Optional[int] = None,
+                metadata: Optional[Dict[str, Any]] = None) -> Checkpoint:
+    """Save a (possibly sharded) pytree with orbax; blocking."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state)
+    meta = dict(metadata or {})
+    meta.update({"step": step, "saved_at": time.time()})
+    with open(os.path.join(path, "ckpt_meta.json"), "w") as f:
+        json.dump(meta, f)
+    return Checkpoint(path)
+
+
+def restore_pytree(path: str, *, target: Any = None,
+                   shardings: Any = None) -> Any:
+    """Restore a pytree; with `shardings` (pytree of NamedSharding) leaves
+    are placed directly onto the mesh (no host-side full copy)."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    if shardings is not None:
+        import jax
+        restore_args = jax.tree_util.tree_map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+        return ckptr.restore(path, item=target, restore_args=restore_args)
+    return ckptr.restore(path, item=target)
+
+
+class CheckpointManager:
+    """Rotating checkpoint directory (num_to_keep)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = 2):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+
+    def save(self, state: Any, step: int,
+             metadata: Optional[Dict[str, Any]] = None) -> Checkpoint:
+        path = os.path.join(self.root, f"checkpoint_{step:09d}")
+        ckpt = save_pytree(state, path, step=step, metadata=metadata)
+        self._prune()
+        return ckpt
+
+    def latest(self) -> Optional[Checkpoint]:
+        entries = sorted(d for d in os.listdir(self.root)
+                         if d.startswith("checkpoint_"))
+        if not entries:
+            return None
+        return Checkpoint(os.path.join(self.root, entries[-1]))
+
+    def _prune(self):
+        if self.num_to_keep is None:
+            return
+        entries = sorted(d for d in os.listdir(self.root)
+                         if d.startswith("checkpoint_"))
+        for d in entries[:-self.num_to_keep]:
+            shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)

@@ -1,0 +1,66 @@
+"""Training configuration dataclasses.
+
+Reference parity: python/ray/air/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig). TPU-first twist: ScalingConfig speaks in
+hosts and a MeshSpec instead of `num_workers` GPU processes — one worker
+per host, all chips driven by one SPMD program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How to scale the job across hosts/chips.
+
+    num_workers: worker actors (== participating hosts). On a single host
+      this is 1: the SPMD program inside it drives every local chip.
+    mesh: MeshSpec for the global device mesh (dp/fsdp/tp/sp/ep/pp).
+    use_tpu: claim the TPU in the worker (False -> CPU jax, for tests).
+    resources_per_worker: extra custom resources per worker actor.
+    """
+    num_workers: int = 1
+    mesh: Optional[MeshSpec] = None
+    use_tpu: bool = True
+    resources_per_worker: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0          # worker-group restarts before giving up
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = 2
+    checkpoint_frequency: int = 0  # steps between automatic checkpoints
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = "ray_tpu_run"
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def run_dir(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        path = os.path.join(base, self.name)
+        os.makedirs(path, exist_ok=True)
+        return path

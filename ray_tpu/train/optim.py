@@ -1,0 +1,49 @@
+"""Optimizers & schedules (optax), replacing torch.optim in the reference's
+training path (python/ray/train/examples/*, rllib optimizers)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import optax
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr_frac: float = 0.1) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr, warmup_steps=max(1, warmup_steps),
+        decay_steps=max(2, total_steps), end_value=peak_lr * end_lr_frac)
+
+
+def _decay_mask(params):
+    """No weight decay on norms/biases/embeddings (standard LLM recipe)."""
+    import jax
+    from ..parallel.sharding import path_str
+
+    def mask_leaf(path, leaf):
+        p = path_str(path).lower()
+        return not any(t in p for t in ("norm", "bias", "scale", "embed",
+                                        "wpe", "ln_"))
+    return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+
+def make_optimizer(name: str = "adamw", *, learning_rate=3e-4,
+                   weight_decay: float = 0.1, b1=0.9, b2=0.95,
+                   grad_clip: Optional[float] = 1.0,
+                   schedule: Optional[optax.Schedule] = None
+                   ) -> optax.GradientTransformation:
+    lr = schedule if schedule is not None else learning_rate
+    if name == "adamw":
+        core = optax.adamw(lr, b1=b1, b2=b2, weight_decay=weight_decay,
+                           mask=_decay_mask)
+    elif name == "adam":
+        core = optax.adam(lr, b1=b1, b2=b2)
+    elif name == "sgd":
+        core = optax.sgd(lr, momentum=0.9)
+    elif name == "lion":
+        core = optax.lion(lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if grad_clip:
+        return optax.chain(optax.clip_by_global_norm(grad_clip), core)
+    return core

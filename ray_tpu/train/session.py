@@ -1,0 +1,81 @@
+"""Per-worker training session.
+
+Reference parity: python/ray/train/_internal/session.py +
+python/ray/train/context.py — `train.report(...)`, `train.get_context()`
+with rank/world info, checkpoint handoff.
+
+Inside a train worker, `report` ships metrics (and optionally a checkpoint
+path) to the trainer supervisor over the runtime's out-of-band report
+channel; on the driver (local mode) it appends directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional
+
+_session_lock = threading.Lock()
+_session: Optional["TrainSession"] = None
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    trial_name: str = ""
+    experiment_name: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+
+class TrainSession:
+    def __init__(self, context: TrainContext, report_fn):
+        self.context = context
+        self._report_fn = report_fn
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Any] = None) -> None:
+        self.iteration += 1
+        payload = {"metrics": dict(metrics), "iteration": self.iteration,
+                   "rank": self.context.world_rank}
+        if checkpoint is not None:
+            payload["checkpoint"] = getattr(checkpoint, "path", checkpoint)
+        self._report_fn(payload)
+
+
+def init_session(context: TrainContext, report_fn) -> TrainSession:
+    global _session
+    with _session_lock:
+        _session = TrainSession(context, report_fn)
+    return _session
+
+
+def clear_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No active train session — report()/get_context() must run "
+            "inside a training function launched by a Trainer")
+    return _session
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return get_session().context

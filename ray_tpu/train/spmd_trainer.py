@@ -1,0 +1,116 @@
+"""SpmdTrainer: declarative model+mesh trainer (the TorchTrainer analogue
+for the common LLM case).
+
+Reference parity: TorchTrainer + its prepare_model/prepare_data_loader
+utilities (python/ray/train/torch/). Instead of wrapping user torch code,
+the common case is declared: model (name or module), mesh spec, optimizer,
+data iterator — the trainer owns the jitted step, logging, checkpointing,
+and restore.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from ..parallel.mesh import MeshSpec, build_mesh
+from .checkpoint import CheckpointManager, restore_pytree
+from .config import RunConfig
+from .optim import make_optimizer, warmup_cosine
+from .spmd import make_train_step
+from .result import Result
+
+
+@dataclasses.dataclass
+class SpmdTrainerConfig:
+    model: Any                          # nn.Module or registry name
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    optimizer: str = "adamw"
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    log_every: int = 10
+    checkpoint_every: int = 0
+    grad_clip: float = 1.0
+    seed: int = 0
+
+
+class SpmdTrainer:
+    def __init__(self, config: SpmdTrainerConfig,
+                 data_iter_fn: Callable[[], Iterator[Dict[str, Any]]],
+                 run_config: Optional[RunConfig] = None,
+                 report_fn: Optional[Callable[[Dict[str, Any]], None]] = None):
+        self.cfg = config
+        self.data_iter_fn = data_iter_fn
+        self.run_config = run_config or RunConfig(name="spmd_trainer")
+        self.report_fn = report_fn
+
+    def fit(self, resume_from: Optional[str] = None) -> Result:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        model = cfg.model
+        if isinstance(model, str):
+            from ..models import get_model
+            model = get_model(model)
+        devices = jax.devices()
+        spec = cfg.mesh
+        if spec.size != len(devices):
+            # single-host convenience: use however many devices exist
+            spec = MeshSpec(dp=len(devices)) if len(devices) > 1 else MeshSpec()
+        mesh = build_mesh(spec, devices=devices[:spec.size])
+
+        schedule = warmup_cosine(cfg.learning_rate, cfg.warmup_steps,
+                                 cfg.total_steps)
+        tx = make_optimizer(cfg.optimizer, schedule=schedule,
+                            grad_clip=cfg.grad_clip)
+
+        data = self.data_iter_fn()
+        first = next(data)
+        batch = {k: jnp.asarray(v) for k, v in first.items()}
+        init_fn = make_train_step(model, tx, mesh)
+        state, step_fn = init_fn(jax.random.PRNGKey(cfg.seed), batch)
+
+        manager = CheckpointManager(
+            self.run_config.run_dir() + "/checkpoints",
+            self.run_config.checkpoint_config.num_to_keep)
+        start_step = 0
+        if resume_from:
+            state = restore_pytree(resume_from, target=state,
+                                   shardings=step_fn.state_shardings)
+            start_step = int(state.step)
+
+        history = []
+        tokens_acc, t_last = 0, time.time()
+        for i in range(start_step, cfg.total_steps):
+            state, metrics = step_fn(state, batch)
+            tokens_acc += int(np.prod(batch[next(iter(batch))].shape[:2]))
+            if (i + 1) % cfg.log_every == 0 or i + 1 == cfg.total_steps:
+                now = time.time()
+                m = {k: float(v) for k, v in metrics.items()}
+                m.update(step=i + 1,
+                         tokens_per_s=tokens_acc / max(now - t_last, 1e-9))
+                tokens_acc, t_last = 0, now
+                history.append(m)
+                if self.report_fn:
+                    self.report_fn(m)
+            if cfg.checkpoint_every and (i + 1) % cfg.checkpoint_every == 0:
+                manager.save(jax.device_get(state), i + 1)
+            try:
+                nxt = next(data)
+                batch = {k: jnp.asarray(v) for k, v in nxt.items()}
+            except StopIteration:
+                data = self.data_iter_fn()
+                batch = {k: jnp.asarray(v)
+                         for k, v in next(data).items()}
+
+        final_ckpt = None
+        if cfg.checkpoint_every:
+            final_ckpt = manager.save(jax.device_get(state), cfg.total_steps)
+        return Result(metrics=history[-1] if history else {},
+                      checkpoint=final_ckpt or manager.latest(),
+                      metrics_history=history,
+                      path=self.run_config.run_dir())

@@ -1,0 +1,165 @@
+"""JaxTrainer: distributed training driver.
+
+Reference parity: python/ray/train/base_trainer.py +
+data_parallel_trainer.py + torch/torch_trainer.py. Differences by design:
+  * One worker actor per HOST (not per accelerator): inside each worker a
+    single jitted SPMD program drives all local chips; scaling across hosts
+    multiplies the mesh, not the worker count per chip.
+  * No backend_config/NCCL setup: collective wiring is XLA's job.
+
+Fault tolerance (reference FailureConfig semantics): if a worker dies and
+failure budget remains, the whole group restarts from the latest checkpoint
+(passed to the loop via session context / `get_checkpoint()`).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core import runtime as runtime_mod
+from ..exceptions import ActorDiedError, RayTpuError, WorkerCrashedError
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import RunConfig, ScalingConfig
+from .result import Result
+from .session import TrainContext, init_session, clear_session
+
+_trainer_ids = itertools.count()
+
+
+class _TrainWorker:
+    """Actor hosting one training loop (one host's SPMD program)."""
+
+    def __init__(self, ctx: TrainContext, channel: str):
+        self.ctx = ctx
+        self.channel = channel
+
+    def run(self, fn: Callable, config: Dict[str, Any],
+            resume_from: Optional[str]) -> str:
+        rt = runtime_mod.get_runtime()
+
+        def report_fn(payload):
+            rt.report(self.channel, payload)
+
+        ctx = self.ctx
+        session = init_session(ctx, report_fn)
+        session.resume_from = resume_from
+        try:
+            if resume_from is not None:
+                config = dict(config or {})
+                config.setdefault("resume_from_checkpoint", resume_from)
+            fn(config) if config is not None else fn({})
+            return "done"
+        finally:
+            clear_session()
+
+    def ping(self):
+        return "pong"
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self._fn = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig(use_tpu=False)
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self._resume = resume_from_checkpoint
+        self._tid = next(_trainer_ids)
+        self.channel = f"train:{self._tid}"
+
+    # -- internals ----------------------------------------------------------
+    def _spawn_group(self, resume_from: Optional[str]):
+        workers = []
+        refs = []
+        res = self.scaling.worker_resources()
+        for rank in range(self.scaling.num_workers):
+            ctx = TrainContext(world_size=self.scaling.num_workers,
+                               world_rank=rank, local_rank=rank,
+                               experiment_name=self.run_config.name)
+            actor_cls = api.remote(
+                num_cpus=res.get("CPU", 1),
+                num_tpus=res.get("TPU", 0),
+                resources={k: v for k, v in res.items()
+                           if k not in ("CPU", "TPU")},
+            )(_TrainWorker)
+            w = actor_cls.remote(ctx, self.channel)
+            workers.append(w)
+        for rank, w in enumerate(workers):
+            cfg = dict(self._config)
+            if self.datasets:
+                cfg["datasets"] = {
+                    k: self._shard_dataset(ds, rank)
+                    for k, ds in self.datasets.items()}
+            refs.append(w.run.remote(self._fn, cfg, resume_from))
+        return workers, refs
+
+    def _shard_dataset(self, ds, rank):
+        split = getattr(ds, "split_for_worker", None)
+        if split is not None:
+            return split(rank, self.scaling.num_workers)
+        return ds
+
+    def fit(self) -> Result:
+        if not api.is_initialized():
+            api.init()
+        rt = runtime_mod.get_runtime()
+        history: List[Dict[str, Any]] = []
+        run_dir = self.run_config.run_dir()
+        ckpt_root = os.path.join(run_dir, "checkpoints")
+        manager = CheckpointManager(
+            ckpt_root, self.run_config.checkpoint_config.num_to_keep)
+
+        def on_report(worker_id, payload):
+            history.append(payload)
+
+        rt.register_report_handler(self.channel, on_report)
+
+        failures_left = self.run_config.failure_config.max_failures
+        resume_from = self._resume.path if self._resume else None
+        error: Optional[BaseException] = None
+
+        while True:
+            workers, refs = self._spawn_group(resume_from)
+            try:
+                api.get(refs)
+                error = None
+                break
+            except (ActorDiedError, WorkerCrashedError, RayTpuError) as e:
+                error = e
+                for w in workers:
+                    try:
+                        api.kill(w)
+                    except Exception:
+                        pass
+                if failures_left > 0:
+                    failures_left -= 1
+                    latest = manager.latest()
+                    resume_from = latest.path if latest else resume_from
+                    continue
+                break
+            finally:
+                for w in workers:
+                    try:
+                        api.kill(w)
+                    except Exception:
+                        pass
+
+        final_metrics = history[-1]["metrics"] if history else {}
+        ckpt = manager.latest()
+        # Also honor checkpoints reported via session.report(path)
+        reported = [h.get("checkpoint") for h in history
+                    if h.get("checkpoint")]
+        if ckpt is None and reported:
+            ckpt = Checkpoint(reported[-1])
+        return Result(metrics=final_metrics, checkpoint=ckpt, error=error,
+                      metrics_history=[h["metrics"] for h in history
+                                       if "metrics" in h],
+                      path=run_dir)

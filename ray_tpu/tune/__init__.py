@@ -1,0 +1,14 @@
+"""ray_tpu.tune — scalable hyperparameter tuning (reference:
+python/ray/tune)."""
+from .space import (uniform, loguniform, quniform, randint, choice,
+                    grid_search, generate_variants)
+from .schedulers import (FIFOScheduler, ASHAScheduler, HyperBandScheduler,
+                         MedianStoppingRule, PopulationBasedTraining)
+from .tuner import Tuner, TuneConfig, ResultGrid, Trial
+from .session import report, get_trial_id, StopTrial
+
+__all__ = ["uniform", "loguniform", "quniform", "randint", "choice",
+           "grid_search", "generate_variants", "FIFOScheduler",
+           "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
+           "PopulationBasedTraining", "Tuner", "TuneConfig", "ResultGrid",
+           "Trial", "report", "get_trial_id", "StopTrial"]

@@ -1,0 +1,235 @@
+"""Tuner: hyperparameter search over trial actors.
+
+Reference parity: python/ray/tune/tuner.py + tune/execution/tune_controller
+(trial lifecycle, max-concurrency, scheduler integration) + tune/tune.py.
+
+Execution model: each trial is a function trainable running inside a
+dedicated actor. `tune.report(...)` inside the trial synchronously asks the
+driver-side scheduler CONTINUE/STOP (reference does this async + actor
+kill; synchronous decisions make ASHA/PBT deterministic and testable, and
+stopped trials unwind cooperatively via _StopTrial). PBT config swaps are
+delivered in the report reply.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core import runtime as runtime_mod
+from ..train.config import RunConfig
+from ..train.result import Result
+from .schedulers import (CONTINUE, STOP, FIFOScheduler, TrialScheduler,
+                         PopulationBasedTraining)
+from .space import generate_variants
+
+_tuner_ids = itertools.count()
+
+
+class TuneConfig:
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 num_samples: int = 1, max_concurrent_trials: int = 4,
+                 scheduler: Optional[TrialScheduler] = None,
+                 search_alg: Optional[Any] = None, seed: int = 0):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.search_alg = search_alg
+        self.seed = seed
+
+
+class _TrialActor:
+    """Hosts one trial's function trainable."""
+
+    def __init__(self, trial_id: str, channel: str):
+        self.trial_id = trial_id
+        self.channel = channel
+
+    def run(self, fn: Callable, config: Dict[str, Any]) -> str:
+        from ..core import runtime as rt_mod
+        from ..tune import session as tune_session
+        rt = rt_mod.get_runtime()
+
+        def sync_report(payload):
+            payload = dict(payload, trial_id=self.trial_id)
+            reply = rt.report_sync(self.channel, payload, timeout=60)
+            return reply
+
+        tune_session._init_trial(self.trial_id, sync_report)
+        try:
+            fn(config)
+            return "COMPLETED"
+        except tune_session.StopTrial:
+            return "STOPPED"
+        finally:
+            tune_session._clear_trial()
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = "PENDING"
+        self.iteration = 0
+        self.last_metrics: Dict[str, Any] = {}
+        self.best_value: Optional[float] = None
+        self.history: List[Dict[str, Any]] = []
+        self.actor = None
+        self.done_ref = None
+        self.error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: str, mode: str):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        sign = 1 if mode == "max" else -1
+        best = None
+        for t in self.trials:
+            if metric in t.last_metrics:
+                v = sign * t.last_metrics[metric]
+                if best is None or v > best[0]:
+                    best = (v, t)
+        if best is None:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        t = best[1]
+        return Result(metrics=dict(t.last_metrics, config=t.config),
+                      checkpoint=None, metrics_history=t.history)
+
+    def dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self.trials:
+            row = {"trial_id": t.trial_id, "status": t.status,
+                   "iterations": t.iteration}
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            row.update(t.last_metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i):
+        t = self.trials[i]
+        return Result(metrics=dict(t.last_metrics, config=t.config),
+                      checkpoint=None, metrics_history=t.history)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune_run")
+        self._tid = next(_tuner_ids)
+        self.channel = f"tune:{self._tid}"
+        self._lock = threading.Lock()
+        self._trials: Dict[str, Trial] = {}
+
+    def fit(self) -> ResultGrid:
+        if not api.is_initialized():
+            api.init()
+        rt = runtime_mod.get_runtime()
+        tc = self.tune_config
+        sched = tc.scheduler
+
+        variants = generate_variants(self.param_space, tc.num_samples,
+                                     tc.seed)
+        trials = [Trial(f"trial_{self._tid}_{i:04d}", cfg)
+                  for i, cfg in enumerate(variants)]
+        for t in trials:
+            self._trials[t.trial_id] = t
+            if isinstance(sched, PopulationBasedTraining):
+                sched.register(t.trial_id, t.config)
+
+        def on_report(worker_id, payload):
+            with self._lock:
+                trial = self._trials.get(payload["trial_id"])
+                if trial is None:
+                    return CONTINUE
+                trial.iteration = payload.get("iteration", trial.iteration)
+                metrics = payload.get("metrics", {})
+                trial.last_metrics = metrics
+                trial.history.append(metrics)
+                value = metrics.get(tc.metric)
+                decision = CONTINUE
+                if value is not None:
+                    decision = sched.on_result(trial.trial_id,
+                                               trial.iteration, float(value))
+                reply = {"decision": decision}
+                if isinstance(sched, PopulationBasedTraining):
+                    new_cfg = sched.take_pending_config(trial.trial_id)
+                    if new_cfg:
+                        reply["new_config"] = new_cfg
+                return reply
+
+        rt.register_report_handler(self.channel, on_report)
+
+        pending = list(trials)
+        running: List[Trial] = []
+        finished: List[Trial] = []
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                t = pending.pop(0)
+                t.status = "RUNNING"
+                actor_cls = api.remote(num_cpus=1)(_TrialActor)
+                t.actor = actor_cls.remote(t.trial_id, self.channel)
+                t.done_ref = t.actor.run.remote(self._trainable, t.config)
+                running.append(t)
+            done_refs = [t.done_ref for t in running]
+            ready, _ = api.wait(done_refs, num_returns=1, timeout=300.0)
+            still = []
+            for t in running:
+                if t.done_ref in ready:
+                    try:
+                        outcome = api.get(t.done_ref)
+                        t.status = ("TERMINATED" if outcome == "COMPLETED"
+                                    else "STOPPED")
+                    except Exception as e:  # noqa: BLE001
+                        t.status = "ERROR"
+                        t.error = repr(e)
+                    sched.on_complete(t.trial_id)
+                    try:
+                        api.kill(t.actor)
+                    except Exception:
+                        pass
+                    finished.append(t)
+                else:
+                    still.append(t)
+            running = still
+
+        self._write_experiment_state(trials)
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    def _write_experiment_state(self, trials: List[Trial]):
+        state = [{"trial_id": t.trial_id, "config": t.config,
+                  "status": t.status, "iterations": t.iteration,
+                  "last_metrics": t.last_metrics, "error": t.error}
+                 for t in trials]
+        path = os.path.join(self.run_config.run_dir(),
+                            "experiment_state.json")
+        with open(path, "w") as f:
+            json.dump(state, f, indent=1, default=str)
+
+    @staticmethod
+    def restore(path: str) -> List[Dict[str, Any]]:
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            return json.load(f)

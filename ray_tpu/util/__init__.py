@@ -1,0 +1,1 @@
+"""Utilities (reference: python/ray/util)."""

@@ -1,0 +1,37 @@
+"""Deterministic JAX platform selection under the container's TPU plugin.
+
+The image's sitecustomize.py registers a TPU PJRT plugin at interpreter
+startup and force-sets jax's `jax_platforms` config, so environment
+variables alone don't decide the platform. These helpers win regardless of
+registration state; call them before the first jax.devices()/jit.
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_virtual_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend, optionally with N virtual
+    devices (for testing multi-chip sharding without chips)."""
+    if n_virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{n_virtual_devices}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+    if xla_bridge.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+        clear_backends()
+
+
+def subprocess_env_cpu(env: dict) -> dict:
+    """Environment for a child process that must never touch the TPU:
+    blank the plugin trigger so sitecustomize skips registration (faster
+    startup, no tunnel contention)."""
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

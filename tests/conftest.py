@@ -4,12 +4,15 @@ Must set env before jax is imported anywhere (SURVEY.md §4).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_STORE_BYTES", str(1 << 30))
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The container's sitecustomize force-registers a TPU plugin and overrides
+# jax config; force_cpu wins regardless (must run before first jax use).
+from ray_tpu.util.jaxenv import force_cpu  # noqa: E402
+force_cpu(n_virtual_devices=8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,132 @@
+"""Ray-Train-parity e2e: JaxTrainer function loop, report/session,
+checkpoint save/restore, failure recovery (SURVEY.md §2.4)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (JaxTrainer, ScalingConfig, RunConfig,
+                           FailureConfig, CheckpointConfig)
+
+
+def _loop_basic(config):
+    """Runs inside a worker actor: tiny jax regression, reports each epoch."""
+    from ray_tpu.util.jaxenv import force_cpu
+    force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    assert ctx.get_world_size() == config["world"]
+    key = jax.random.PRNGKey(0)
+    w = jnp.zeros((4,))
+    x = jax.random.normal(key, (64, 4))
+    y = x @ jnp.array([1.0, -2.0, 3.0, 0.5])
+    tx = optax.sgd(0.1)
+    opt = tx.init(w)
+
+    @jax.jit
+    def step(w, opt):
+        loss, g = jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(w)
+        up, opt = tx.update(g, opt)
+        return optax.apply_updates(w, up), opt, loss
+
+    for epoch in range(config["epochs"]):
+        w, opt, loss = step(w, opt)
+        train.report({"loss": float(loss), "epoch": epoch})
+
+
+def test_jax_trainer_e2e(rt, tmp_path):
+    trainer = JaxTrainer(
+        _loop_basic,
+        train_loop_config={"epochs": 5, "world": 2},
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=False),
+        run_config=RunConfig(name="t_basic", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 10  # 2 workers x 5 epochs
+    assert result.metrics["loss"] < 10.0
+
+
+def _loop_ckpt(config):
+    import jax.numpy as jnp
+    from ray_tpu import train
+    from ray_tpu.train import save_pytree, restore_pytree
+
+    start = 0
+    state = {"w": jnp.zeros((2,)), "step": jnp.array(0)}
+    resume = config.get("resume_from_checkpoint")
+    if resume:
+        state = restore_pytree(resume, target=state)
+        start = int(state["step"])
+    for i in range(start, config["steps"]):
+        state = {"w": state["w"] + 1.0, "step": jnp.array(i + 1)}
+        path = os.path.join(config["ckpt_dir"], f"checkpoint_{i+1:09d}")
+        if (i + 1) % 2 == 0:
+            save_pytree(state, path, step=i + 1)
+        if (i + 1) == config.get("die_at", -1) and not os.path.exists(
+                config["ckpt_dir"] + "/died_once"):
+            open(config["ckpt_dir"] + "/died_once", "w").close()
+            os._exit(1)
+        train.report({"step": i + 1, "w0": float(state["w"][0])})
+
+
+def test_trainer_failure_recovery(rt, tmp_path):
+    ckpt_dir = str(tmp_path / "ckpts")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    trainer = JaxTrainer(
+        _loop_ckpt,
+        train_loop_config={"steps": 6, "ckpt_dir": ckpt_dir, "die_at": 4},
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+        run_config=RunConfig(
+            name="t_ft", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)))
+    # Redirect the trainer's checkpoint manager at the loop's dir by
+    # pointing storage at tmp; the loop writes its own checkpoints, and the
+    # manager scans run_dir/checkpoints — emulate by symlink.
+    os.makedirs(str(tmp_path / "t_ft"), exist_ok=True)
+    link = str(tmp_path / "t_ft" / "checkpoints")
+    if not os.path.exists(link):
+        os.symlink(ckpt_dir, link)
+    result = trainer.fit()
+    assert result.error is None
+    # after dying at step 4 it restarts from ckpt step 4 and finishes 6
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[-1] == 6
+    assert result.checkpoint is not None
+
+
+def test_spmd_trainer_smoke(tmp_path):
+    import jax.numpy as jnp
+    from ray_tpu.train import SpmdTrainer, SpmdTrainerConfig
+    from ray_tpu.parallel import MeshSpec
+
+    rng = np.random.RandomState(0)
+
+    def data():
+        while True:
+            yield {"tokens": rng.randint(0, 255, (8, 32))}
+
+    cfg = SpmdTrainerConfig(model="llama-debug", mesh=MeshSpec(dp=2, tp=2,
+                                                               fsdp=2),
+                            total_steps=12, log_every=4, warmup_steps=2,
+                            checkpoint_every=6)
+    tr = SpmdTrainer(cfg, data, run_config=RunConfig(
+        name="spmd_smoke", storage_path=str(tmp_path)))
+    res = tr.fit()
+    assert res.metrics["step"] == 12
+    assert res.metrics["loss"] < res.metrics_history[0]["loss"]
+    assert res.checkpoint is not None
+
+    # resume from the final checkpoint: step counter should continue
+    cfg2 = SpmdTrainerConfig(model="llama-debug",
+                             mesh=MeshSpec(dp=2, tp=2, fsdp=2),
+                             total_steps=14, log_every=2, warmup_steps=2)
+    tr2 = SpmdTrainer(cfg2, data, run_config=RunConfig(
+        name="spmd_smoke2", storage_path=str(tmp_path)))
+    res2 = tr2.fit(resume_from=res.checkpoint.path)
+    assert res2.metrics["step"] == 14

@@ -1,0 +1,80 @@
+"""Tune parity tests: variants, schedulers, e2e Tuner (SURVEY.md §2.5)."""
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ASHAScheduler, MedianStoppingRule, STOP
+
+
+def test_generate_variants_grid_and_random():
+    space = {"lr": tune.loguniform(1e-4, 1e-1),
+             "bs": tune.grid_search([16, 32]),
+             "fixed": 7}
+    variants = tune.generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 6  # 3 samples x 2 grid points
+    assert all(v["fixed"] == 7 for v in variants)
+    assert {v["bs"] for v in variants} == {16, 32}
+    assert all(1e-4 <= v["lr"] <= 1e-1 for v in variants)
+
+
+def test_asha_stops_bad_trials():
+    s = ASHAScheduler(grace_period=2, reduction_factor=2, max_t=32)
+    # good trial reaches rung first
+    assert s.on_result("good", 2, 0.9) == "CONTINUE"
+    # bad trial below the top-1/2 cut at the same rung gets stopped
+    assert s.on_result("bad", 2, 0.1) == STOP
+    # max_t always stops
+    assert s.on_result("good", 32, 0.95) == STOP
+
+
+def test_median_stopping():
+    s = MedianStoppingRule(grace_period=1, min_samples=3)
+    s.on_result("a", 1, 0.9)
+    s.on_result("b", 1, 0.8)
+    assert s.on_result("c", 1, 0.1) == STOP
+
+
+def _objective(config):
+    score = 0.0
+    for i in range(5):
+        score += config["lr"] * 10
+        tune.report({"score": score, "step": i})
+
+
+def test_tuner_e2e(rt):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search([0.1, 0.5, 1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=1,
+                                    max_concurrent_trials=3))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["config"]["lr"] == 1.0
+    assert best.metrics["score"] == pytest.approx(50.0)
+    df = grid.dataframe()
+    assert len(df) == 3 and "config/lr" in df.columns
+
+
+def _objective_long(config):
+    # quality proportional to lr; 10 iterations
+    for i in range(1, 11):
+        tune.report({"score": config["lr"] * i})
+
+
+def test_tuner_with_asha_stops_weak(rt):
+    # strong trial first (sequential execution) so the rung cut is set high
+    tuner = tune.Tuner(
+        _objective_long,
+        param_space={"lr": tune.grid_search([1.0, 0.01])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=1,
+            scheduler=ASHAScheduler(grace_period=2, reduction_factor=2,
+                                    max_t=100)))
+    grid = tuner.fit()
+    statuses = {t.config["lr"]: t.status for t in grid.trials}
+    assert statuses[1.0] == "TERMINATED"
+    assert statuses[0.01] == "STOPPED"   # killed by ASHA at a rung
+    best = grid.get_best_result()
+    assert best.metrics["config"]["lr"] == 1.0
