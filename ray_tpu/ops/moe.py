@@ -1,0 +1,92 @@
+"""Mixture-of-Experts: top-k routing + capacity-factor dispatch, TPU-first.
+
+The reference runs MoE models through HF torch implementations (per-token
+gather/scatter with dynamic shapes). That shape-dynamism defeats XLA, so
+this is the GShard/Switch formulation instead: routing becomes one-hot
+einsums with *static* shapes — dispatch (G,E,C) x tokens (G,d) -> expert
+batches (E,C,d) — which XLA lowers to MXU matmuls and, when the expert dim
+is sharded over the `ep` mesh axis, to an all-to-all over ICI. Tokens
+overflowing an expert's capacity C are dropped (output 0 for that expert's
+contribution), the standard capacity-factor trade.
+
+Routing follows Mixtral: softmax over the top-k logits only. Aux losses
+(load-balance + router z-loss) come back alongside the output.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MoEAux(NamedTuple):
+    load_balance_loss: jax.Array   # scalar, Switch-style
+    router_z_loss: jax.Array       # scalar
+    expert_load: jax.Array         # (E,) fraction of tokens per expert
+
+
+def expert_capacity(n_tokens: int, n_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    cap = int(n_tokens * k * capacity_factor / n_experts)
+    return max(cap, 1)
+
+
+def top_k_routing(router_logits: jax.Array, k: int):
+    """router_logits: (G, E). Returns (weights (G,k), indices (G,k)) with
+    weights = softmax over the selected top-k logits (Mixtral convention)."""
+    top_logits, top_idx = jax.lax.top_k(router_logits, k)
+    weights = jax.nn.softmax(top_logits.astype(jnp.float32), axis=-1)
+    return weights, top_idx
+
+
+def moe_dispatch_combine(x: jax.Array, router_logits: jax.Array,
+                         expert_fn: Callable[[jax.Array], jax.Array],
+                         *, k: int = 2,
+                         capacity_factor: float = 1.25,
+                         capacity: Optional[int] = None):
+    """x: (G, d) flattened tokens; router_logits: (G, E).
+
+    expert_fn: (E, C, d) -> (E, C, d_out), typically a vmap over the expert
+    dim of stacked expert weights (sharded over `ep`).
+
+    Returns (out (G, d_out), MoEAux).
+    """
+    g, d = x.shape
+    e = router_logits.shape[-1]
+    c = capacity if capacity is not None else expert_capacity(
+        g, e, k, capacity_factor)
+
+    weights, top_idx = top_k_routing(router_logits, k)     # (G,k)
+    # (G, k, E) one-hot of chosen experts, ranked by k-slot priority.
+    assign = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+    # Position of each (token, slot) within its expert queue: slot-major
+    # ordering so slot-0 (highest-priority) choices win capacity (GShard).
+    # int32 cumsum keeps queue positions exact past 2^24 assignments.
+    slot_major = assign.transpose(1, 0, 2).reshape(k * g, e).astype(jnp.int32)
+    pos_slot_major = jnp.cumsum(slot_major, axis=0) - slot_major   # (k*G, E)
+    pos = pos_slot_major.reshape(k, g, e).transpose(1, 0, 2)       # (G,k,E)
+    within_cap = pos < c
+    keep = assign * within_cap                                      # (G,k,E)
+    slot_pos = (pos * keep).sum(-1).astype(jnp.int32)               # (G,k)
+    kept_expert = keep                                              # (G,k,E)
+
+    # dispatch (G, E, C): one-hot over capacity slot for kept assignments.
+    cap_onehot = jax.nn.one_hot(slot_pos, c, dtype=jnp.float32)     # (G,k,C)
+    dispatch = jnp.einsum("gke,gkc->gec", kept_expert, cap_onehot)
+    combine = jnp.einsum("gke,gk,gkc->gec", kept_expert,
+                         weights, cap_onehot)
+
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x)
+    expert_out = expert_fn(expert_in)                               # (E,C,do)
+    out = jnp.einsum("gec,ecd->gd", combine.astype(expert_out.dtype),
+                     expert_out)
+
+    # Aux losses (fp32): Switch load-balance = E * sum(frac_tokens * frac_prob)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    frac_prob = probs.mean(axis=0)                                  # (E,)
+    frac_tokens = assign.sum(axis=1).mean(axis=0)                   # (E,)
+    lb = e * jnp.sum(frac_prob * frac_tokens) / k
+    z = jnp.mean(jax.nn.logsumexp(
+        router_logits.astype(jnp.float32), axis=-1) ** 2)
+    return out, MoEAux(lb, z, frac_tokens)
