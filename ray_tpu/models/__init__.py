@@ -5,6 +5,10 @@ Train/Serve/RLlib examples, re-implemented TPU-first.
 """
 from .llama import Llama, LlamaConfig
 from .gpt2 import GPT2, GPT2Config
+from .mixtral import Mixtral, MixtralConfig
+from .vit import ViT, ViTConfig
+from .clip import CLIP, CLIPConfig, contrastive_loss
+from .mlp import MLP, MLPConfig, ResNetLite
 
 _REGISTRY = {
     "llama3-8b": lambda **kw: Llama(LlamaConfig.llama3_8b(**kw)),
@@ -14,6 +18,12 @@ _REGISTRY = {
     "gpt2-medium": lambda **kw: GPT2(GPT2Config.medium(**kw)),
     "gpt2-large": lambda **kw: GPT2(GPT2Config.large(**kw)),
     "gpt2-debug": lambda **kw: GPT2(GPT2Config.debug(**kw)),
+    "mixtral-8x7b": lambda **kw: Mixtral(MixtralConfig.mixtral_8x7b(**kw)),
+    "mixtral-debug": lambda **kw: Mixtral(MixtralConfig.debug(**kw)),
+    "vit-base": lambda **kw: ViT(ViTConfig.base(**kw)),
+    "vit-debug": lambda **kw: ViT(ViTConfig.debug(**kw)),
+    "clip-debug": lambda **kw: CLIP(CLIPConfig.debug(**kw)),
+    "resnet-lite": lambda **kw: ResNetLite(**kw),
 }
 
 
@@ -27,5 +37,7 @@ def register_model(name: str, builder) -> None:
     _REGISTRY[name] = builder
 
 
-__all__ = ["Llama", "LlamaConfig", "GPT2", "GPT2Config", "get_model",
-           "register_model"]
+__all__ = ["Llama", "LlamaConfig", "GPT2", "GPT2Config", "Mixtral",
+           "MixtralConfig", "ViT", "ViTConfig", "CLIP", "CLIPConfig",
+           "contrastive_loss", "MLP", "MLPConfig", "ResNetLite",
+           "get_model", "register_model"]

@@ -1,0 +1,164 @@
+"""CLIP-style dual encoder (image tower + text tower, contrastive loss).
+
+Reference-side counterpart: HF CLIP used in Ray Data/Serve multimodal
+examples (batch inference pipelines). Vision tower reuses the ViT trunk;
+text tower is a small causal transformer pooled at EOT; both project into
+a shared embedding space with a learnable logit temperature.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops import layer_norm, multi_head_attention
+from .vit import ViTConfig, ViTBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    embed_dim: int = 512
+    # vision
+    image_size: int = 224
+    patch_size: int = 32
+    vision_d_model: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    # text
+    vocab_size: int = 49408
+    max_text_len: int = 77
+    text_d_model: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    dtype: Any = jnp.bfloat16
+
+    def vision_cfg(self) -> ViTConfig:
+        return ViTConfig(image_size=self.image_size,
+                         patch_size=self.patch_size,
+                         num_classes=self.embed_dim,
+                         d_model=self.vision_d_model,
+                         n_layers=self.vision_layers,
+                         n_heads=self.vision_heads,
+                         d_ff=self.vision_d_model * 4,
+                         dtype=self.dtype)
+
+    @staticmethod
+    def debug(**kw) -> "CLIPConfig":
+        return CLIPConfig(embed_dim=32, image_size=32, patch_size=8,
+                          vision_d_model=64, vision_layers=2,
+                          vision_heads=4, vocab_size=256, max_text_len=16,
+                          text_d_model=48, text_layers=2, text_heads=4,
+                          **kw)
+
+
+class _TextBlock(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        hd = d // cfg.text_heads
+        h = layer_norm(x,
+                       self.param("ln1_scale", nn.initializers.ones, (d,)),
+                       self.param("ln1_bias", nn.initializers.zeros, (d,)))
+        q = nn.Dense(d, name="q_proj", dtype=cfg.dtype)(h)
+        k = nn.Dense(d, name="k_proj", dtype=cfg.dtype)(h)
+        v = nn.Dense(d, name="v_proj", dtype=cfg.dtype)(h)
+        att = multi_head_attention(
+            q.reshape(b, s, cfg.text_heads, hd),
+            k.reshape(b, s, cfg.text_heads, hd),
+            v.reshape(b, s, cfg.text_heads, hd), causal=True)
+        x = x + nn.Dense(d, name="o_proj", dtype=cfg.dtype)(
+            att.reshape(b, s, d))
+        h = layer_norm(x,
+                       self.param("ln2_scale", nn.initializers.ones, (d,)),
+                       self.param("ln2_bias", nn.initializers.zeros, (d,)))
+        h = nn.gelu(nn.Dense(d * 4, name="fc_in", dtype=cfg.dtype)(h))
+        return x + nn.Dense(d, name="fc_out", dtype=cfg.dtype)(h)
+
+
+class CLIP(nn.Module):
+    """(images (B,H,W,C), tokens (B,T)) -> (img_emb, txt_emb, logit_scale).
+
+    Embeddings are L2-normalized fp32; `contrastive_loss` gives the
+    symmetric InfoNCE objective.
+    """
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, images, tokens):
+        cfg = self.cfg
+
+        # ---- vision tower: ViT trunk + linear projection ----
+        vcfg = cfg.vision_cfg()
+        b = images.shape[0]
+        x = nn.Conv(vcfg.d_model,
+                    kernel_size=(vcfg.patch_size, vcfg.patch_size),
+                    strides=(vcfg.patch_size, vcfg.patch_size),
+                    name="patch_embed", dtype=cfg.dtype)(
+                        images.astype(cfg.dtype))
+        x = x.reshape(b, -1, vcfg.d_model)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, vcfg.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, vcfg.d_model)).astype(cfg.dtype),
+             x], axis=1)
+        pos = self.param("vision_pos_embed", nn.initializers.normal(0.02),
+                         (1, vcfg.n_patches + 1, vcfg.d_model))
+        x = x + pos.astype(cfg.dtype)
+        for i in range(vcfg.n_layers):
+            x = ViTBlock(vcfg, name=f"vision_layer_{i}")(x)
+        x = layer_norm(
+            x, self.param("vision_ln_scale", nn.initializers.ones,
+                          (vcfg.d_model,)),
+            self.param("vision_ln_bias", nn.initializers.zeros,
+                       (vcfg.d_model,)))
+        img_emb = nn.Dense(cfg.embed_dim, use_bias=False,
+                           name="vision_proj",
+                           dtype=jnp.float32)(x[:, 0].astype(jnp.float32))
+
+        # ---- text tower: causal transformer, pooled at last token ----
+        t = nn.Embed(cfg.vocab_size, cfg.text_d_model, name="token_embed",
+                     dtype=cfg.dtype,
+                     embedding_init=nn.initializers.normal(0.02))(tokens)
+        tpos = self.param("text_pos_embed", nn.initializers.normal(0.02),
+                          (1, cfg.max_text_len, cfg.text_d_model))
+        t = t + tpos[:, :tokens.shape[1]].astype(cfg.dtype)
+        for i in range(cfg.text_layers):
+            t = _TextBlock(cfg, name=f"text_layer_{i}")(t)
+        t = layer_norm(
+            t, self.param("text_ln_scale", nn.initializers.ones,
+                          (cfg.text_d_model,)),
+            self.param("text_ln_bias", nn.initializers.zeros,
+                       (cfg.text_d_model,)))
+        txt_emb = nn.Dense(cfg.embed_dim, use_bias=False, name="text_proj",
+                           dtype=jnp.float32)(
+                               t[:, -1].astype(jnp.float32))
+
+        logit_scale = self.param("logit_scale",
+                                 nn.initializers.constant(2.6592), ())
+        img_emb = img_emb / (jnp.linalg.norm(img_emb, axis=-1,
+                                             keepdims=True) + 1e-8)
+        txt_emb = txt_emb / (jnp.linalg.norm(txt_emb, axis=-1,
+                                             keepdims=True) + 1e-8)
+        return img_emb, txt_emb, jnp.exp(logit_scale)
+
+    def init_params(self, rng, batch=1):
+        cfg = self.cfg
+        images = jnp.zeros((batch, cfg.image_size, cfg.image_size, 3),
+                           jnp.float32)
+        tokens = jnp.zeros((batch, cfg.max_text_len), jnp.int32)
+        return self.init(rng, images, tokens)["params"]
+
+
+def contrastive_loss(img_emb, txt_emb, logit_scale) -> jax.Array:
+    """Symmetric InfoNCE over in-batch negatives (fp32)."""
+    logits = logit_scale * img_emb @ txt_emb.T
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.mean(jax.nn.log_softmax(logits, axis=-1)[labels, labels])
+    lt = -jnp.mean(jax.nn.log_softmax(logits.T, axis=-1)[labels, labels])
+    return 0.5 * (li + lt)

@@ -1,0 +1,193 @@
+"""Mixtral-style sparse-MoE decoder, TPU-first.
+
+The reference serves/trains Mixtral through HF torch (dynamic per-token
+expert gather). Here the MoE MLP uses ray_tpu.ops.moe's static-shaped
+GShard dispatch so expert compute is batched einsums the MXU likes, and
+the stacked expert weights carry a leading expert axis sharded over the
+`ep` mesh axis (see parallel/sharding.py DEFAULT_RULES: `experts_*`).
+
+Attention/RoPE/norms reuse the Llama blocks — weight layout stays
+`layer_{i}/attention/...` so serve/train tooling treats both families
+uniformly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops import rms_norm, rope_frequencies, swiglu
+from ..ops.moe import moe_dispatch_combine, expert_capacity
+from .llama import LlamaAttention, LlamaConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 5632
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 2048
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    router_aux_coef: float = 0.01
+    router_z_coef: float = 1e-3
+    remat: bool = False
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def attn_cfg(self) -> LlamaConfig:
+        """The attention sub-config shared with the Llama blocks."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
+            max_seq_len=self.max_seq_len, rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps, dtype=self.dtype,
+            attn_impl=self.attn_impl)
+
+    @staticmethod
+    def mixtral_8x7b(**kw) -> "MixtralConfig":
+        return MixtralConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, d_ff=14336,
+                             n_experts=8, experts_per_token=2,
+                             max_seq_len=8192, remat=True, **kw)
+
+    @staticmethod
+    def debug(**kw) -> "MixtralConfig":
+        return MixtralConfig(vocab_size=256, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128,
+                             n_experts=4, experts_per_token=2,
+                             max_seq_len=128, **kw)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts with stacked (E, ...) weights."""
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        router_w = self.param(
+            "router_kernel", nn.initializers.normal(0.02),
+            (d, cfg.n_experts))
+        # Stacked expert weights; names match sharding DEFAULT_RULES so the
+        # expert axis lands on `ep` and the ff dims on fsdp/tp.
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate_kernel", init,
+                            (cfg.n_experts, d, cfg.d_ff))
+        w_up = self.param("experts_up_kernel", init,
+                          (cfg.n_experts, d, cfg.d_ff))
+        w_down = self.param("experts_down_kernel", init,
+                            (cfg.n_experts, cfg.d_ff, d))
+
+        tokens = x.reshape(b * s, d)
+        router_logits = jnp.einsum(
+            "gd,de->ge", tokens.astype(jnp.float32),
+            router_w.astype(jnp.float32))
+
+        def expert_fn(batch):   # (E, C, d) -> (E, C, d)
+            batch = batch.astype(cfg.dtype)
+            gate = jnp.einsum("ecd,edf->ecf", batch, w_gate.astype(cfg.dtype))
+            up = jnp.einsum("ecd,edf->ecf", batch, w_up.astype(cfg.dtype))
+            return jnp.einsum("ecf,efd->ecd", swiglu(gate, up),
+                              w_down.astype(cfg.dtype))
+
+        cap = expert_capacity(b * s, cfg.n_experts, cfg.experts_per_token,
+                              cfg.capacity_factor)
+        out, aux = moe_dispatch_combine(
+            tokens, router_logits, expert_fn,
+            k=cfg.experts_per_token, capacity=cap)
+        self.sow("aux_loss", "router",
+                 cfg.router_aux_coef * aux.load_balance_loss
+                 + cfg.router_z_coef * aux.router_z_loss)
+        return out.reshape(b, s, d).astype(cfg.dtype)
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, cache=None, positions=None):
+        cfg = self.cfg
+        attn_norm_w = self.param("attn_norm", nn.initializers.ones,
+                                 (cfg.d_model,))
+        mlp_norm_w = self.param("mlp_norm", nn.initializers.ones,
+                                (cfg.d_model,))
+        h, new_cache = LlamaAttention(cfg.attn_cfg(), name="attention")(
+            rms_norm(x, attn_norm_w, cfg.norm_eps), cos, sin, cache,
+            positions)
+        x = x + h
+        x = x + MoEMLP(cfg, name="moe")(rms_norm(x, mlp_norm_w,
+                                                 cfg.norm_eps))
+        return x, new_cache
+
+
+class Mixtral(nn.Module):
+    """tokens (B, S) -> (logits, cache). Same calling convention as Llama
+    so the serve engine and trainers are model-family agnostic.
+
+    The summed router aux loss is exposed via the "aux_loss" collection:
+    `model.apply(vars, tokens, mutable=["aux_loss"])`.
+    """
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens, cache=None, positions=None):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
+                         dtype=cfg.dtype,
+                         embedding_init=nn.initializers.normal(0.02))
+        x = embed(tokens)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        block_cls = (nn.remat(MixtralBlock)
+                     if (cfg.remat and cache is None) else MixtralBlock)
+        new_cache = []
+        for i in range(cfg.n_layers):
+            block = block_cls(cfg, name=f"layer_{i}")
+            x, c = block(x, cos, sin,
+                         None if cache is None else cache[i], positions)
+            new_cache.append(c)
+        final_w = self.param("final_norm", nn.initializers.ones,
+                             (cfg.d_model,))
+        x = rms_norm(x, final_w, cfg.norm_eps)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                          dtype=jnp.float32)(x.astype(jnp.float32))
+        return logits, (new_cache if cache is not None else None)
+
+    def init_params(self, rng, batch=1, seq=8):
+        tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+    def empty_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.cfg
+        return [
+            (jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       dtype=dtype),
+             jnp.zeros((batch, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       dtype=dtype),
+             jnp.zeros((batch,), dtype=jnp.int32))
+            for _ in range(cfg.n_layers)
+        ]
+
+    @staticmethod
+    def aux_loss(mutables) -> jax.Array:
+        """Sum the sown per-layer router losses from `mutable=["aux_loss"]`."""
+        leaves = jax.tree_util.tree_leaves(mutables.get("aux_loss", {}))
+        if not leaves:
+            return jnp.float32(0.0)
+        return sum(jnp.sum(leaf) for leaf in leaves)

@@ -1,0 +1,70 @@
+"""Small dense nets: MLP and ResNet-lite.
+
+Reference-side counterpart: the torch nn.Sequential policy/value nets in
+rllib catalogs (rllib/core/models/) and the tabular models in train/tune
+examples. These back ray_tpu.rllib policies and the tune/train smoke
+paths, so they stay tiny, fp32, and jit-cheap.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    hidden: Sequence[int] = (64, 64)
+    out_dim: int = 1
+    activation: str = "tanh"     # "tanh" | "relu" | "gelu"
+    dtype: Any = jnp.float32
+
+
+_ACTS = {"tanh": nn.tanh, "relu": nn.relu, "gelu": nn.gelu}
+
+
+class MLP(nn.Module):
+    cfg: MLPConfig
+
+    @nn.compact
+    def __call__(self, x):
+        act = _ACTS[self.cfg.activation]
+        for i, h in enumerate(self.cfg.hidden):
+            x = act(nn.Dense(h, name=f"fc_{i}",
+                             dtype=self.cfg.dtype)(x))
+        return nn.Dense(self.cfg.out_dim, name="head",
+                        dtype=self.cfg.dtype)(x)
+
+    def init_params(self, rng, in_dim: int):
+        return self.init(rng, jnp.zeros((1, in_dim)))["params"]
+
+
+class ResNetLite(nn.Module):
+    """Tiny pre-activation residual conv net for 32x32-ish images."""
+    num_classes: int = 10
+    width: int = 32
+    n_blocks: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.width, (3, 3), name="stem")(x)
+        for i in range(self.n_blocks):
+            w = self.width * (2 ** i)
+            h = nn.relu(nn.GroupNorm(num_groups=8,
+                                     name=f"block{i}_gn1")(x))
+            h = nn.Conv(w, (3, 3), name=f"block{i}_conv1")(h)
+            h = nn.relu(nn.GroupNorm(num_groups=8,
+                                     name=f"block{i}_gn2")(h))
+            h = nn.Conv(w, (3, 3), name=f"block{i}_conv2")(h)
+            if x.shape[-1] != w:
+                x = nn.Conv(w, (1, 1), name=f"block{i}_skip")(x)
+            x = x + h
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+    def init_params(self, rng, image_size: int = 32, channels: int = 3):
+        return self.init(
+            rng, jnp.zeros((1, image_size, image_size, channels)))["params"]

@@ -1,0 +1,118 @@
+"""Vision Transformer classifier, TPU-first.
+
+Reference-side counterpart: the torchvision/HF image models used across
+Ray Train/Serve examples (e.g. doc image-classification examples and
+`python/ray/train` vision tutorials). Built on flax.linen with the same
+sharding-friendly naming as the decoders (q_proj/.../fc_in/fc_out), so
+tp/fsdp rules apply unchanged.
+
+Patchify is a single strided conv (one big MXU matmul after im2col, which
+XLA does for free); encoder blocks are pre-norm MHA + GELU MLP.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops import layer_norm, multi_head_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    channels: int = 3
+    pool: str = "cls"            # "cls" | "mean"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def debug(**kw) -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         d_model=64, n_layers=2, n_heads=4, d_ff=128, **kw)
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        h = layer_norm(x,
+                       self.param("ln1_scale", nn.initializers.ones, (d,)),
+                       self.param("ln1_bias", nn.initializers.zeros, (d,)))
+        q = nn.Dense(d, name="q_proj", dtype=cfg.dtype)(h)
+        k = nn.Dense(d, name="k_proj", dtype=cfg.dtype)(h)
+        v = nn.Dense(d, name="v_proj", dtype=cfg.dtype)(h)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        att = multi_head_attention(q, k, v, causal=False)
+        x = x + nn.Dense(d, name="o_proj", dtype=cfg.dtype)(
+            att.reshape(b, s, d))
+        h = layer_norm(x,
+                       self.param("ln2_scale", nn.initializers.ones, (d,)),
+                       self.param("ln2_bias", nn.initializers.zeros, (d,)))
+        h = nn.Dense(cfg.d_ff, name="fc_in", dtype=cfg.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(d, name="fc_out", dtype=cfg.dtype)(h)
+        return x
+
+
+class ViT(nn.Module):
+    """images (B, H, W, C) float -> logits (B, num_classes) fp32."""
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        b = images.shape[0]
+        x = nn.Conv(cfg.d_model,
+                    kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    name="patch_embed", dtype=cfg.dtype)(
+                        images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.d_model)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.d_model)).astype(cfg.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.n_patches + 1, cfg.d_model))
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = ViTBlock(cfg, name=f"layer_{i}")(x)
+        x = layer_norm(
+            x, self.param("ln_f_scale", nn.initializers.ones,
+                          (cfg.d_model,)),
+            self.param("ln_f_bias", nn.initializers.zeros, (cfg.d_model,)))
+        feat = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+        return nn.Dense(cfg.num_classes, name="head",
+                        dtype=jnp.float32)(feat.astype(jnp.float32))
+
+    def init_params(self, rng, batch=1):
+        cfg = self.cfg
+        images = jnp.zeros((batch, cfg.image_size, cfg.image_size,
+                            cfg.channels), jnp.float32)
+        return self.init(rng, images)["params"]

@@ -27,38 +27,44 @@ _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite: avoids inf-inf
 
 
 def _online_chunk(q, k, v, m, l, acc, q_offset, k_offset, scale, causal):
-    """One block of online-softmax attention.
+    """One block of online-softmax attention, grouped-query layout.
 
-    q: (B, Sq, H, D) local query chunk at global offset q_offset
-    k/v: (B, Sk, H, D) visiting kv chunk at global offset k_offset
-    m: (B, H, Sq) running max; l: (B, H, Sq) running denominator;
-    acc: (B, Sq, H, D) running numerator. All fp32.
+    q: (B, Sq, Hkv, R, D) local query chunk at global offset q_offset —
+       R = Hq // Hkv query heads per kv head, so kv stays un-repeated
+    k/v: (B, Sk, Hkv, D) visiting kv chunk at global offset k_offset
+    m/l: (B, Hkv, R, Sq) running max / denominator;
+    acc: (B, Sq, Hkv, R, D) running numerator. All fp32.
     """
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         qpos = q_offset + jnp.arange(sq)[:, None]
         kpos = k_offset + jnp.arange(sk)[None, :]
-        logits = jnp.where((qpos >= kpos)[None, None], logits, _NEG_BIG)
+        logits = jnp.where((qpos >= kpos)[None, None, None],
+                           logits, _NEG_BIG)
     new_m = jnp.maximum(m, logits.max(axis=-1))
     correction = jnp.exp(m - new_m)
-    p = jnp.exp(logits - new_m[..., None])          # (B, H, Sq, Sk)
+    p = jnp.exp(logits - new_m[..., None])          # (B,Hkv,R,Sq,Sk)
     new_l = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    new_acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    pv = jnp.einsum("bhrqk,bkhd->bqhrd", p, v.astype(jnp.float32))
+    new_acc = (acc * correction.transpose(0, 3, 1, 2)[..., None] + pv)
     return new_m, new_l, new_acc
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, n_chunks: int,
                           causal: bool, scale: float):
-    """Per-device body under shard_map. q/k/v: local (B, S/n, H, D)."""
+    """Per-device body under shard_map. q: local (B, S/n, Hq, D);
+    k/v: local (B, S/n, Hkv, D). kv rides the ring at Hkv width — GQA's
+    bandwidth saving applies to the ppermute traffic too."""
     idx = jax.lax.axis_index(axis_name)
-    b, sq, h, d = q.shape
-    q32 = q.astype(jnp.float32)
-    m = jnp.full((b, h, sq), _NEG_BIG, jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    acc = jnp.zeros((b, sq, h, d), jnp.float32)
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    rep = hq // hkv
+    q32 = q.astype(jnp.float32).reshape(b, sq, hkv, rep, d)
+    m = jnp.full((b, hkv, rep, sq), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((b, hkv, rep, sq), jnp.float32)
+    acc = jnp.zeros((b, sq, hkv, rep, d), jnp.float32)
     perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
 
     def body(s, carry):
@@ -76,8 +82,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_chunks: int,
 
     m, l, acc, _, _ = jax.lax.fori_loop(0, n_chunks, body,
                                         (m, l, acc, k, v))
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = acc / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -92,17 +98,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     b, s, hq, d = q.shape
     hkv = k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"n_heads {hq} % n_kv_heads {hkv} != 0")
     if scale is None:
         scale = d ** -0.5
-    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
+    n = mesh.shape.get(axis_name, 1)
     if n == 1:
         # Degenerate ring == dense attention; reuse the canonical impl.
         from .attention import multi_head_attention  # noqa: PLC0415
         return multi_head_attention(q, k, v, causal=causal, scale=scale)
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     if s % n:
         raise ValueError(f"seq len {s} not divisible by {axis_name}={n}")
 

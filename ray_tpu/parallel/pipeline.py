@@ -74,7 +74,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jax.Array, *,
     pp (sharded over `axis_name`). B must divide into n_microbatches.
     Returns (B, ...) activations, replicated over the pp axis.
     """
-    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis_name, 1)
+    n = mesh.shape.get(axis_name, 1)
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} % n_microbatches {n_microbatches} != 0")
@@ -89,13 +89,22 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jax.Array, *,
     mb = b // n_microbatches
     x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
 
+    # Microbatch rows shard over the data axes so dp/fsdp slices each run
+    # their own pipeline on their own batch shard (no replicated compute).
+    data_axes, prod = [], 1
+    for a in ("dp", "fsdp"):
+        sz = mesh.shape.get(a, 1)
+        if sz > 1 and mb % (prod * sz) == 0:
+            data_axes.append(a)
+            prod *= sz
+    batch_spec = P(None, tuple(data_axes) if data_axes else None)
     param_specs = jax.tree_util.tree_map(
         lambda a: P(axis_name), stacked_params)
     fn = jax.shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn,
                           axis_name=axis_name, n_stages=n,
                           n_micro=n_microbatches),
-        mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
-        check_vma=False)
+        mesh=mesh, in_specs=(param_specs, batch_spec),
+        out_specs=batch_spec, check_vma=False)
     out = fn(stacked_params, x_mb)
     return out.reshape(b, *out.shape[2:])

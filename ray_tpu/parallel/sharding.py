@@ -31,6 +31,11 @@ Rule = Tuple[str, P]
 
 
 DEFAULT_RULES: Sequence[Rule] = (
+    # MoE experts first: their paths can also contain generic names like
+    # gate_proj, and first-match must pick the 3-axis ep spec.
+    (r".*experts.*(gate|up).*kernel$", P("ep", "fsdp", "tp")),
+    (r".*experts.*down.*kernel$", P("ep", "tp", "fsdp")),
+    (r".*router.*kernel$", P("fsdp", None)),
     (r".*(token_embed|embed_tokens|wte)\b.*embedding$", P("tp", "fsdp")),
     # untied output head: (d_model, vocab) column-parallel over vocab
     (r".*(lm_head|output_proj)\b.*kernel$", P("fsdp", "tp")),
@@ -38,10 +43,6 @@ DEFAULT_RULES: Sequence[Rule] = (
     (r".*(wo|o_proj|out_proj|attn_out)\b.*kernel$", P("tp", "fsdp")),
     (r".*(gate_proj|up_proj|w1|w3|fc_in)\b.*kernel$", P("fsdp", "tp")),
     (r".*(down_proj|w2|fc_out)\b.*kernel$", P("tp", "fsdp")),
-    # MoE experts: leading expert dim over ep, then standard column/row
-    (r".*experts.*(gate|up)\b.*kernel$", P("ep", "fsdp", "tp")),
-    (r".*experts.*down\b.*kernel$", P("ep", "tp", "fsdp")),
-    (r".*router\b.*kernel$", P("fsdp", None)),
     (r".*(pos_embed|wpe)\b.*embedding$", P(None, "fsdp")),
     (r".*(norm|ln_f|ln_1|ln_2|layernorm).*$", P()),
     (r".*bias$", P()),
@@ -69,7 +70,7 @@ class ShardingRules:
 def _clip_to_mesh(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     """Drop axes not in the mesh / of size 1, and any axis that doesn't
     divide the dimension — falling back to replication for that dim."""
-    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axis_sizes = mesh.shape
     out = []
     for i, entry in enumerate(spec):
         if i >= len(shape):
@@ -141,11 +142,9 @@ def shard_pytree(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
 
 def batch_sharding(mesh: Mesh, *, seq_axis: Optional[str] = "sp") -> NamedSharding:
     """Input batch (B, S, ...) sharded over data axes, seq over sp."""
-    data = tuple(a for a in ("dp", "fsdp")
-                 if dict(zip(mesh.axis_names,
-                             mesh.devices.shape)).get(a, 1) > 1)
-    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    seq = seq_axis if seq_axis and axis_sizes.get(seq_axis, 1) > 1 else None
+    data = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    seq = (seq_axis if seq_axis and mesh.shape.get(seq_axis, 1) > 1
+           else None)
     return NamedSharding(mesh, P(data if data else None, seq))
 
 
