@@ -1,0 +1,6 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The image has no pybind11, so each component ships a flat C ABI compiled
+on first use (g++ -O2 -shared) and cached next to the source. See
+store_binding.py for the object-store arena.
+"""

@@ -1,0 +1,218 @@
+"""ctypes binding for the C++ shared-memory object arena.
+
+NativeStore implements the same interface as core.object_store.ShmStore
+(put_value/get_value/release/delete_segment/used_bytes/shutdown) but backs
+large objects with the single C++ arena instead of one POSIX segment per
+object: allocation, refcounts, and LRU eviction all happen in native code
+under one process-shared lock (reference parity:
+src/ray/object_manager/plasma/store.cc).
+
+Arena discovery: the owner (driver) picks a segment name and exports it as
+RAY_TPU_ARENA_NAME so spawned workers attach the same arena. Writes are
+zero-copy (serialize directly into the mapping); reads pin the object and
+hand numpy views over shared pages until release().
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any
+
+from .build import build_library
+from ..core import serialization
+from ..core.object_store import INLINE_MAX, ObjectLocation
+from ..exceptions import ObjectLostError, ObjectStoreFullError
+
+_ENV_NAME = "RAY_TPU_ARENA_NAME"
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(build_library("object_store"))
+    lib.rtpu_arena_create.restype = ctypes.c_void_p
+    lib.rtpu_arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_int]
+    lib.rtpu_arena_close.restype = None
+    lib.rtpu_arena_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rtpu_arena_unlink.restype = None
+    lib.rtpu_arena_unlink.argtypes = [ctypes.c_void_p]
+    lib.rtpu_arena_base.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.rtpu_arena_base.argtypes = [ctypes.c_void_p]
+    lib.rtpu_arena_create_object.restype = ctypes.c_int64
+    lib.rtpu_arena_create_object.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_uint64]
+    lib.rtpu_arena_seal.restype = ctypes.c_int
+    lib.rtpu_arena_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_arena_get.restype = ctypes.c_int64
+    lib.rtpu_arena_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.rtpu_arena_release.restype = ctypes.c_int
+    lib.rtpu_arena_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_arena_delete.restype = ctypes.c_int
+    lib.rtpu_arena_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_arena_contains.restype = ctypes.c_int
+    lib.rtpu_arena_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_arena_used.restype = ctypes.c_uint64
+    lib.rtpu_arena_used.argtypes = [ctypes.c_void_p]
+    lib.rtpu_arena_capacity.restype = ctypes.c_uint64
+    lib.rtpu_arena_capacity.argtypes = [ctypes.c_void_p]
+    lib.rtpu_arena_count.restype = ctypes.c_uint32
+    lib.rtpu_arena_count.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            _lib = _load_lib()
+        return _lib
+
+
+class _Pin:
+    """Holds one arena refcount for as long as any buffer view of the
+    object is alive (PEP 688: memoryview(_Pin) re-exports the arena slice
+    while keeping this object — and therefore the pin — referenced)."""
+
+    __slots__ = ("_store", "_name", "_view")
+
+    def __init__(self, store: "NativeStore", name: str, view: memoryview):
+        self._store = store
+        self._name = name
+        self._view = view
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._view
+
+    def __del__(self):
+        try:
+            self._store._release_one(self._name)
+        except Exception:
+            pass  # interpreter teardown
+
+
+class NativeStore:
+    """Per-process view of the node's C++ shared-memory arena."""
+
+    def __init__(self, capacity_bytes: int = 8 << 30,
+                 is_owner: bool = False):
+        self._lib = get_lib()
+        self.capacity = capacity_bytes
+        self.is_owner = is_owner
+        if is_owner:
+            name = f"/rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
+            os.environ[_ENV_NAME] = name
+        else:
+            name = os.environ.get(_ENV_NAME, "")
+            if not name:
+                raise RuntimeError(
+                    "no arena to attach: RAY_TPU_ARENA_NAME unset "
+                    "(driver store is not the native backend)")
+        self._name = name
+        self._handle = self._lib.rtpu_arena_create(
+            name.encode(), capacity_bytes, 1 if is_owner else 0)
+        if not self._handle:
+            raise RuntimeError(f"failed to map arena {name}")
+        base = self._lib.rtpu_arena_base(self._handle)
+        cap = self._lib.rtpu_arena_capacity(self._handle)
+        # One memoryview over the whole data region; object views slice it.
+        self._data = memoryview(
+            (ctypes.c_uint8 * cap).from_address(
+                ctypes.addressof(base.contents))).cast("B")
+        self._lock = threading.Lock()
+
+    # -- write path ---------------------------------------------------------
+    def put_value(self, oid: str, value: Any) -> ObjectLocation:
+        meta, bufs = serialization.serialize(value)
+        size = serialization.packed_size(meta, bufs)
+        if size <= INLINE_MAX:
+            return ObjectLocation(kind="inline", size=size,
+                                  data=serialization.pack_parts(meta, bufs))
+        off = self._lib.rtpu_arena_create_object(
+            self._handle, oid.encode(), size)
+        if off == -2:
+            raise ValueError(f"object {oid} already exists in the arena")
+        if off < 0:
+            raise ObjectStoreFullError(
+                f"object {oid} ({size} B) does not fit in the arena "
+                f"({self.used_bytes()}/{self.capacity} B used, "
+                f"nothing evictable)")
+        try:
+            serialization.pack_into(self._data[off:off + size], meta, bufs)
+        except BaseException:
+            self._lib.rtpu_arena_seal(self._handle, oid.encode())
+            self._lib.rtpu_arena_delete(self._handle, oid.encode())
+            raise
+        self._lib.rtpu_arena_seal(self._handle, oid.encode())
+        return ObjectLocation(kind="native", size=size, name=oid)
+
+    # -- read path ----------------------------------------------------------
+    def get_value(self, loc: ObjectLocation) -> Any:
+        if loc.kind == "inline":
+            return serialization.unpack(loc.data)
+        if loc.kind == "native":
+            size = ctypes.c_uint64()
+            off = self._lib.rtpu_arena_get(
+                self._handle, loc.name.encode(), ctypes.byref(size))
+            if off < 0:
+                raise ObjectLostError(
+                    f"object {loc.name} is gone from the arena (evicted?)")
+            # The pin (refcount) lives exactly as long as the deserialized
+            # value: zero-copy numpy views keep `pin` alive through the
+            # memoryview chain; when the last view dies, __del__ unpins and
+            # the object becomes evictable again. Values with no
+            # out-of-band buffers drop the pin on return.
+            pin = _Pin(self, loc.name, self._data[off:off + size.value])
+            return serialization.unpack(memoryview(pin))
+        if loc.kind == "shm":
+            # A peer fell back to the pure-Python store; read its segment.
+            return self._shm_fallback().get_value(loc)
+        raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def _shm_fallback(self):
+        if not hasattr(self, "_fallback"):
+            from ..core.object_store import ShmStore  # noqa: PLC0415
+            self._fallback = ShmStore(capacity_bytes=self.capacity,
+                                      is_owner=self.is_owner)
+        return self._fallback
+
+    # -- lifecycle ----------------------------------------------------------
+    def _release_one(self, name: str) -> None:
+        if self._handle:
+            self._lib.rtpu_arena_release(self._handle, name.encode())
+
+    def release(self, name: str) -> None:
+        """Pins are lifetime-managed (_Pin); explicit release is a no-op."""
+
+    def delete_segment(self, name: str, size: int) -> None:
+        if name.startswith("rtpu_"):
+            # Segment written by a ShmStore-fallback peer.
+            self._shm_fallback().delete_segment(name, size)
+        else:
+            self._lib.rtpu_arena_delete(self._handle, name.encode())
+
+    def contains(self, name: str) -> bool:
+        return bool(self._lib.rtpu_arena_contains(self._handle,
+                                                  name.encode()))
+
+    def used_bytes(self) -> int:
+        return int(self._lib.rtpu_arena_used(self._handle))
+
+    def num_objects(self) -> int:
+        return int(self._lib.rtpu_arena_count(self._handle))
+
+    def shutdown(self) -> None:
+        if self._handle:
+            # Readers may still hold zero-copy numpy views into the
+            # mapping, so never munmap mid-process: unlink the name (owner)
+            # and let the kernel reclaim pages at process exit.
+            if self.is_owner:
+                self._lib.rtpu_arena_unlink(self._handle)
+            self._handle = None
+        if self.is_owner:
+            os.environ.pop(_ENV_NAME, None)

@@ -826,7 +826,8 @@ class DriverRuntime:
     def _free(self, oids: List[str]):
         for oid in oids:
             e = self.gcs.objects.pop(oid, None)
-            if e is not None and e.loc is not None and e.loc.kind == "shm":
+            if e is not None and e.loc is not None and e.loc.kind in (
+                    "shm", "native"):
                 self.store.delete_segment(e.loc.name, e.loc.size)
 
     def _create_pg(self, pg: PlacementGroupState):

@@ -14,8 +14,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops import layer_norm, multi_head_attention
-from .vit import ViTConfig, ViTBlock
+from ..ops import layer_norm
+from .vit import ViTConfig, ViTBlock, ViTTrunk
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,90 +54,52 @@ class CLIPConfig:
                           **kw)
 
 
-class _TextBlock(nn.Module):
-    cfg: CLIPConfig
-
-    @nn.compact
-    def __call__(self, x):
-        cfg = self.cfg
-        b, s, d = x.shape
-        hd = d // cfg.text_heads
-        h = layer_norm(x,
-                       self.param("ln1_scale", nn.initializers.ones, (d,)),
-                       self.param("ln1_bias", nn.initializers.zeros, (d,)))
-        q = nn.Dense(d, name="q_proj", dtype=cfg.dtype)(h)
-        k = nn.Dense(d, name="k_proj", dtype=cfg.dtype)(h)
-        v = nn.Dense(d, name="v_proj", dtype=cfg.dtype)(h)
-        att = multi_head_attention(
-            q.reshape(b, s, cfg.text_heads, hd),
-            k.reshape(b, s, cfg.text_heads, hd),
-            v.reshape(b, s, cfg.text_heads, hd), causal=True)
-        x = x + nn.Dense(d, name="o_proj", dtype=cfg.dtype)(
-            att.reshape(b, s, d))
-        h = layer_norm(x,
-                       self.param("ln2_scale", nn.initializers.ones, (d,)),
-                       self.param("ln2_bias", nn.initializers.zeros, (d,)))
-        h = nn.gelu(nn.Dense(d * 4, name="fc_in", dtype=cfg.dtype)(h))
-        return x + nn.Dense(d, name="fc_out", dtype=cfg.dtype)(h)
-
-
 class CLIP(nn.Module):
     """(images (B,H,W,C), tokens (B,T)) -> (img_emb, txt_emb, logit_scale).
 
     Embeddings are L2-normalized fp32; `contrastive_loss` gives the
-    symmetric InfoNCE objective.
+    symmetric InfoNCE objective. Text pools at each row's EOT token —
+    `tokens.argmax(-1)`, the OpenAI CLIP convention: EOT must be the
+    highest id in the vocab, so right-padded captions pool at content,
+    not padding.
     """
     cfg: CLIPConfig
+
+    def text_cfg(self) -> ViTConfig:
+        """Shape-only config for the text blocks (reuses ViTBlock)."""
+        cfg = self.cfg
+        return ViTConfig(d_model=cfg.text_d_model, n_heads=cfg.text_heads,
+                         d_ff=cfg.text_d_model * 4, dtype=cfg.dtype)
 
     @nn.compact
     def __call__(self, images, tokens):
         cfg = self.cfg
 
-        # ---- vision tower: ViT trunk + linear projection ----
-        vcfg = cfg.vision_cfg()
-        b = images.shape[0]
-        x = nn.Conv(vcfg.d_model,
-                    kernel_size=(vcfg.patch_size, vcfg.patch_size),
-                    strides=(vcfg.patch_size, vcfg.patch_size),
-                    name="patch_embed", dtype=cfg.dtype)(
-                        images.astype(cfg.dtype))
-        x = x.reshape(b, -1, vcfg.d_model)
-        cls = self.param("cls_token", nn.initializers.zeros,
-                         (1, 1, vcfg.d_model))
-        x = jnp.concatenate(
-            [jnp.broadcast_to(cls, (b, 1, vcfg.d_model)).astype(cfg.dtype),
-             x], axis=1)
-        pos = self.param("vision_pos_embed", nn.initializers.normal(0.02),
-                         (1, vcfg.n_patches + 1, vcfg.d_model))
-        x = x + pos.astype(cfg.dtype)
-        for i in range(vcfg.n_layers):
-            x = ViTBlock(vcfg, name=f"vision_layer_{i}")(x)
-        x = layer_norm(
-            x, self.param("vision_ln_scale", nn.initializers.ones,
-                          (vcfg.d_model,)),
-            self.param("vision_ln_bias", nn.initializers.zeros,
-                       (vcfg.d_model,)))
+        # ---- vision tower: shared ViT trunk + linear projection ----
+        x = ViTTrunk(cfg.vision_cfg(), name="vision_trunk")(images)
         img_emb = nn.Dense(cfg.embed_dim, use_bias=False,
                            name="vision_proj",
                            dtype=jnp.float32)(x[:, 0].astype(jnp.float32))
 
-        # ---- text tower: causal transformer, pooled at last token ----
+        # ---- text tower: causal ViTBlocks, pooled at EOT ----
         t = nn.Embed(cfg.vocab_size, cfg.text_d_model, name="token_embed",
                      dtype=cfg.dtype,
                      embedding_init=nn.initializers.normal(0.02))(tokens)
         tpos = self.param("text_pos_embed", nn.initializers.normal(0.02),
                           (1, cfg.max_text_len, cfg.text_d_model))
         t = t + tpos[:, :tokens.shape[1]].astype(cfg.dtype)
+        tcfg = self.text_cfg()
         for i in range(cfg.text_layers):
-            t = _TextBlock(cfg, name=f"text_layer_{i}")(t)
+            t = ViTBlock(tcfg, causal=True, name=f"text_layer_{i}")(t)
         t = layer_norm(
             t, self.param("text_ln_scale", nn.initializers.ones,
                           (cfg.text_d_model,)),
             self.param("text_ln_bias", nn.initializers.zeros,
                        (cfg.text_d_model,)))
+        eot = jnp.argmax(tokens, axis=-1)
+        pooled = t[jnp.arange(tokens.shape[0]), eot]
         txt_emb = nn.Dense(cfg.embed_dim, use_bias=False, name="text_proj",
-                           dtype=jnp.float32)(
-                               t[:, -1].astype(jnp.float32))
+                           dtype=jnp.float32)(pooled.astype(jnp.float32))
 
         logit_scale = self.param("logit_scale",
                                  nn.initializers.constant(2.6592), ())

@@ -52,7 +52,10 @@ class ViTConfig:
 
 
 class ViTBlock(nn.Module):
+    """Pre-norm MHA + GELU MLP. Also serves as the CLIP text block with
+    causal=True (the only difference between the towers)."""
     cfg: ViTConfig
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -67,7 +70,7 @@ class ViTBlock(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        att = multi_head_attention(q, k, v, causal=False)
+        att = multi_head_attention(q, k, v, causal=self.causal)
         x = x + nn.Dense(d, name="o_proj", dtype=cfg.dtype)(
             att.reshape(b, s, d))
         h = layer_norm(x,
@@ -79,8 +82,13 @@ class ViTBlock(nn.Module):
         return x
 
 
-class ViT(nn.Module):
-    """images (B, H, W, C) float -> logits (B, num_classes) fp32."""
+class ViTTrunk(nn.Module):
+    """Patchify -> [cls | patches] + pos -> encoder blocks -> final LN.
+
+    Returns the full (B, n_patches+1, d_model) sequence; classifiers pool
+    it, CLIP projects x[:, 0]. Shared by ViT and CLIP so the towers can't
+    drift apart.
+    """
     cfg: ViTConfig
 
     @nn.compact
@@ -103,10 +111,20 @@ class ViT(nn.Module):
         x = x + pos.astype(cfg.dtype)
         for i in range(cfg.n_layers):
             x = ViTBlock(cfg, name=f"layer_{i}")(x)
-        x = layer_norm(
+        return layer_norm(
             x, self.param("ln_f_scale", nn.initializers.ones,
                           (cfg.d_model,)),
             self.param("ln_f_bias", nn.initializers.zeros, (cfg.d_model,)))
+
+
+class ViT(nn.Module):
+    """images (B, H, W, C) float -> logits (B, num_classes) fp32."""
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        x = ViTTrunk(cfg, name="trunk")(images)
         feat = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
         return nn.Dense(cfg.num_classes, name="head",
                         dtype=jnp.float32)(feat.astype(jnp.float32))

@@ -1,0 +1,120 @@
+"""C++ shm arena: create/seal/get/release/delete, refcount, LRU eviction,
+zero-copy, multiprocess attach (SURVEY §2.1 C6)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native.store_binding import NativeStore
+from ray_tpu.exceptions import ObjectLostError, ObjectStoreFullError
+
+
+@pytest.fixture()
+def store():
+    s = NativeStore(capacity_bytes=32 << 20, is_owner=True)
+    yield s
+    s.shutdown()
+
+
+def test_roundtrip_large_ndarray(store):
+    arr = np.arange(1 << 20, dtype=np.float32)
+    loc = store.put_value("obj-a", arr)
+    assert loc.kind == "native"
+    out = store.get_value(loc)
+    np.testing.assert_array_equal(out, arr)
+    store.release("obj-a")
+
+
+def test_small_objects_stay_inline(store):
+    loc = store.put_value("obj-s", {"x": 1})
+    assert loc.kind == "inline"
+    assert store.get_value(loc) == {"x": 1}
+    assert store.num_objects() == 0
+
+
+def test_zero_copy_read(store):
+    arr = np.ones(1 << 20, dtype=np.uint8)
+    loc = store.put_value("obj-z", arr)
+    out = store.get_value(loc)
+    assert not out.flags["OWNDATA"]
+
+
+def test_duplicate_put_rejected(store):
+    arr = np.zeros(1 << 18, dtype=np.uint8)
+    store.put_value("obj-d", arr)
+    with pytest.raises(ValueError):
+        store.put_value("obj-d", arr)
+
+
+def test_lru_eviction_frees_unpinned(store):
+    for i in range(10):   # 10 x 4MB into a 32MB arena
+        store.put_value(f"obj-f{i}", np.zeros(4 << 20, dtype=np.uint8))
+    assert store.num_objects() < 10
+    # newest object survived
+    assert store.contains("obj-f9")
+    assert not store.contains("obj-f0")
+
+
+def test_pinned_objects_not_evicted(store):
+    arr = np.zeros(4 << 20, dtype=np.uint8)
+    loc = store.put_value("obj-pin", arr)
+    _view = store.get_value(loc)   # pins obj-pin while the view lives
+    for i in range(10):
+        store.put_value(f"obj-g{i}", np.zeros(4 << 20, dtype=np.uint8))
+    assert store.contains("obj-pin")
+    del _view
+
+
+def test_get_after_eviction_raises(store):
+    loc = store.put_value("obj-e", np.zeros(4 << 20, dtype=np.uint8))
+    for i in range(10):
+        store.put_value(f"obj-h{i}", np.zeros(4 << 20, dtype=np.uint8))
+    with pytest.raises(ObjectLostError):
+        store.get_value(loc)
+
+
+def test_oversized_put_raises(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.put_value("obj-big", np.zeros(64 << 20, dtype=np.uint8))
+
+
+def test_delete_frees_space(store):
+    loc = store.put_value("obj-del", np.zeros(8 << 20, dtype=np.uint8))
+    used = store.used_bytes()
+    store.delete_segment(loc.name, loc.size)
+    assert store.used_bytes() < used
+    assert not store.contains("obj-del")
+
+
+def test_deferred_delete_until_last_view_dies(store):
+    import gc
+    loc = store.put_value("obj-dd", np.zeros(1 << 20, dtype=np.uint8))
+    view = store.get_value(loc)          # pins via _Pin lifetime
+    store.delete_segment(loc.name, 0)    # defers: still pinned
+    assert view[0] == 0                  # pages still valid
+    n_before = store.num_objects()
+    del view                             # last view dies -> unpin -> free
+    gc.collect()
+    assert store.num_objects() == n_before - 1
+
+
+def _child_reads(loc_tuple, q):
+    from ray_tpu.core.object_store import ObjectLocation
+    from ray_tpu._native.store_binding import NativeStore
+    s = NativeStore(capacity_bytes=32 << 20, is_owner=False)
+    out = s.get_value(ObjectLocation(*loc_tuple))
+    q.put(int(out.sum()))
+
+
+def test_multiprocess_attach(store):
+    arr = np.ones(1 << 20, dtype=np.int64)
+    loc = store.put_value("obj-mp", arr)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reads,
+                    args=((loc.kind, loc.size, loc.data, loc.name), q))
+    p.start()
+    result = q.get(timeout=30)
+    p.join(timeout=10)
+    assert result == 1 << 20
