@@ -176,19 +176,24 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     rt = runtime_mod.get_runtime()
-    if not rt.is_driver:
-        raise RuntimeNotInitializedError(
-            "get_actor from workers not yet supported")
-    ns = namespace or rt.namespace
+    ns = namespace or getattr(rt, "namespace", "default")
     # Creation registers the name asynchronously in the dispatcher; poll
     # briefly so `Actor.options(name=...).remote(); get_actor(name)` works.
     import time as _time
     deadline = _time.time() + 2.0
     while True:
-        aid = rt.gcs.lookup_named_actor(ns, name)
-        if aid is not None:
-            entry = rt.gcs.actors[aid]
-            return ActorHandle(aid, entry.class_name)
+        if rt.is_driver:
+            aid = rt.gcs.lookup_named_actor(ns, name)
+            found = None if aid is None \
+                else (aid, rt.gcs.actors[aid].class_name)
+        else:
+            # Workers resolve names through the driver's GCS. A worker has
+            # no namespace attribute: send the explicit namespace or None,
+            # and the driver substitutes its own default for None.
+            found = rt.report_sync("sys.lookup_actor", (namespace, name),
+                                   timeout=5.0)
+        if found is not None:
+            return ActorHandle(found[0], found[1])
         if _time.time() > deadline:
             raise ValueError(f"no actor named {name!r} in namespace {ns!r}")
         _time.sleep(0.01)

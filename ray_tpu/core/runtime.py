@@ -151,6 +151,15 @@ class DriverRuntime:
         self._shutdown = threading.Event()
         self._conn_by_wid: Dict[str, Connection] = {}
 
+        self.report_handlers["sys.lookup_actor"] = self._sys_lookup_actor
+
+        # Backstop for drivers that exit without calling shutdown() (e.g.
+        # a pytest process): workers self-exit on socket close, but the shm
+        # arena needs an explicit owner-side unlink or it outlives us in
+        # /dev/shm.
+        import atexit
+        atexit.register(self.shutdown)
+
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="rtpu-dispatch")
         self._dispatcher.start()
@@ -921,6 +930,16 @@ class DriverRuntime:
 
     def register_report_handler(self, channel: str, fn: Callable) -> None:
         self.report_handlers[channel] = fn
+
+    def _sys_lookup_actor(self, _wid, payload) -> Optional[tuple]:
+        """Built-in report_sync channel backing get_actor() from workers."""
+        ns, name = payload
+        if ns is None:
+            ns = self.namespace
+        aid = self.gcs.lookup_named_actor(ns, name)
+        if aid is None:
+            return None
+        return aid, self.gcs.actors[aid].class_name
 
     def placement_group(self, bundles, strategy="PACK", name="") -> "PlacementGroupState":
         from .ids import new_placement_group_id  # noqa: PLC0415

@@ -1,0 +1,40 @@
+"""ray_tpu.serve — scalable model serving (reference: python/ray/serve).
+
+Control plane: a controller actor reconciles replica actors per
+deployment (health checks, autoscaling, rolling updates). Data plane:
+client-side power-of-two-choices routing straight to replica actors, a
+stdlib HTTP ingress, @batch coalescing (keeps the MXU fed), @multiplexed
+model caches, and a JAX continuous-batching LLM engine (serve.llm).
+"""
+from __future__ import annotations
+
+import importlib
+
+from .api import (run, start, status, delete, shutdown, get_app_handle,
+                  get_deployment_handle)
+from .batching import batch
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .deployment import Application, Deployment, deployment_decorator
+from .handle import (BackPressureError, DeploymentHandle,
+                     DeploymentResponse, DeploymentResponseGenerator)
+from .multiplex import get_multiplexed_model_id, multiplexed
+
+deployment = deployment_decorator
+
+
+def __getattr__(name):
+    if name == "llm":
+        mod = importlib.import_module(".llm", __name__)
+        globals()["llm"] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu.serve' has no attribute {name!r}")
+
+
+__all__ = [
+    "run", "start", "status", "delete", "shutdown", "get_app_handle",
+    "get_deployment_handle", "batch", "AutoscalingConfig",
+    "DeploymentConfig", "HTTPOptions", "Application", "Deployment",
+    "deployment", "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator", "BackPressureError",
+    "get_multiplexed_model_id", "multiplexed", "llm",
+]

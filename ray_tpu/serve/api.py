@@ -1,0 +1,171 @@
+"""serve.run / shutdown / status — the user-facing control API.
+
+Reference parity: python/ray/serve/api.py (run, delete, status,
+get_deployment_handle, get_app_handle) + _private/api.py (controller
+bootstrap). The application graph from `.bind()` is flattened here:
+nested Applications in init args are deployed too and replaced with
+DeploymentHandles before the args ship to replicas.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .config import HTTPOptions
+from .controller import CONTROLLER_NAME, ServeController
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+
+_DEFAULT_APP = "default"
+
+
+def _get_or_start_controller(http_options: Optional[HTTPOptions] = None):
+    import ray_tpu
+    ray_tpu.init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001  not started yet
+        opts = http_options or HTTPOptions()
+        ctrl = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, max_concurrency=16).remote(
+            {"host": opts.host, "port": opts.port,
+             "root_path": opts.root_path})
+        ray_tpu.get(ctrl.ping.remote())
+        return ctrl
+
+
+def start(http_options: Optional[HTTPOptions] = None, **_kw):
+    """Explicitly start the serve instance (reference: serve.start)."""
+    return _get_or_start_controller(http_options)
+
+
+def _flatten_app(app: Application, app_name: str,
+                 out: Dict[str, dict], is_ingress: bool) -> DeploymentHandle:
+    """DFS the bound graph; returns the handle standing in for `app`."""
+    d = app.deployment
+
+    def convert(v):
+        if isinstance(v, Application):
+            return _flatten_app(v, app_name, out, is_ingress=False)
+        return v
+
+    args = tuple(convert(a) for a in app._args)
+    kwargs = {k: convert(v) for k, v in app._kwargs.items()}
+    if d.name in out:
+        prev = out[d.name]
+        if (prev["version"] != d.version_hash()
+                or prev["init_args"] != args
+                or prev["init_kwargs"] != kwargs):
+            raise ValueError(
+                f"two deployments named {d.name!r} with different code or "
+                f"init args in one app; use .options(name=...) to "
+                f"disambiguate")
+    else:
+        out[d.name] = {
+            "name": d.name,
+            "callable_bytes": d.callable_bytes(),
+            "init_args": args,
+            "init_kwargs": kwargs,
+            "config": d.config.to_dict(),
+            "version": d.version_hash(),
+            "route_prefix": d.route_prefix if is_ingress else None,
+            "is_ingress": is_ingress,
+        }
+    return DeploymentHandle(d.name, app_name)
+
+
+def run(target: Application, *, name: str = _DEFAULT_APP,
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _local_testing_mode: bool = False,
+        wait_for_ready_timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress."""
+    import ray_tpu
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application (from .bind()); "
+                        f"got {type(target)}")
+    if route_prefix is not None:
+        ingress_d = target.deployment
+        if ingress_d.route_prefix != route_prefix:
+            target = Application(
+                ingress_d.options(route_prefix=route_prefix),
+                target._args, target._kwargs)
+    ctrl = _get_or_start_controller()
+    deployments: Dict[str, dict] = {}
+    ingress_handle = _flatten_app(target, name, deployments, is_ingress=True)
+    ray_tpu.get(ctrl.deploy_application.remote(
+        name, list(deployments.values())))
+    _wait_healthy(ctrl, name, wait_for_ready_timeout_s)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return ingress_handle
+
+
+def _wait_healthy(ctrl, app_name: str, timeout_s: float):
+    import ray_tpu
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status = ray_tpu.get(ctrl.get_app_status.remote(app_name))
+        if status["status"] == "RUNNING" or (
+                status["deployments"]
+                and all(d["replicas"] >= d["target"] and d["target"] > 0
+                        for d in status["deployments"].values())):
+            return
+        if status["status"] == "DEPLOY_FAILED":
+            raise RuntimeError(f"deploy failed: {status}")
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"app {app_name!r} not healthy after {timeout_s}s")
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+    ctrl = _get_or_start_controller()
+    apps = ray_tpu.get(ctrl.list_applications.remote())
+    return {"applications": {
+        a: ray_tpu.get(ctrl.get_app_status.remote(a)) for a in apps}}
+
+
+def delete(name: str, _blocking: bool = True):
+    import ray_tpu
+    ctrl = _get_or_start_controller()
+    ray_tpu.get(ctrl.delete_application.remote(name))
+
+
+def get_app_handle(name: str = _DEFAULT_APP) -> DeploymentHandle:
+    import ray_tpu
+    ctrl = _get_or_start_controller()
+    routes = ray_tpu.get(ctrl.get_routes.remote())
+    for _prefix, (app, dep) in routes.items():
+        if app == name:
+            return DeploymentHandle(dep, app)
+    apps = ray_tpu.get(ctrl.list_applications.remote())
+    if name in apps and apps[name]:
+        return DeploymentHandle(apps[name][0], name)
+    raise KeyError(f"no application named {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = _DEFAULT_APP) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def shutdown():
+    """Tear down all serve apps and the controller."""
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        return
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(ctrl.graceful_shutdown.remote(), timeout=10)
+        ray_tpu.kill(ctrl)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,331 @@
+"""Serve controller actor: reconciles deployment state.
+
+Reference parity: python/ray/serve/_private/controller.py +
+deployment_state.py (target-state reconciliation, health checks, rolling
+updates) and autoscaling_state.py (metrics-driven replica counts). One
+controller actor per cluster; a background thread runs the reconcile loop
+so control-plane progress never depends on incoming calls.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .config import DeploymentConfig, ReplicaInfo
+
+CONTROLLER_NAME = "_SERVE_CONTROLLER"
+_LOOP_PERIOD_S = 0.25
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, name: str, callable_bytes: bytes,
+                 init_args, init_kwargs, config: DeploymentConfig,
+                 version: str, route_prefix: Optional[str], is_ingress: bool):
+        self.app_name = app_name
+        self.name = name
+        self.callable_bytes = callable_bytes
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.version = version
+        self.route_prefix = route_prefix
+        self.is_ingress = is_ingress
+        self.replicas: List[ReplicaInfo] = []
+        self.target_num: int = self._initial_target()
+        self._replica_seq = 0
+        self._last_metrics: Dict[str, float] = {}
+        self._ongoing_history: List[tuple] = []  # (ts, total_ongoing)
+        self._last_scale_ts = 0.0
+        self._start_failures = 0  # consecutive replica-init failures
+        self.status = "UPDATING"
+        self.message = ""
+
+    def _initial_target(self) -> int:
+        ac = self.config.autoscaling_config
+        if ac is not None:
+            return ac.initial_replicas if ac.initial_replicas is not None \
+                else ac.min_replicas
+        return self.config.num_replicas
+
+    def next_replica_id(self) -> str:
+        self._replica_seq += 1
+        return f"{self.app_name}#{self.name}#{self._replica_seq}"
+
+
+class ServeController:
+    """Actor. Owns all deployment state; creates/destroys replica actors."""
+
+    def __init__(self, http_options: Optional[dict] = None):
+        self._deployments: Dict[str, _DeploymentState] = {}  # key: app/name
+        self._apps: Dict[str, List[str]] = {}  # app -> deployment keys
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._http_options = http_options or {}
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-controller")
+        self._loop_thread.start()
+
+    # ---- API called by serve.api ------------------------------------------
+    def deploy_application(self, app_name: str,
+                           deployments: List[dict]) -> None:
+        """Set target state for an app. Idempotent; changed versions roll."""
+        with self._lock:
+            keys = []
+            for d in deployments:
+                key = f"{app_name}/{d['name']}"
+                keys.append(key)
+                cfg = DeploymentConfig(**d["config"])
+                existing = self._deployments.get(key)
+                if existing is None:
+                    self._deployments[key] = _DeploymentState(
+                        app_name, d["name"], d["callable_bytes"],
+                        d["init_args"], d["init_kwargs"], cfg, d["version"],
+                        d.get("route_prefix"), d.get("is_ingress", False))
+                else:
+                    existing.callable_bytes = d["callable_bytes"]
+                    existing.init_args = d["init_args"]
+                    existing.init_kwargs = d["init_kwargs"]
+                    existing.config = cfg
+                    existing.route_prefix = d.get("route_prefix")
+                    existing.is_ingress = d.get("is_ingress", False)
+                    if existing.version != d["version"]:
+                        existing.version = d["version"]
+                        existing.status = "UPDATING"
+                    existing._start_failures = 0  # redeploy resets backoff
+                    if existing.config.autoscaling_config is None:
+                        existing.target_num = cfg.num_replicas
+            # drop deployments removed from the app
+            for key in list(self._apps.get(app_name, [])):
+                if key not in keys:
+                    self._stop_deployment(key)
+            self._apps[app_name] = keys
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for key in self._apps.pop(app_name, []):
+                self._stop_deployment(key)
+
+    def list_applications(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {a: [k.split("/", 1)[1] for k in keys]
+                    for a, keys in self._apps.items()}
+
+    def get_replicas(self, app_name: str, deployment_name: str) -> List[Any]:
+        """Routing table for handles: [(replica_id, actor_handle), ...]."""
+        with self._lock:
+            st = self._deployments.get(f"{app_name}/{deployment_name}")
+            if st is None:
+                return []
+            return [(r.replica_id, r.actor_handle) for r in st.replicas
+                    if r.state == "RUNNING"]
+
+    def get_deployment_info(self, app_name: str,
+                            deployment_name: str) -> Optional[dict]:
+        with self._lock:
+            st = self._deployments.get(f"{app_name}/{deployment_name}")
+            if st is None:
+                return None
+            return {"name": st.name, "app": st.app_name,
+                    "version": st.version, "status": st.status,
+                    "message": st.message,
+                    "target_num_replicas": st.target_num,
+                    "num_running": sum(1 for r in st.replicas
+                                       if r.state == "RUNNING"),
+                    "route_prefix": st.route_prefix,
+                    "is_ingress": st.is_ingress,
+                    "max_ongoing_requests":
+                        st.config.max_ongoing_requests,
+                    "max_queued_requests":
+                        st.config.max_queued_requests}
+
+    def get_app_status(self, app_name: str) -> dict:
+        with self._lock:
+            keys = self._apps.get(app_name, [])
+            deps = {}
+            overall = "RUNNING"  # reference ApplicationStatus: RUNNING=ok
+            for key in keys:
+                st = self._deployments[key]
+                deps[st.name] = {"status": st.status,
+                                 "replicas": len([r for r in st.replicas
+                                                  if r.state == "RUNNING"]),
+                                 "target": st.target_num}
+                if st.status == "DEPLOY_FAILED":
+                    overall = "DEPLOY_FAILED"
+                elif st.status != "HEALTHY" and overall == "RUNNING":
+                    overall = "DEPLOYING"
+            return {"app": app_name, "status": overall,
+                    "deployments": deps}
+
+    def get_http_config(self) -> dict:
+        return dict(self._http_options)
+
+    def get_routes(self) -> Dict[str, tuple]:
+        """route_prefix -> (app_name, ingress deployment name)."""
+        with self._lock:
+            routes = {}
+            for key, st in self._deployments.items():
+                if st.is_ingress and st.route_prefix is not None:
+                    routes[st.route_prefix] = (st.app_name, st.name)
+            return routes
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            for app in list(self._apps):
+                self.delete_application(app)
+        self._shutdown.set()
+
+    def ping(self) -> bool:
+        return True
+
+    # ---- reconcile loop ---------------------------------------------------
+    def _control_loop(self) -> None:
+        import ray_tpu
+        while not self._shutdown.is_set():
+            try:
+                with self._lock:
+                    keys = list(self._deployments.keys())
+                for key in keys:
+                    # metric collection blocks on replicas -> outside lock
+                    self._collect_autoscale_metrics(ray_tpu, key)
+                    self._reconcile(ray_tpu, key)
+            except Exception:  # noqa: BLE001  control loop must survive
+                import traceback
+                traceback.print_exc()
+            self._shutdown.wait(_LOOP_PERIOD_S)
+
+    _MAX_START_FAILURES = 3
+
+    def _reconcile(self, ray_tpu, key: str) -> None:
+        with self._lock:
+            # re-check under lock: the app may have been deleted between
+            # the loop's snapshot and now (else we'd resurrect replicas
+            # onto an orphaned state object).
+            st = self._deployments.get(key)
+            if st is None:
+                return
+            self._check_started(ray_tpu, st)
+            self._apply_autoscale_decision(st)
+            running = [r for r in st.replicas if r.state == "RUNNING"]
+            starting = [r for r in st.replicas if r.state == "STARTING"]
+            # version rollout: replace at most one stale replica per tick,
+            # only when we're at/above target so capacity never dips.
+            stale = [r for r in running if r.version != st.version]
+            if stale and len(running) + len(starting) >= st.target_num:
+                self._stop_replica(ray_tpu, st, stale[0])
+            live = [r for r in st.replicas
+                    if r.state in ("RUNNING", "STARTING")]
+            if len(live) < st.target_num:
+                if st._start_failures < self._MAX_START_FAILURES:
+                    for _ in range(st.target_num - len(live)):
+                        self._start_replica(ray_tpu, st)
+                # else: stay DEPLOY_FAILED until a redeploy resets backoff
+            elif len(live) > st.target_num:
+                # prefer stopping stale, then newest
+                extras = sorted(
+                    live, key=lambda r: (r.version == st.version,
+                                         r.replica_id))
+                for r in extras[:len(live) - st.target_num]:
+                    self._stop_replica(ray_tpu, st, r)
+            current = [r for r in st.replicas if r.state == "RUNNING"]
+            if (len(current) >= st.target_num
+                    and all(r.version == st.version for r in current)):
+                st.status = "HEALTHY"
+            st.replicas = [r for r in st.replicas if r.state != "DEAD"]
+
+    def _start_replica(self, ray_tpu, st: _DeploymentState) -> None:
+        from .replica import Replica
+        rid = st.next_replica_id()
+        opts = dict(st.config.ray_actor_options)
+        opts.setdefault("max_concurrency", st.config.max_ongoing_requests + 8)
+        handle = ray_tpu.remote(Replica).options(**opts).remote(
+            st.name, rid, st.callable_bytes, st.init_args, st.init_kwargs,
+            user_config=st.config.user_config,
+            max_ongoing_requests=st.config.max_ongoing_requests)
+        info = ReplicaInfo(replica_id=rid, deployment_name=st.name,
+                           app_name=st.app_name, version=st.version,
+                           actor_handle=handle, state="STARTING",
+                           start_ref=handle.ready.remote())
+        st.replicas.append(info)
+
+    def _check_started(self, ray_tpu, st: _DeploymentState) -> None:
+        for r in st.replicas:
+            if r.state != "STARTING":
+                continue
+            ready, _ = ray_tpu.wait([r.start_ref], timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(r.start_ref)
+                    r.state = "RUNNING"
+                    st._start_failures = 0
+                except Exception as e:  # noqa: BLE001  init failed
+                    r.state = "DEAD"
+                    st._start_failures += 1
+                    st.status = "DEPLOY_FAILED"
+                    st.message = repr(e)
+
+    def _stop_replica(self, ray_tpu, st: _DeploymentState,
+                      r: ReplicaInfo) -> None:
+        r.state = "DEAD"
+        try:
+            ray_tpu.kill(r.actor_handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _stop_deployment(self, key: str) -> None:
+        import ray_tpu
+        st = self._deployments.pop(key, None)
+        if st is None:
+            return
+        for r in st.replicas:
+            self._stop_replica(ray_tpu, st, r)
+
+    def _collect_autoscale_metrics(self, ray_tpu, key: str) -> None:
+        """Poll replica queue lengths WITHOUT holding the controller lock
+        (the 0.2s wait would otherwise stall routing-table RPCs)."""
+        with self._lock:
+            st = self._deployments.get(key)
+            if st is None or st.config.autoscaling_config is None:
+                return
+            running = [r for r in st.replicas if r.state == "RUNNING"]
+            refs = [r.actor_handle.get_queue_len.remote() for r in running]
+        if not refs:
+            return
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.2)
+        total = 0.0
+        for ref in ready:
+            try:
+                total += ray_tpu.get(ref)
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            st = self._deployments.get(key)
+            if st is None:
+                return
+            now = time.time()
+            ac = st.config.autoscaling_config
+            st._ongoing_history.append((now, total))
+            cutoff = now - ac.look_back_period_s
+            st._ongoing_history = [(t, v) for t, v in st._ongoing_history
+                                   if t >= cutoff]
+
+    def _apply_autoscale_decision(self, st: _DeploymentState) -> None:
+        """Pure state update from already-collected metrics; lock held."""
+        ac = st.config.autoscaling_config
+        if ac is None or not st._ongoing_history:
+            return
+        running = [r for r in st.replicas if r.state == "RUNNING"]
+        if not running:
+            return
+        now = time.time()
+        avg = (sum(v for _, v in st._ongoing_history)
+               / max(len(st._ongoing_history), 1))
+        desired = ac.desired_replicas(avg, len(running))
+        if desired > st.target_num:
+            if now - st._last_scale_ts >= ac.upscale_delay_s:
+                st.target_num = desired
+                st._last_scale_ts = now
+        elif desired < st.target_num:
+            if now - st._last_scale_ts >= ac.downscale_delay_s:
+                st.target_num = desired
+                st._last_scale_ts = now

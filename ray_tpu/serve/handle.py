@@ -1,0 +1,291 @@
+"""DeploymentHandle: the client-side request path.
+
+Reference parity: python/ray/serve/handle.py (DeploymentHandle,
+DeploymentResponse) + _private/replica_scheduler/pow_2_scheduler.py.
+Routing is client-side power-of-two-choices over in-flight counts the
+handle tracks locally, with the replica set refreshed from the controller.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..exceptions import RayTpuError
+
+_REPLICA_REFRESH_S = 1.0
+
+
+class BackPressureError(RayTpuError):
+    """Raised when max_queued_requests would be exceeded."""
+
+
+class DeploymentResponse:
+    """Future for one request. `.result()` blocks; awaitable in async code;
+    passable to another `.remote()` call (resolves to the ObjectRef).
+
+    If the serving replica died (e.g. killed during a rolling update), the
+    request is transparently re-routed to a live replica, up to
+    `max_retries` times (reference: serve retries replica-death failures
+    at the router).
+    """
+
+    def __init__(self, ref, on_done=None, resubmit=None, max_retries=3):
+        self._ref = ref
+        self._on_done = on_done
+        self._resubmit = resubmit
+        self._max_retries = max_retries
+        self._done = False
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            if self._on_done is not None:
+                self._on_done()
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+        from ..exceptions import ActorDiedError
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except ActorDiedError:
+            if self._resubmit is None or self._max_retries <= 0:
+                raise
+            retry = self._resubmit()
+            retry._max_retries = self._max_retries - 1
+            self._ref = retry._ref
+            return retry.result(timeout_s=timeout_s)
+        finally:
+            self._settle()
+
+    def __await__(self):
+        def _done(v):
+            self._settle()
+            return v
+        return (yield from self._ref.__await__())
+
+    @property
+    def object_ref(self):
+        return self._ref
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to pull chunks from the replica."""
+
+    def __init__(self, replica_handle, stream_id_ref, on_done=None):
+        self._replica = replica_handle
+        self._stream_id_ref = stream_id_ref
+        self._stream_id = None
+        self._buffer: List[Any] = []
+        self._finished = False
+        self._on_done = on_done
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+        if self._buffer:
+            return self._buffer.pop(0)
+        if self._finished:
+            raise StopIteration
+        if self._stream_id is None:
+            self._stream_id = ray_tpu.get(self._stream_id_ref)
+        while not self._buffer:
+            chunks, done = ray_tpu.get(
+                self._replica.stream_next.remote(self._stream_id))
+            self._buffer.extend(chunks)
+            if done:
+                self._finished = True
+                if self._on_done is not None:
+                    self._on_done()
+                break
+        if self._buffer:
+            return self._buffer.pop(0)
+        raise StopIteration
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return next(self)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+
+class _RouterState:
+    """Shared per-(app, deployment) routing state.
+
+    In-flight accounting is by pending ObjectRef: a request stops counting
+    against its replica the moment the replica finishes it (pruned via
+    wait(timeout=0)), NOT when the caller reads the result — so issuing
+    many .remote() calls before consuming any cannot deadlock routing.
+    Streams (no single completion ref) use a manual count released when
+    the generator finishes.
+    """
+
+    def __init__(self):
+        self.replicas: List[tuple] = []  # (replica_id, actor_handle)
+        self.pending: Dict[str, list] = {}   # replica_id -> [ObjectRef]
+        self.manual: Dict[str, int] = {}     # replica_id -> stream count
+        self.last_refresh = 0.0
+        self.lock = threading.Lock()
+        self.max_ongoing = 5
+        self.max_queued = -1
+        self.queued = 0
+
+    def prune(self):
+        """Drop refs whose tasks completed. Caller must NOT hold lock."""
+        import ray_tpu
+        with self.lock:
+            all_refs = [ref for refs in self.pending.values()
+                        for ref in refs]
+        if not all_refs:
+            return
+        ready, _ = ray_tpu.wait(all_refs, num_returns=len(all_refs),
+                                timeout=0)
+        done = {r.id for r in ready}
+        with self.lock:
+            for rid in self.pending:
+                self.pending[rid] = [r for r in self.pending[rid]
+                                     if r.id not in done]
+
+    def load(self, replica_id: str) -> int:
+        return (len(self.pending.get(replica_id, ()))
+                + self.manual.get(replica_id, 0))
+
+
+class DeploymentHandle:
+    """Serializable handle for calling a deployment from anywhere."""
+
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
+        self._deployment = deployment_name
+        self._app = app_name
+        self._method = method_name
+        self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
+        self._router = _RouterState()
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._deployment, self._app, self._method, self._stream,
+                 self._multiplexed_model_id))
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None,
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._deployment, self._app,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._multiplexed_model_id)
+        h._router = self._router  # share in-flight accounting
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _BoundMethod(self, name)
+
+    # ---- routing ----------------------------------------------------------
+    def _controller(self):
+        import ray_tpu
+        return ray_tpu.get_actor("_SERVE_CONTROLLER")
+
+    def _refresh_replicas(self, force: bool = False):
+        import ray_tpu
+        r = self._router
+        now = time.time()
+        if not force and r.replicas and \
+                now - r.last_refresh < _REPLICA_REFRESH_S:
+            return
+        ctrl = self._controller()
+        replicas = ray_tpu.get(
+            ctrl.get_replicas.remote(self._app, self._deployment))
+        info = ray_tpu.get(
+            ctrl.get_deployment_info.remote(self._app, self._deployment))
+        with r.lock:
+            r.replicas = replicas
+            r.last_refresh = now
+            if info:
+                r.max_ongoing = info["max_ongoing_requests"]
+                r.max_queued = info["max_queued_requests"]
+
+    def _pick_replica(self, deadline_s: float = 30.0):
+        """Power-of-two-choices on pending-request counts; blocks
+        (bounded) when every replica is at max_ongoing_requests."""
+        r = self._router
+        start = time.time()
+        while True:
+            self._refresh_replicas(force=not r.replicas)
+            r.prune()
+            with r.lock:
+                candidates = r.replicas
+                if candidates:
+                    if len(candidates) == 1:
+                        chosen = candidates[0]
+                    else:
+                        a, b = random.sample(candidates, 2)
+                        chosen = a if r.load(a[0]) <= r.load(b[0]) else b
+                    if r.load(chosen[0]) < r.max_ongoing:
+                        return chosen
+            if time.time() - start > deadline_s:
+                raise TimeoutError(
+                    f"no capacity on {self._deployment} after {deadline_s}s")
+            time.sleep(0.02)
+
+    def remote(self, *args, **kwargs):
+        r = self._router
+        with r.lock:
+            if r.max_queued >= 0 and r.queued >= r.max_queued:
+                raise BackPressureError(
+                    f"{self._deployment}: max_queued_requests "
+                    f"({r.max_queued}) exceeded")
+            r.queued += 1
+        try:
+            replica_id, handle = self._pick_replica()
+        finally:
+            with r.lock:
+                r.queued -= 1
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        if self._multiplexed_model_id:
+            kwargs["__serve_multiplexed_model_id"] = \
+                self._multiplexed_model_id
+        if self._stream:
+            with r.lock:
+                r.manual[replica_id] = r.manual.get(replica_id, 0) + 1
+
+            def done():
+                with r.lock:
+                    r.manual[replica_id] = max(
+                        0, r.manual.get(replica_id, 1) - 1)
+            sid_ref = handle.stream_start.remote(self._method, args, kwargs)
+            return DeploymentResponseGenerator(handle, sid_ref, on_done=done)
+        ref = handle.handle_request.remote(self._method, args, kwargs)
+        with r.lock:
+            r.pending.setdefault(replica_id, []).append(ref)
+
+        def resubmit(a=args, kw=dict(kwargs)):
+            r.last_refresh = 0.0  # force a routing-table refresh
+            return self.remote(*a, **kw)
+        return DeploymentResponse(ref, resubmit=resubmit)
+
+
+class _BoundMethod:
+    def __init__(self, handle: DeploymentHandle, method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle.options(method_name=self._method).remote(
+            *args, **kwargs)

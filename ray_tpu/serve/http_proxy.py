@@ -1,0 +1,160 @@
+"""HTTP ingress actor.
+
+Reference parity: python/ray/serve/_private/proxy.py + http_util.py —
+re-based on the stdlib ThreadingHTTPServer (no uvicorn/starlette in-image).
+Routes by longest-prefix match against the controller's route table; JSON
+in/out; `Accept: text/event-stream` upgrades the call to the streaming
+path and emits SSE `data:` events per chunk.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .handle import DeploymentHandle
+
+PROXY_NAME = "_SERVE_PROXY"
+
+
+class HTTPProxy:
+    """Actor: owns the HTTP server; refreshes routes from the controller."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._routes = {}           # prefix -> DeploymentHandle
+        self._routes_lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _match(self):
+                with proxy._routes_lock:
+                    routes = dict(proxy._routes)
+                path = self.path.split("?", 1)[0]
+                best = None
+                for prefix in sorted(routes, key=len, reverse=True):
+                    norm = prefix.rstrip("/") or "/"
+                    if path == norm or path.startswith(
+                            norm if norm == "/" else norm + "/"):
+                        best = routes[prefix]
+                        break
+                return best
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                ctype = self.headers.get("Content-Type", "")
+                if "application/json" in ctype and raw:
+                    return json.loads(raw)
+                return raw.decode() if raw else None
+
+            def _respond(self, code, body, ctype="application/json"):
+                data = body if isinstance(body, bytes) else body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _serialize(self, result):
+                if isinstance(result, bytes):
+                    return result, "application/octet-stream"
+                if isinstance(result, str):
+                    return result, "text/plain"
+                return json.dumps(result), "application/json"
+
+            def _handle(self):
+                handle = self._match()
+                if handle is None:
+                    self._respond(404, json.dumps(
+                        {"error": f"no route for {self.path}"}))
+                    return
+                try:
+                    body = self._body()
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._respond(400, json.dumps({"error": repr(e)}))
+                    return
+                wants_stream = "text/event-stream" in (
+                    self.headers.get("Accept") or "")
+                try:
+                    if wants_stream:
+                        gen = handle.options(stream=True).remote(body)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        for chunk in gen:
+                            payload, _ = self._serialize(chunk)
+                            if isinstance(payload, str):
+                                payload = payload.encode()
+                            event = b"data: " + payload + b"\n\n"
+                            self.wfile.write(
+                                f"{len(event):x}\r\n".encode()
+                                + event + b"\r\n")
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        result = handle.remote(body).result(timeout_s=60)
+                        payload, ctype = self._serialize(result)
+                        self._respond(200, payload, ctype)
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._respond(500, json.dumps({"error": repr(e)}))
+                    except Exception:  # noqa: BLE001  client went away
+                        pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serve-http").start()
+        threading.Thread(target=self._route_refresh_loop, daemon=True,
+                         name="serve-http-routes").start()
+
+    def _route_refresh_loop(self):
+        import time
+        import ray_tpu
+        from .controller import CONTROLLER_NAME
+        while True:
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                routes = ray_tpu.get(ctrl.get_routes.remote())
+                with self._routes_lock:
+                    self._routes = {
+                        prefix: DeploymentHandle(dep, app)
+                        for prefix, (app, dep) in routes.items()}
+            except Exception:  # noqa: BLE001  controller not up yet
+                pass
+            time.sleep(0.5)
+
+    def address(self):
+        return (self._host, self._port)
+
+    def ready(self) -> int:
+        return self._port
+
+    def ping(self) -> bool:
+        return True
+
+
+def start_proxy(host: str = "127.0.0.1", port: int = 8000):
+    """Start (or fetch) the proxy actor; returns (handle, bound_port)."""
+    import ray_tpu
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except Exception:  # noqa: BLE001
+        proxy = ray_tpu.remote(HTTPProxy).options(
+            name=PROXY_NAME, max_concurrency=8).remote(host, port)
+    bound = ray_tpu.get(proxy.ready.remote())
+    return proxy, bound

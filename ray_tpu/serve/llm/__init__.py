@@ -1,0 +1,86 @@
+"""ray_tpu.serve.llm — LLM serving on the continuous-batching engine.
+
+Reference parity: the fork's `serve.llm` vLLM integration
+(build_llm_deployment / LLMServer): one replica owns the TPU chip and an
+LLMEngine; requests stream tokens via the serve streaming path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..deployment import Application, deployment_decorator
+from .engine import LLMEngine, LLMEngineConfig
+
+
+class LLMServer:
+    """Deployment class wrapping an LLMEngine.
+
+    `model_factory` is a zero-arg callable returning (model, params) —
+    kept as a factory so weights load inside the replica process (on the
+    TPU host), not in the driver.
+    """
+
+    def __init__(self, model_factory, engine_config: Optional[dict] = None,
+                 tokenizer: Optional[Any] = None):
+        model, params = model_factory()
+        cfg = LLMEngineConfig(**(engine_config or {}))
+        self.engine = LLMEngine(model, params, cfg)
+        self.tokenizer = tokenizer
+
+    def _encode(self, prompt):
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "text prompt but no tokenizer configured; pass token "
+                    "ids or set tokenizer=")
+            return self.tokenizer.encode(prompt)
+        return prompt
+
+    def _decode_tok(self, tok: int):
+        if self.tokenizer is not None:
+            return self.tokenizer.decode([tok])
+        return tok
+
+    def __call__(self, body: Dict[str, Any]):
+        """Unary or streaming generate. body: {"prompt": [ids] | str,
+        "max_tokens": int, "temperature": float, "stream": bool}."""
+        prompt = self._encode(body["prompt"])
+        max_tokens = body.get("max_tokens")
+        temperature = float(body.get("temperature", 0.0))
+        rid = self.engine.submit(prompt, max_tokens, temperature)
+        if body.get("stream"):
+            def gen():
+                for tok in self.engine.stream(rid):
+                    yield self._decode_tok(tok)
+            return gen()
+        toks = list(self.engine.stream(rid))
+        if self.tokenizer is not None:
+            return {"text": self.tokenizer.decode(toks), "tokens": toks}
+        return {"tokens": toks}
+
+    def generate(self, body: Dict[str, Any]):
+        return self(body)
+
+    def stats(self, _body=None) -> Dict[str, Any]:
+        return self.engine.get_stats()
+
+    def check_health(self):
+        if not self.engine._loop_thread.is_alive():
+            raise RuntimeError("engine loop died")
+
+
+def build_llm_deployment(model_factory, *, engine_config=None,
+                         tokenizer=None, name: str = "LLMServer",
+                         num_replicas: int = 1,
+                         max_ongoing_requests: int = 32) -> Application:
+    """Build a ready-to-run LLM serving app:
+    `serve.run(build_llm_deployment(factory))`."""
+    dep = deployment_decorator(
+        LLMServer, name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests)
+    return dep.bind(model_factory, engine_config=engine_config,
+                    tokenizer=tokenizer)
+
+
+__all__ = ["LLMEngine", "LLMEngineConfig", "LLMServer",
+           "build_llm_deployment"]

@@ -1,0 +1,306 @@
+"""JAX continuous-batching LLM engine.
+
+Reference parity: the fork's vLLM-style serving path (continuous batching,
+paged KV, streaming) — re-designed TPU-first:
+
+* Slot-based KV cache: one preallocated HBM buffer per layer of shape
+  (max_slots, max_seq_len, n_kv_heads, head_dim). Static shapes, so the
+  decode step compiles ONCE and every subsequent step reuses it.
+* Continuous batching: ONE jitted decode step advances ALL active slots
+  together (the MXU sees batch=max_slots matmuls, not per-request calls).
+  Requests join/leave between steps with no recompile.
+* Prefill: prompts are padded to power-of-two buckets -> a handful of
+  compiles total; KV is written straight into the request's slot via
+  dynamic_update_slice.
+* Sampling (greedy / temperature / top-k) happens on-device inside the
+  jitted step; only the sampled token ids (max_slots int32) cross to host
+  per step.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class LLMEngineConfig:
+    max_slots: int = 8              # max concurrently-decoding sequences
+    max_seq_len: int = 1024         # prompt + generation budget per slot
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+    eos_token_id: Optional[int] = None
+    max_new_tokens_default: int = 64
+    top_k: int = 0                  # 0 = full softmax sampling
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt: np.ndarray              # (P,) int32
+    max_new_tokens: int
+    temperature: float
+    out_queue: queue_mod.Queue = field(
+        default_factory=lambda: queue_mod.Queue(maxsize=4096))
+    slot: int = -1
+    generated: int = 0
+    submit_ts: float = field(default_factory=time.time)
+    first_token_ts: Optional[float] = None
+
+
+_END = ("__end__", None)
+
+
+class LLMEngine:
+    """Continuous-batching engine over a ray_tpu Llama-family model.
+
+    `model` must follow the ray_tpu/models/llama.py contract:
+    apply({"params": params}, tokens, cache=..., positions=...) ->
+    (logits, new_cache) with cache = [per-layer (k, v, lengths)].
+    """
+
+    def __init__(self, model, params, cfg: LLMEngineConfig):
+        import jax
+        import jax.numpy as jnp
+        self._jax, self._jnp = jax, jnp
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        mcfg = model.cfg
+        if cfg.eos_token_id is None:
+            cfg.eos_token_id = getattr(mcfg, "eos_token_id", None)
+        S, L = cfg.max_slots, cfg.max_seq_len
+        self._cache = [
+            (jnp.zeros((S, L, mcfg.n_kv_heads, mcfg.head_dim), mcfg.dtype),
+             jnp.zeros((S, L, mcfg.n_kv_heads, mcfg.head_dim), mcfg.dtype),
+             jnp.zeros((S,), jnp.int32))
+            for _ in range(mcfg.n_layers)]
+        self._last_tokens = jnp.zeros((S,), jnp.int32)
+        self._free_slots = list(range(S))
+        self._active: Dict[int, _Request] = {}
+        self._waiting: "queue_mod.Queue[_Request]" = queue_mod.Queue()
+        self._requests: Dict[str, _Request] = {}
+        self._req_counter = itertools.count()
+        self._lock = threading.Lock()
+        self._rng_key = jax.random.PRNGKey(0)
+        self._shutdown = threading.Event()
+        self.stats = {"prefills": 0, "decode_steps": 0,
+                      "tokens_generated": 0, "preempted": 0}
+
+        self._prefill_jit = jax.jit(
+            self._prefill_impl, static_argnames=("pad_len",),
+            donate_argnums=(1,))
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._loop_thread = threading.Thread(
+            target=self._engine_loop, daemon=True, name="llm-engine")
+        self._loop_thread.start()
+
+    # ---- jitted kernels ---------------------------------------------------
+    def _prefill_impl(self, params, cache, tokens, slot, true_len,
+                      pad_len: int):
+        """Run the prompt through the model writing KV into `slot`.
+        tokens: (1, pad_len); returns (last_logits (V,), cache')."""
+        jnp = self._jnp
+        lax = self._jax.lax
+        # slice this slot's rows out of the big cache
+        small = []
+        for (ck, cv, lens) in cache:
+            k1 = lax.dynamic_slice_in_dim(ck, slot, 1, axis=0)
+            v1 = lax.dynamic_slice_in_dim(cv, slot, 1, axis=0)
+            small.append((k1, v1, jnp.zeros((1,), jnp.int32)))
+        positions = jnp.arange(pad_len)[None, :]
+        logits, new_small = self.model.apply(
+            {"params": params}, tokens, cache=small, positions=positions)
+        out_cache = []
+        for (ck, cv, lens), (k1, v1, _l1) in zip(cache, new_small):
+            ck = lax.dynamic_update_slice_in_dim(ck, k1, slot, axis=0)
+            cv = lax.dynamic_update_slice_in_dim(cv, v1, slot, axis=0)
+            lens = lens.at[slot].set(true_len)
+            out_cache.append((ck, cv, lens))
+        last = logits[0, true_len - 1]
+        return last, out_cache
+
+    def _decode_impl(self, params, cache, last_tokens, active_mask,
+                     temps, rng_key):
+        """One decode step for every slot. Returns (next_tokens (S,),
+        cache'). Inactive slots' lengths are restored so their state
+        never drifts."""
+        jnp = self._jnp
+        jax = self._jax
+        old_lengths = cache[0][2]
+        positions = old_lengths[:, None]  # (S, 1): write at current end
+        logits, new_cache = self.model.apply(
+            {"params": params}, last_tokens[:, None], cache=cache,
+            positions=positions)
+        logits = logits[:, 0, :]  # (S, V)
+        fixed = []
+        for (ck, cv, lens) in new_cache:
+            lens = jnp.where(active_mask, lens, old_lengths)
+            fixed.append((ck, cv, lens))
+        if self.cfg.top_k and self.cfg.top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(
+            rng_key, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        nxt = jnp.where(active_mask, nxt, last_tokens)
+        return nxt, fixed
+
+    # ---- public API -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0) -> str:
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        self._bucket(prompt.size)  # validate in the caller, not the loop
+        budget = max_new_tokens or self.cfg.max_new_tokens_default
+        if prompt.size + budget > self.cfg.max_seq_len:
+            budget = self.cfg.max_seq_len - prompt.size
+            if budget <= 0:
+                raise ValueError(
+                    f"prompt length {prompt.size} exceeds max_seq_len "
+                    f"{self.cfg.max_seq_len}")
+        req = _Request(request_id=f"req-{next(self._req_counter)}",
+                       prompt=prompt, max_new_tokens=budget,
+                       temperature=temperature)
+        with self._lock:
+            self._requests[req.request_id] = req
+        self._waiting.put(req)
+        return req.request_id
+
+    def stream(self, request_id: str):
+        """Blocking generator of token ids for one request."""
+        req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(request_id)
+        while True:
+            kind, payload = req.out_queue.get()
+            if kind == "token":
+                yield payload
+            elif kind == "error":
+                raise payload
+            else:  # end
+                break
+        with self._lock:
+            self._requests.pop(request_id, None)
+
+    def generate_sync(self, prompt_ids, max_new_tokens=None,
+                      temperature: float = 0.0) -> List[int]:
+        rid = self.submit(prompt_ids, max_new_tokens, temperature)
+        return list(self.stream(rid))
+
+    def get_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {**self.stats, "active": len(self._active),
+                    "waiting": self._waiting.qsize(),
+                    "free_slots": len(self._free_slots)}
+
+    def shutdown(self):
+        self._shutdown.set()
+
+    # ---- engine loop ------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b and b <= self.cfg.max_seq_len:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest prefill "
+                         f"bucket {self.cfg.prefill_buckets[-1]}")
+
+    def _admit_one(self) -> bool:
+        jnp = self._jnp
+        try:
+            req = self._waiting.get_nowait()
+        except queue_mod.Empty:
+            return False
+        slot = self._free_slots.pop()
+        req.slot = slot
+        try:
+            pad_len = self._bucket(req.prompt.size)
+            tokens = np.zeros((1, pad_len), np.int32)
+            tokens[0, :req.prompt.size] = req.prompt
+            last_logits, self._cache = self._prefill_jit(
+                self.params, self._cache, jnp.asarray(tokens),
+                jnp.int32(slot), jnp.int32(req.prompt.size),
+                pad_len=pad_len)
+            # first generated token comes straight from prefill logits
+            if req.temperature > 0:
+                self._rng_key, sub = self._jax.random.split(self._rng_key)
+                tok = int(self._jax.random.categorical(
+                    sub, last_logits / max(req.temperature, 1e-6)))
+            else:
+                tok = int(jnp.argmax(last_logits))
+        except BaseException as e:  # noqa: BLE001
+            self._free_slots.append(slot)
+            req.slot = -1
+            req.out_queue.put(("error", e))
+            req.out_queue.put(_END)
+            return True
+        self.stats["prefills"] += 1
+        req.first_token_ts = time.time()
+        self._emit(req, tok)
+        if req.generated < req.max_new_tokens:
+            self._active[slot] = req
+            self._last_tokens = self._last_tokens.at[slot].set(tok)
+        else:
+            self._release(req)
+        return True
+
+    def _emit(self, req: _Request, tok: int):
+        req.generated += 1
+        self.stats["tokens_generated"] += 1
+        req.out_queue.put(("token", tok))
+        if (self.cfg.eos_token_id is not None
+                and tok == self.cfg.eos_token_id):
+            req.max_new_tokens = req.generated  # finish after EOS
+
+    def _release(self, req: _Request):
+        req.out_queue.put(_END)
+        if req.slot >= 0:
+            self._free_slots.append(req.slot)
+            self._active.pop(req.slot, None)
+            req.slot = -1
+
+    def _engine_loop(self):
+        jnp = self._jnp
+        S = self.cfg.max_slots
+        while not self._shutdown.is_set():
+            admitted = False
+            try:
+                while self._free_slots and self._admit_one():
+                    admitted = True
+            except BaseException:  # noqa: BLE001  loop must survive
+                import traceback
+                traceback.print_exc()
+            if not self._active:
+                if not admitted:
+                    time.sleep(0.002)
+                continue
+            active_mask = np.zeros((S,), bool)
+            temps = np.zeros((S,), np.float32)
+            for slot, req in self._active.items():
+                active_mask[slot] = True
+                temps[slot] = req.temperature
+            self._rng_key, sub = self._jax.random.split(self._rng_key)
+            try:
+                nxt, self._cache = self._decode_jit(
+                    self.params, self._cache, self._last_tokens,
+                    jnp.asarray(active_mask), jnp.asarray(temps), sub)
+                self._last_tokens = nxt
+                nxt_host = np.asarray(nxt)
+            except BaseException as e:  # noqa: BLE001
+                for req in list(self._active.values()):
+                    req.out_queue.put(("error", e))
+                    self._release(req)
+                continue
+            self.stats["decode_steps"] += 1
+            for slot, req in list(self._active.items()):
+                self._emit(req, int(nxt_host[slot]))
+                full = (req.prompt.size + req.generated
+                        >= self.cfg.max_seq_len)
+                if req.generated >= req.max_new_tokens or full:
+                    self._release(req)

@@ -1,0 +1,231 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference parity: python/ray/serve/_private/replica.py (request handling,
+ongoing-request accounting, health checks, reconfigure, streaming) —
+re-shaped for the ray_tpu runtime: one actor per replica, async
+`handle_request` running on the worker's persistent asyncio loop, and a
+poll-based streaming protocol (`stream_next`) instead of gRPC streams.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import itertools
+import queue as queue_mod
+import threading
+from typing import Any, Dict, Optional
+
+_STREAM_END = "__ray_tpu_stream_end__"
+
+
+class Replica:
+    """The actor class the controller instantiates per replica.
+
+    Wraps either a user class (instantiated with init args) or a plain
+    function. All requests land on `handle_request`; generators/async
+    generators are exposed through `stream_start`/`stream_next` so HTTP
+    proxies and handles can pull token-by-token.
+    """
+
+    def __init__(self, deployment_name: str, replica_id: str,
+                 callable_bytes: bytes, init_args, init_kwargs,
+                 user_config: Optional[Dict[str, Any]] = None,
+                 max_ongoing_requests: int = 5):
+        from .. import core  # noqa: F401  (ensures runtime symbols loaded)
+        from ..core import serialization
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total_served = 0
+        self._lock = threading.Lock()
+        self._streams: Dict[str, queue_mod.Queue] = {}
+        self._stream_counter = itertools.count()
+
+        target = serialization.loads_call(callable_bytes)
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = target
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ---- lifecycle --------------------------------------------------------
+    def ready(self) -> str:
+        """Readiness probe: returns once __init__ (and any model load in
+        the user ctor) has completed."""
+        return self._replica_id
+
+    def health_check(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Dict[str, Any]) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def prepare_for_shutdown(self) -> int:
+        """Graceful drain: report ongoing count so controller can wait."""
+        with self._lock:
+            return self._ongoing
+
+    def shutdown_user_callable(self) -> None:
+        fn = getattr(self._callable, "__del__", None)
+        del fn  # user __del__ runs when the process exits; nothing to do
+
+    # ---- metrics ----------------------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica_id": self._replica_id,
+                    "ongoing": self._ongoing,
+                    "total": self._total_served,
+                    "max_ongoing": self._max_ongoing}
+
+    def get_queue_len(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    # ---- request path -----------------------------------------------------
+    def _resolve_method(self, method_name: str):
+        if self._is_function:
+            if method_name not in ("__call__", None):
+                raise AttributeError(
+                    f"function deployment has no method {method_name!r}")
+            return self._callable
+        return getattr(self._callable, method_name or "__call__")
+
+    async def handle_request(self, method_name: str, args, kwargs) -> Any:
+        """Unary request. Runs user coroutines on the worker loop; sync
+        handlers run in the default executor so they don't block the loop
+        (and so max_ongoing_requests > 1 gives real concurrency)."""
+        with self._lock:
+            self._ongoing += 1
+        try:
+            mux_id = kwargs.pop("__serve_multiplexed_model_id", "")
+            from .multiplex import _set_multiplexed_model_id
+            method = self._resolve_method(method_name)
+            if inspect.iscoroutinefunction(method):
+                if mux_id:
+                    _set_multiplexed_model_id(mux_id)
+                result = await method(*args, **kwargs)
+            else:
+                def _call_sync():
+                    # contextvar set inside the executor thread: plain
+                    # run_in_executor does not propagate context.
+                    if mux_id:
+                        _set_multiplexed_model_id(mux_id)
+                    return method(*args, **kwargs)
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(None, _call_sync)
+                if inspect.iscoroutine(result):
+                    result = await result
+            if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                raise TypeError(
+                    "handler returned a generator; call it via the "
+                    "streaming path (handle.options(stream=True))")
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+                self._total_served += 1
+
+    # ---- streaming path ---------------------------------------------------
+    async def stream_start(self, method_name: str, args, kwargs) -> str:
+        """Start a streaming call; returns a stream id to poll with
+        stream_next(). The generator is drained on a background task and
+        chunks buffered, so slow consumers don't stall the handler."""
+        stream_id = f"{self._replica_id}-s{next(self._stream_counter)}"
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=1024)
+        self._streams[stream_id] = q
+        with self._lock:
+            self._ongoing += 1
+        mux_id = kwargs.pop("__serve_multiplexed_model_id", "")
+        from .multiplex import _set_multiplexed_model_id
+        if mux_id:
+            _set_multiplexed_model_id(mux_id)
+        method = self._resolve_method(method_name)
+
+        async def _put(item):
+            # never block the event loop: the queue is bounded, so park
+            # in short async sleeps when a slow consumer falls behind.
+            while True:
+                try:
+                    q.put_nowait(item)
+                    return
+                except queue_mod.Full:
+                    await asyncio.sleep(0.01)
+
+        def _next_with_ctx(it):
+            # executor threads don't inherit the loop's contextvars; a
+            # sync generator reading get_multiplexed_model_id() needs the
+            # var set in the thread actually running its frames.
+            if mux_id:
+                _set_multiplexed_model_id(mux_id)
+            return next(it, _STREAM_END)
+
+        async def _drain():
+            try:
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                if inspect.isasyncgen(result):
+                    async for chunk in result:
+                        await _put(("chunk", chunk))
+                elif inspect.isgenerator(result):
+                    loop = asyncio.get_running_loop()
+                    it = iter(result)
+                    while True:
+                        chunk = await loop.run_in_executor(
+                            None, _next_with_ctx, it)
+                        if chunk == _STREAM_END:
+                            break
+                        await _put(("chunk", chunk))
+                else:  # unary result streamed as a single chunk
+                    await _put(("chunk", result))
+                await _put(("end", None))
+            except BaseException as e:  # noqa: BLE001
+                await _put(("error", e))
+            finally:
+                with self._lock:
+                    self._ongoing -= 1
+                    self._total_served += 1
+
+        asyncio.ensure_future(_drain())
+        return stream_id
+
+    def stream_next(self, stream_id: str, batch: int = 64,
+                    timeout_s: float = 30.0):
+        """Pull up to `batch` buffered chunks. Returns (chunks, done).
+        Raises the handler's exception if the stream errored."""
+        q = self._streams.get(stream_id)
+        if q is None:
+            return [], True
+        chunks = []
+        done = False
+        try:
+            kind, payload = q.get(timeout=timeout_s)
+            while True:
+                if kind == "chunk":
+                    chunks.append(payload)
+                elif kind == "end":
+                    done = True
+                    break
+                elif kind == "error":
+                    self._streams.pop(stream_id, None)
+                    raise payload
+                if len(chunks) >= batch:
+                    break
+                try:
+                    kind, payload = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+        except queue_mod.Empty:
+            pass
+        if done:
+            self._streams.pop(stream_id, None)
+        return chunks, done
