@@ -1,0 +1,29 @@
+"""ray_tpu.rllib — RL on the TPU-native runtime.
+
+Reference counterpart: rllib/ (Algorithm, PPO, DQN, EnvRunner, RLModule,
+SampleBatch, replay buffers, GRPO post-training path). Rollouts run on
+CPU env actors; learner updates are single jitted XLA programs,
+dp-shardable over a jax Mesh (LearnerGroup).
+"""
+from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import DQN, DQNConfig
+from .env import (BanditEnv, CartPole, Env, GridWorld, Space, VectorEnv,
+                  make_env, register_env)
+from .env_runner import EnvRunner
+from .grpo import GRPOConfig, GRPOLearner, GRPOTrainer, group_relative_advantages
+from .learner import Learner, LearnerGroup
+from .ppo import PPO, PPOConfig
+from .replay import EpisodeReplayBuffer, ReplayBuffer
+from .rl_module import (Categorical, DiagGaussian, RLModule, RLModuleSpec,
+                        spec_for_env)
+from .sample_batch import SampleBatch, compute_gae, concat_samples
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "GRPOConfig", "GRPOLearner", "GRPOTrainer", "group_relative_advantages",
+    "Env", "Space", "CartPole", "GridWorld", "BanditEnv", "VectorEnv",
+    "make_env", "register_env", "EnvRunner", "Learner", "LearnerGroup",
+    "ReplayBuffer", "EpisodeReplayBuffer", "RLModule", "RLModuleSpec",
+    "spec_for_env", "Categorical", "DiagGaussian", "SampleBatch",
+    "concat_samples", "compute_gae",
+]

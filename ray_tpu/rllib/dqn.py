@@ -1,0 +1,192 @@
+"""DQN on JAX: replay buffer + double-Q target, jitted TD update.
+
+Reference counterpart: rllib/algorithms/dqn/. Demonstrates the replay
+path (R6): EnvRunner fragments feed a ReplayBuffer; updates sample
+uniformly; the target net refreshes by period.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import sample_batch as sb
+from .algorithm import Algorithm, AlgorithmConfig
+from .replay import ReplayBuffer
+from .sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.buffer_size = 50_000
+        self.learning_starts = 1000
+        self.target_update_freq = 500     # in gradient steps
+        self.train_batch_size = 64
+        self.num_gradient_steps = 32      # per training iteration
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_timesteps = 10_000
+        self.double_q = True
+        self.lr = 1e-3
+        self.algo_class = DQN
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        if config.num_env_runners > 0:
+            raise ValueError("DQN collects via its local epsilon-greedy "
+                             "runner; num_env_runners>0 is not supported")
+        super().__init__(config)
+        if not self.module.is_discrete:
+            raise ValueError("DQN needs a discrete action space")
+        cfg = config
+        module = self.module
+        # re-use the pi tower as the Q net: dist_in are Q-values
+        self.q_params = self.params["pi"]
+        self.target_params = jax.device_get(self.q_params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.q_params)
+        self._grad_steps = 0
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+
+        def td_update(q_params, target_params, opt_state, batch):
+            def loss_fn(qp):
+                q = module.pi_net.apply({"params": qp}, batch[sb.OBS])
+                qa = jnp.take_along_axis(
+                    q, batch[sb.ACTIONS][:, None].astype(jnp.int32),
+                    axis=-1).squeeze(-1)
+                q_next_t = module.pi_net.apply({"params": target_params},
+                                               batch[sb.NEXT_OBS])
+                if cfg.double_q:
+                    q_next_o = module.pi_net.apply({"params": qp},
+                                                   batch[sb.NEXT_OBS])
+                    a_star = jnp.argmax(q_next_o, axis=-1)
+                    q_next = jnp.take_along_axis(
+                        q_next_t, a_star[:, None], axis=-1).squeeze(-1)
+                else:
+                    q_next = q_next_t.max(axis=-1)
+                nonterminal = 1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)
+                target = (batch[sb.REWARDS]
+                          + cfg.gamma * nonterminal * q_next)
+                target = jax.lax.stop_gradient(target)
+                return jnp.mean((qa - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(q_params)
+            updates, opt_state = self.tx.update(grads, opt_state, q_params)
+            return optax.apply_updates(q_params, updates), opt_state, loss
+
+        self._td_update = jax.jit(td_update)
+        self._q_fwd = jax.jit(
+            lambda qp, obs: module.pi_net.apply({"params": qp}, obs))
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config
+        frac = min(1.0, self._timesteps_total
+                   / max(1, cfg.epsilon_decay_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _collect(self):
+        """Epsilon-greedy rollout via the local runner's vec env."""
+        cfg: DQNConfig = self.config
+        runner = self.local_runner
+        vec = runner.vec
+        T, B = cfg.rollout_fragment_length, vec.num_envs
+        cols = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.TERMINATEDS, sb.NEXT_OBS)}
+        obs = runner._obs
+        eps = self._epsilon()
+        for _ in range(T):
+            q = np.asarray(self._q_fwd(self.q_params, obs))
+            greedy = q.argmax(axis=-1)
+            rand = self._rng.integers(0, q.shape[-1], size=B)
+            explore = self._rng.random(B) < eps
+            actions = np.where(explore, rand, greedy).astype(np.int32)
+            nxt, r, tm, tr, infos = vec.step(actions)
+            runner._ep_ret += r
+            runner._ep_len += 1
+            # store the TRUE next obs (not the auto-reset obs); truncation
+            # keeps terminateds=0 so the target bootstraps through it.
+            nxt_true = nxt.copy()
+            for i in np.nonzero(tm | tr)[0]:
+                nxt_true[i] = infos[i]["final_obs"]
+                runner.completed_returns.append(float(runner._ep_ret[i]))
+                runner.completed_lengths.append(int(runner._ep_len[i]))
+                runner._ep_ret[i] = 0.0
+                runner._ep_len[i] = 0
+            cols[sb.OBS].append(obs.copy())
+            cols[sb.ACTIONS].append(actions)
+            cols[sb.REWARDS].append(r)
+            cols[sb.TERMINATEDS].append(tm)
+            cols[sb.NEXT_OBS].append(nxt_true)
+            obs = nxt
+        runner._obs = obs
+        flat = {k: np.concatenate(v) for k, v in cols.items()}
+        return SampleBatch(flat), runner.pop_episode_stats()
+
+    def training_step(self, batch: SampleBatch) -> Dict[str, Any]:
+        cfg: DQNConfig = self.config
+        self.buffer.add(batch)
+        if len(self.buffer) < cfg.learning_starts:
+            return {"td_loss": None, "buffer_size": len(self.buffer),
+                    "epsilon": self._epsilon()}
+        losses = []
+        for _ in range(cfg.num_gradient_steps):
+            mb = self.buffer.sample(cfg.train_batch_size).as_numpy()
+            self.q_params, self.opt_state, loss = self._td_update(
+                self.q_params, self.target_params, self.opt_state, mb)
+            self._grad_steps += 1
+            if self._grad_steps % cfg.target_update_freq == 0:
+                self.target_params = jax.device_get(self.q_params)
+            losses.append(float(loss))
+        # keep the module params in sync so Algorithm-level periodic
+        # evaluation (which reads self.params) sees the trained Q net
+        self.params = dict(self.params, pi=self.q_params)
+        return {"td_loss": float(np.mean(losses)),
+                "buffer_size": len(self.buffer),
+                "epsilon": self._epsilon()}
+
+    def compute_single_action(self, obs, *, explore: bool = False):
+        obs = np.asarray(obs, np.float32)[None]
+        q = np.asarray(self._q_fwd(self.q_params, obs))[0]
+        if explore and self._rng.random() < self._epsilon():
+            return int(self._rng.integers(0, len(q)))
+        return int(q.argmax())
+
+    def evaluate(self) -> Dict[str, float]:
+        from .env import make_env
+        if self.local_runner._eval_env is None:
+            self.local_runner._eval_env = make_env(
+                self.config.env, **self.config.env_config)
+        env = self.local_runner._eval_env
+        returns = []
+        for _ in range(self.config.evaluation_num_episodes):
+            obs, _ = env.reset()
+            total, steps = 0.0, 0
+            while steps < 1000:
+                a = self.compute_single_action(obs)
+                obs, r, tm, tr, _ = env.step(a)
+                total += r
+                steps += 1
+                if tm or tr:
+                    break
+            returns.append(total)
+        return {"evaluation_return_mean": float(np.mean(returns))}
+
+    def _save_extra(self):
+        return {"q_params": jax.device_get(self.q_params),
+                "target_params": self.target_params,
+                "opt_state": jax.device_get(self.opt_state),
+                "grad_steps": self._grad_steps}
+
+    def _restore_extra(self, extra):
+        if extra:
+            self.q_params = extra["q_params"]
+            self.target_params = extra["target_params"]
+            self.opt_state = extra["opt_state"]
+            self._grad_steps = extra["grad_steps"]

@@ -1,0 +1,149 @@
+"""RLModule: flax policy(+value) networks and action distributions.
+
+Reference counterpart: rllib/core/rl_module/ (RLModule, catalog-built
+encoder + pi/vf heads) and rllib/models/distributions. TPU-first: the
+module is a pure function of (params, obs) so the whole sampling/update
+path jits; distributions are jnp-native (no torch.distributions).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.mlp import MLP, MLPConfig
+from .env import Space
+
+
+class Categorical:
+    """Discrete action distribution over logits."""
+
+    def __init__(self, logits: jnp.ndarray):
+        self.logits = logits
+
+    def sample(self, rng) -> jnp.ndarray:
+        return jax.random.categorical(rng, self.logits, axis=-1)
+
+    def mode(self) -> jnp.ndarray:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        logp_all = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+
+    def entropy(self) -> jnp.ndarray:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def kl(self, other: "Categorical") -> jnp.ndarray:
+        lp, lq = (jax.nn.log_softmax(self.logits, -1),
+                  jax.nn.log_softmax(other.logits, -1))
+        return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+
+
+class DiagGaussian:
+    """Continuous action distribution: independent normals."""
+
+    def __init__(self, mean: jnp.ndarray, log_std: jnp.ndarray):
+        self.mean = mean
+        self.log_std = log_std
+
+    def sample(self, rng) -> jnp.ndarray:
+        eps = jax.random.normal(rng, self.mean.shape)
+        return self.mean + jnp.exp(self.log_std) * eps
+
+    def mode(self) -> jnp.ndarray:
+        return self.mean
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * ((actions - self.mean) ** 2 / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self) -> jnp.ndarray:
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def kl(self, other: "DiagGaussian") -> jnp.ndarray:
+        v0, v1 = jnp.exp(2 * self.log_std), jnp.exp(2 * other.log_std)
+        return jnp.sum(other.log_std - self.log_std
+                       + (v0 + (self.mean - other.mean) ** 2) / (2 * v1)
+                       - 0.5, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    """Reference: rllib/core/rl_module/rl_module.py::RLModuleSpec."""
+    obs_dim: int
+    action_space: Space
+    hidden: Sequence[int] = (64, 64)
+    activation: str = "tanh"
+    free_log_std: bool = True     # continuous: state-independent log-std
+
+
+class RLModule:
+    """Separate policy and value MLP towers + a dist head.
+
+    forward(params, obs) -> (dist_inputs, value). Pure; everything jits.
+    """
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        sp = spec.action_space
+        if sp.kind == "discrete":
+            self.pi_out = sp.n
+            self.is_discrete = True
+        else:
+            self.pi_out = int(np.prod(sp.shape))
+            self.is_discrete = False
+        self.pi_net = MLP(MLPConfig(hidden=tuple(spec.hidden),
+                                    out_dim=self.pi_out,
+                                    activation=spec.activation))
+        self.vf_net = MLP(MLPConfig(hidden=tuple(spec.hidden), out_dim=1,
+                                    activation=spec.activation))
+
+    def init(self, rng) -> Any:
+        r1, r2 = jax.random.split(rng)
+        params = {
+            "pi": self.pi_net.init_params(r1, self.spec.obs_dim),
+            "vf": self.vf_net.init_params(r2, self.spec.obs_dim),
+        }
+        if not self.is_discrete and self.spec.free_log_std:
+            params["log_std"] = jnp.zeros((self.pi_out,))
+        return params
+
+    def forward(self, params, obs) -> Tuple[Any, jnp.ndarray]:
+        dist_in = self.pi_net.apply({"params": params["pi"]}, obs)
+        value = self.vf_net.apply({"params": params["vf"]}, obs).squeeze(-1)
+        return dist_in, value
+
+    def dist(self, params, dist_in):
+        if self.is_discrete:
+            return Categorical(dist_in)
+        log_std = params.get("log_std", jnp.zeros(dist_in.shape[-1:]))
+        return DiagGaussian(dist_in, jnp.broadcast_to(log_std,
+                                                      dist_in.shape))
+
+    def explore_action(self, params, obs, rng):
+        """One jittable sampling step: obs -> (action, logp, value)."""
+        dist_in, value = self.forward(params, obs)
+        d = self.dist(params, dist_in)
+        a = d.sample(rng)
+        return a, d.logp(a), value
+
+    def deterministic_action(self, params, obs):
+        dist_in, _ = self.forward(params, obs)
+        return self.dist(params, dist_in).mode()
+
+
+def spec_for_env(env, hidden: Sequence[int] = (64, 64),
+                 activation: str = "tanh") -> RLModuleSpec:
+    obs_dim = int(np.prod(env.observation_space.shape))
+    return RLModuleSpec(obs_dim=obs_dim, action_space=env.action_space,
+                        hidden=hidden, activation=activation)

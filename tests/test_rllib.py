@@ -1,0 +1,258 @@
+"""RLlib tests (parity model: rllib/algorithms/*/tests, rllib/tests).
+
+Key claims: PPO learns CartPole (eval return rises well above the random
+baseline), DQN's TD loss path runs, GRPO pushes a toy LM toward the
+rewarded token, buffers/dists/GAE are numerically sound.
+"""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (PPO, PPOConfig, DQN, DQNConfig, CartPole,
+                           GridWorld, BanditEnv, VectorEnv, EnvRunner,
+                           ReplayBuffer, EpisodeReplayBuffer, SampleBatch,
+                           concat_samples, compute_gae,
+                           group_relative_advantages, GRPOConfig,
+                           GRPOTrainer, Categorical, DiagGaussian)
+from ray_tpu.rllib import sample_batch as sb
+
+
+# ---------- envs ----------
+
+def test_cartpole_contract():
+    env = CartPole(seed=0)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0
+    for _ in range(10):
+        obs, r, tm, tr, _ = env.step(env.action_space.sample(
+            np.random.default_rng(0)))
+        total += r
+        if tm or tr:
+            break
+    assert total > 0
+
+
+def test_gridworld_reaches_goal():
+    env = GridWorld(n=3)
+    env.reset()
+    # go down twice, right twice
+    for a in (1, 1, 3, 3):
+        obs, r, tm, tr, _ = env.step(a)
+    assert tm and r == 1.0
+
+
+def test_vector_env_autoreset():
+    vec = VectorEnv([lambda: GridWorld(n=3, max_steps=5)] * 4)
+    obs, _ = vec.reset(seed=0)
+    assert obs.shape == (4, 2)
+    for _ in range(7):   # beyond max_steps: auto-reset must keep shape
+        obs, r, tm, tr, _ = vec.step(np.zeros(4, np.int64))
+    assert obs.shape == (4, 2)
+
+
+# ---------- sample batch / GAE ----------
+
+def test_sample_batch_ops():
+    b1 = SampleBatch({"x": np.arange(4), "y": np.ones(4)})
+    b2 = SampleBatch({"x": np.arange(4, 6), "y": np.zeros(2)})
+    cat = concat_samples([b1, b2])
+    assert cat.count == 6
+    mbs = list(cat.minibatches(3))
+    assert len(mbs) == 2 and mbs[0].count == 3
+    shuf = cat.shuffle(seed=0)
+    assert sorted(shuf["x"].tolist()) == list(range(6))
+
+
+def test_gae_matches_manual():
+    # two steps, one env, no termination: hand-checkable recursion
+    rewards = np.array([[1.0], [1.0]], np.float32)
+    values = np.array([[0.5], [0.5]], np.float32)
+    terms = np.zeros((2, 1), np.float32)
+    last_v = np.array([0.5], np.float32)
+    adv, ret = compute_gae(rewards, values, terms, last_v,
+                           gamma=0.9, lam=1.0)
+    # delta_1 = 1 + .9*.5 - .5 = .95 ; adv_1 = .95
+    # delta_0 = .95 ; adv_0 = .95 + .9*.95 = 1.805
+    assert np.isclose(adv[1, 0], 0.95)
+    assert np.isclose(adv[0, 0], 1.805)
+    assert np.allclose(ret, adv + values)
+
+
+def test_gae_respects_termination():
+    rewards = np.array([[1.0], [1.0]], np.float32)
+    values = np.array([[0.0], [0.0]], np.float32)
+    terms = np.array([[1.0], [0.0]], np.float32)
+    last_v = np.array([10.0], np.float32)
+    adv, _ = compute_gae(rewards, values, terms, last_v,
+                         gamma=0.9, lam=1.0)
+    # t=0 terminated: no bootstrap through it
+    assert np.isclose(adv[0, 0], 1.0)
+
+
+# ---------- replay ----------
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=10, seed=0)
+    buf.add(SampleBatch({"x": np.arange(8)}))
+    assert len(buf) == 8
+    buf.add(SampleBatch({"x": np.arange(8, 16)}))
+    assert len(buf) == 10          # capped
+    s = buf.sample(32)
+    assert s.count == 32
+    assert s["x"].max() >= 8       # newer data present
+
+
+def test_episode_replay():
+    buf = EpisodeReplayBuffer(capacity_episodes=2)
+    for i in range(3):
+        buf.add_episode(SampleBatch({"x": np.full(4, i)}))
+    assert len(buf) == 2           # oldest evicted
+    flat = buf.sample(16)
+    assert 0 not in flat["x"]
+
+
+# ---------- distributions ----------
+
+def test_categorical_logp_entropy():
+    import jax.numpy as jnp
+    logits = jnp.log(jnp.array([[0.25, 0.75]]))
+    d = Categorical(logits)
+    assert np.isclose(float(d.logp(jnp.array([1]))[0]), np.log(0.75),
+                      atol=1e-5)
+    expected_h = -(0.25 * np.log(0.25) + 0.75 * np.log(0.75))
+    assert np.isclose(float(d.entropy()[0]), expected_h, atol=1e-5)
+
+
+def test_diag_gaussian_kl_zero_same():
+    import jax.numpy as jnp
+    d = DiagGaussian(jnp.zeros((1, 3)), jnp.zeros((1, 3)))
+    assert np.isclose(float(d.kl(d)[0]), 0.0, atol=1e-6)
+
+
+# ---------- env runner ----------
+
+def test_env_runner_batch_shapes():
+    runner = EnvRunner(CartPole, num_envs=2, rollout_length=16, seed=0)
+    import jax
+    params = runner.module.init(jax.random.PRNGKey(0))
+    batch = runner.sample(params)
+    assert batch.count == 32
+    for col in (sb.OBS, sb.ACTIONS, sb.ADVANTAGES, sb.RETURNS, sb.LOGPS):
+        assert col in batch
+    assert batch[sb.OBS].shape == (32, 4)
+
+
+# ---------- PPO learns CartPole ----------
+
+@pytest.mark.slow
+def test_ppo_learns_cartpole():
+    config = (PPOConfig()
+              .environment(CartPole)
+              .env_runners(num_envs_per_env_runner=8,
+                           rollout_fragment_length=128)
+              .training(lr=3e-4, num_epochs=6, minibatch_size=256,
+                        entropy_coeff=0.01)
+              .evaluation(evaluation_num_episodes=5)
+              .debugging(seed=0))
+    algo = config.build()
+    before = algo.evaluate()["evaluation_return_mean"]
+    for _ in range(12):
+        result = algo.train()
+    after = algo.evaluate()["evaluation_return_mean"]
+    # random policy hovers ~20; learned should clearly beat it
+    assert after > max(60.0, before + 30.0), (before, after)
+    assert result["timesteps_total"] == 12 * 8 * 128
+
+
+def test_ppo_save_restore(tmp_path):
+    config = (PPOConfig().environment(GridWorld)
+              .env_runners(num_envs_per_env_runner=2,
+                           rollout_fragment_length=8)
+              .training(num_epochs=1, minibatch_size=16))
+    algo = config.build()
+    algo.train()
+    path = algo.save(str(tmp_path / "ckpt"))
+    algo2 = config.copy().build()
+    algo2.restore(path)
+    assert algo2.iteration == algo.iteration
+    import jax
+    a = jax.tree_util.tree_leaves(jax.device_get(algo.params))
+    b = jax.tree_util.tree_leaves(jax.device_get(algo2.params))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y)
+
+
+def test_ppo_remote_runners(rt):
+    config = (PPOConfig().environment(GridWorld)
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=8)
+              .training(num_epochs=1, minibatch_size=16))
+    algo = config.build()
+    result = algo.train()
+    assert result["timesteps_total"] == 2 * 2 * 8
+    algo.stop()
+
+
+# ---------- DQN ----------
+
+def test_dqn_runs_and_updates():
+    config = (DQNConfig().environment(GridWorld)
+              .env_runners(num_envs_per_env_runner=2,
+                           rollout_fragment_length=32)
+              .training(learning_starts=100, train_batch_size=32,
+                        num_gradient_steps=4))
+    algo = config.build()
+    r1 = algo.train()                      # warmup, below learning_starts
+    assert r1["learner"]["td_loss"] is None
+    r2 = algo.train()
+    assert r2["learner"]["td_loss"] is not None
+
+
+# ---------- GRPO ----------
+
+def test_group_relative_advantages():
+    r = np.array([1.0, 3.0, 2.0, 2.0], np.float32)   # 2 groups of 2
+    adv = group_relative_advantages(r, 2)
+    assert adv[0] < 0 < adv[1]            # within group 1: 1 < 3
+    assert np.allclose(adv[2:], 0.0)      # tie group: both zero
+
+
+def test_grpo_increases_rewarded_token():
+    """Toy LM: reward completions containing token 3; after a few steps
+    the policy should emit token 3 more often."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    V = 8
+
+    class TinyLM(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            emb = nn.Embed(V, 16)(tokens)
+            h = nn.relu(nn.Dense(32)(emb))
+            return nn.Dense(V)(h)
+
+    model = TinyLM()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+
+    def reward(prompt, completion):
+        return float((np.asarray(completion) == 3).mean())
+
+    cfg = GRPOConfig(group_size=8, max_new_tokens=6, lr=5e-2, seed=0,
+                     kl_coeff=0.0)
+    trainer = GRPOTrainer(apply_fn, params, reward, cfg)
+    prompts = [[1, 2], [4, 5]]
+
+    def frac_token3():
+        toks = trainer._sample_group([1, 2], 16)
+        return (toks[:, 2:] == 3).mean()
+
+    before = frac_token3()
+    stats = {}
+    for _ in range(8):
+        stats = trainer.step(prompts)
+    after = frac_token3()
+    assert after > before + 0.2, (before, after, stats)
