@@ -174,13 +174,14 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     runtime_mod.get_runtime().cancel(ref, force=force)
 
 
-def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+def get_actor(name: str, namespace: Optional[str] = None, *,
+              timeout: float = 2.0) -> ActorHandle:
     rt = runtime_mod.get_runtime()
     ns = namespace or getattr(rt, "namespace", "default")
     # Creation registers the name asynchronously in the dispatcher; poll
     # briefly so `Actor.options(name=...).remote(); get_actor(name)` works.
     import time as _time
-    deadline = _time.time() + 2.0
+    deadline = _time.time() + timeout
     while True:
         if rt.is_driver:
             aid = rt.gcs.lookup_named_actor(ns, name)

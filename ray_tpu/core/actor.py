@@ -81,14 +81,15 @@ class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
                  max_restarts=0, max_concurrency=1, name=None,
                  namespace=None, lifetime=None, runtime_env=None,
-                 placement_group=None, bundle_index=-1):
+                 placement_group=None, bundle_index=-1,
+                 get_if_exists=False):
         self._cls = cls
         self._default_opts = dict(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             name=name, namespace=namespace, lifetime=lifetime,
             runtime_env=runtime_env, placement_group=placement_group,
-            bundle_index=bundle_index)
+            bundle_index=bundle_index, get_if_exists=get_if_exists)
         self._class_bytes: Optional[bytes] = None
 
     def options(self, **opts) -> "ActorClass":
@@ -100,6 +101,24 @@ class ActorClass:
         return ac
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        opts = self._default_opts
+        if opts.get("get_if_exists") and opts.get("name"):
+            # Reference: .options(name=..., get_if_exists=True) — return the
+            # live named actor instead of failing on the name collision.
+            # Name registration is async in the dispatcher, so a miss here
+            # may be a race with an in-flight creation: create our own, then
+            # re-resolve through the registry — the first registrant wins and
+            # a losing duplicate dies on the name collision unreferenced.
+            from .. import api as _api  # noqa: PLC0415
+            try:
+                return _api.get_actor(opts["name"], opts["namespace"],
+                                      timeout=0.0)
+            except ValueError:
+                self._create(args, kwargs)
+                return _api.get_actor(opts["name"], opts["namespace"])
+        return self._create(args, kwargs)
+
+    def _create(self, args, kwargs) -> ActorHandle:
         from . import resources as res_mod  # noqa: PLC0415
         rt = runtime_mod.get_runtime()
         opts = self._default_opts

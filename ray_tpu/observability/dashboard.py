@@ -1,0 +1,114 @@
+"""Dashboard: HTTP JSON endpoints over the state API.
+
+Reference counterpart: python/ray/dashboard (head modules serving
+/api/...). No JS frontend (documented gap in SURVEY.md §2.8 O2); every
+panel the reference renders is available as JSON:
+
+  GET /api/cluster     — cluster summary
+  GET /api/nodes       — node table
+  GET /api/actors      — actor table
+  GET /api/tasks       — task table
+  GET /api/objects     — object summary + rows
+  GET /api/workers     — worker processes
+  GET /api/placement_groups
+  GET /api/timeline    — chrome-trace events
+  GET /metrics         — Prometheus text exposition
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..util import metrics as metrics_mod
+from ..util import state as state_mod
+from . import timeline as timeline_mod
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):       # silence per-request stderr noise
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(obj, default=str).encode())
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        q = parse_qs(parsed.query)
+        limit = int(q.get("limit", ["100"])[0])
+        route = parsed.path.rstrip("/")
+        try:
+            if route == "/api/cluster":
+                self._json(state_mod.cluster_summary())
+            elif route == "/api/nodes":
+                self._json(state_mod.list_nodes(limit=limit))
+            elif route == "/api/actors":
+                self._json(state_mod.list_actors(limit=limit))
+            elif route == "/api/tasks":
+                self._json(state_mod.list_tasks(limit=limit))
+            elif route == "/api/objects":
+                self._json({"summary": state_mod.summarize_objects(),
+                            "objects": state_mod.list_objects(limit=limit)})
+            elif route == "/api/workers":
+                self._json(state_mod.list_workers(limit=limit))
+            elif route == "/api/placement_groups":
+                self._json(state_mod.list_placement_groups(limit=limit))
+            elif route == "/api/timeline":
+                self._json(timeline_mod.timeline_events())
+            elif route == "/metrics":
+                self._send(200, metrics_mod.exposition().encode(),
+                           "text/plain; version=0.0.4")
+            elif route in ("", "/", "/api"):
+                self._json({"routes": ["/api/cluster", "/api/nodes",
+                                       "/api/actors", "/api/tasks",
+                                       "/api/objects", "/api/workers",
+                                       "/api/placement_groups",
+                                       "/api/timeline", "/metrics"]})
+            else:
+                self._json({"error": f"no route {route}"}, 404)
+        except Exception as e:  # surface errors as JSON, keep serving
+            self._json({"error": repr(e)}, 500)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="rtpu-dashboard")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

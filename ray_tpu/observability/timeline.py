@@ -1,0 +1,71 @@
+"""Chrome-trace timeline export of task/actor spans.
+
+Reference counterpart: ray.timeline() (python/ray/_private/profiling.py,
+state API timeline export) — emits the chrome://tracing "trace events"
+JSON array format. Rows are workers; spans are task executions; instant
+events mark actor state changes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..core.runtime import get_runtime
+
+_US = 1_000_000.0
+
+
+def timeline_events() -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    events: List[Dict[str, Any]] = []
+    pid = 1   # single "process": the cluster; tid = worker lane
+
+    lanes: Dict[str, int] = {}
+
+    def lane(wid: Optional[str]) -> int:
+        key = wid or "driver"
+        if key not in lanes:
+            lanes[key] = len(lanes) + 1
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": lanes[key], "args": {"name": f"worker:{key}"}})
+        return lanes[key]
+
+    for te in list(rt.gcs.tasks.values()):
+        if not te.started_at:
+            continue
+        end = te.finished_at or te.started_at
+        cat = "actor_task" if te.actor_id else "task"
+        events.append({
+            "name": te.name, "cat": cat, "ph": "X",
+            "ts": te.started_at * _US,
+            "dur": max(1.0, (end - te.started_at) * _US),
+            "pid": pid, "tid": lane(te.worker_id),
+            "args": {"task_id": te.task_id, "state": te.state,
+                     "actor_id": te.actor_id,
+                     "queued_s": round(te.started_at - te.submitted_at, 6)
+                     if te.submitted_at else None},
+        })
+    for ae in list(rt.gcs.actors.values()):
+        if ae.worker_id is None:
+            continue
+        events.append({
+            "name": f"actor:{ae.class_name}[{ae.state}]", "cat": "actor",
+            "ph": "i", "s": "t",
+            "ts": 0 if not rt.gcs.tasks else min(
+                (t.submitted_at for t in list(rt.gcs.tasks.values())
+                 if t.submitted_at), default=0) * _US,
+            "pid": pid, "tid": lane(ae.worker_id),
+            "args": {"actor_id": ae.actor_id}})
+    return events
+
+
+def timeline(filename: Optional[str] = None) -> Any:
+    """Export the trace; returns the event list, optionally writing JSON
+    loadable in chrome://tracing / Perfetto."""
+    events = timeline_events()
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return filename
+    return events

@@ -157,11 +157,14 @@ class Algorithm:
             stats_list = [s for _, s in outs]
             rets = [s["episode_return_mean"] for s in stats_list
                     if s["episode_return_mean"] is not None]
+            lens = [s["episode_len_mean"] for s in stats_list
+                    if s["episode_len_mean"] is not None]
             stats = {
                 "episodes_this_iter": sum(s["episodes_this_iter"]
                                           for s in stats_list),
                 "episode_return_mean": float(np.mean(rets)) if rets
                 else None,
+                "episode_len_mean": float(np.mean(lens)) if lens else None,
             }
             return concat_samples(batches), stats
         batch = self.local_runner.sample(self.params)

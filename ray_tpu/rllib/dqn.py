@@ -158,25 +158,8 @@ class DQN(Algorithm):
             return int(self._rng.integers(0, len(q)))
         return int(q.argmax())
 
-    def evaluate(self) -> Dict[str, float]:
-        from .env import make_env
-        if self.local_runner._eval_env is None:
-            self.local_runner._eval_env = make_env(
-                self.config.env, **self.config.env_config)
-        env = self.local_runner._eval_env
-        returns = []
-        for _ in range(self.config.evaluation_num_episodes):
-            obs, _ = env.reset()
-            total, steps = 0.0, 0
-            while steps < 1000:
-                a = self.compute_single_action(obs)
-                obs, r, tm, tr, _ = env.step(a)
-                total += r
-                steps += 1
-                if tm or tr:
-                    break
-            returns.append(total)
-        return {"evaluation_return_mean": float(np.mean(returns))}
+    # evaluate() is inherited: training_step syncs params["pi"] = q_params,
+    # and Categorical.mode() == argmax Q — the greedy policy.
 
     def _save_extra(self):
         return {"q_params": jax.device_get(self.q_params),

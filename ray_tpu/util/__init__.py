@@ -1,1 +1,8 @@
 """Utilities (reference: python/ray/util)."""
+from .actor_pool import ActorPool
+from .queue import Queue
+
+from . import metrics  # noqa: F401
+from . import state    # noqa: F401
+
+__all__ = ["ActorPool", "Queue", "metrics", "state"]

@@ -1,0 +1,122 @@
+"""Host-level collectives: barrier / broadcast / allreduce / allgather.
+
+Reference counterpart: python/ray/util/collective (NCCL/GLOO process
+groups). TPU-first split: ON-MESH tensor collectives are XLA's job
+(psum/all_gather over ICI inside jit — see ray_tpu/parallel); this
+module covers the CONTROL-PLANE case — host numpy arrays synchronized
+across worker processes (e.g. data-loader coordination, eval metric
+reduction) — via a named rendezvous actor, no NCCL.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_OPS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "mean": lambda xs: np.mean(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "product": lambda xs: np.prod(xs, axis=0),
+}
+
+
+class _CollectiveActor:
+    """Rendezvous state per (group, sequence-number round)."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._rounds: Dict[tuple, Dict[int, Any]] = {}
+        self._results: Dict[tuple, Any] = {}
+
+    def contribute(self, key: tuple, rank: int, payload) -> None:
+        self._rounds.setdefault(key, {})[rank] = payload
+
+    def poll(self, key: tuple, op: Optional[str]):
+        """Returns (ready, result). Result computed once per round."""
+        if key in self._results:
+            return True, self._results[key]
+        room = self._rounds.get(key, {})
+        if len(room) < self.world:
+            return False, None
+        ordered = [room[r] for r in sorted(room)]
+        if op is None:                     # allgather
+            result = ordered
+        elif op == "broadcast":
+            # payloads are (is_src, value): select by src flag, so
+            # broadcasting None works and stray non-src values are ignored
+            result = next(v for flag, v in ordered if flag)
+        elif op == "barrier":
+            result = True
+        else:
+            result = _OPS[op]([np.asarray(v) for v in ordered])
+        self._results[key] = result
+        # GC old rounds of the same kind to bound memory
+        self._rounds.pop(key, None)
+        if len(self._results) > 64:
+            oldest = next(iter(self._results))
+            self._results.pop(oldest)
+        return True, result
+
+
+class CollectiveGroup:
+    """One rank's handle; ranks coordinate via the shared named actor."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        import ray_tpu
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq: Dict[str, int] = {}
+        name = f"rtpu_collective:{group_name}"
+        try:
+            self.actor = ray_tpu.get_actor(name, timeout=0.0)
+        except ValueError:
+            cls = ray_tpu.remote(_CollectiveActor).options(
+                name=name, get_if_exists=True)
+            cls.remote(world_size)
+            # canonicalize through the name registry: if two ranks raced,
+            # the loser's actor died on the name collision and lookup
+            # returns the winner for everyone.
+            self.actor = ray_tpu.get_actor(name)
+
+    def _round(self, kind: str, payload, op: Optional[str],
+               timeout: float = 60.0):
+        import ray_tpu
+        seq = self._seq.get(kind, 0)
+        self._seq[kind] = seq + 1
+        key = (kind, seq)
+        ray_tpu.get(self.actor.contribute.remote(key, self.rank, payload))
+        deadline = time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            ready, result = ray_tpu.get(self.actor.poll.remote(key, op))
+            if ready:
+                return result
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"collective {kind}#{seq} timed out "
+                    f"({self.world_size} ranks expected)")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.02)
+
+    def barrier(self, timeout: float = 60.0) -> None:
+        self._round("barrier", None, "barrier", timeout)
+
+    def allreduce(self, array, op: str = "sum", timeout: float = 60.0):
+        return self._round("allreduce", np.asarray(array), op, timeout)
+
+    def allgather(self, value, timeout: float = 60.0) -> List[Any]:
+        return self._round("allgather", value, None, timeout)
+
+    def broadcast(self, value=None, src: int = 0, timeout: float = 60.0):
+        return self._round("broadcast", (self.rank == src, value),
+                           "broadcast", timeout)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    """Reference: ray.util.collective.init_collective_group."""
+    return CollectiveGroup(group_name, world_size, rank)

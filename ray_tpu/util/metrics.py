@@ -1,0 +1,213 @@
+"""Application metrics: Counter / Gauge / Histogram + registry.
+
+Reference counterpart: python/ray/util/metrics.py (user-facing metric
+objects) and python/ray/_private/metrics_agent.py (export). Metrics live
+in an in-process registry; `exposition()` renders the Prometheus text
+format the dashboard serves at /metrics.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing.kind}")
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return merged
+
+    def _series(self):  # -> iterable of (tags, value-ish)
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_tags_key(self._merged(tags)), 0.0)
+
+    def _series(self):
+        return list(self._values.items())
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tags_key(self._merged(tags))] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None) -> None:
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None) -> None:
+        self.inc(-value, tags)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_tags_key(self._merged(tags)), 0.0)
+
+    def _series(self):
+        return list(self._values.items())
+
+
+DEFAULT_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="",
+                 boundaries: Sequence[float] = DEFAULT_BOUNDARIES,
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+        self._buckets: Dict[tuple, List[int]] = {}
+        self._sum: Dict[tuple, float] = {}
+        self._count: Dict[tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            if key not in self._buckets:
+                self._buckets[key] = [0] * (len(self.boundaries) + 1)
+                self._sum[key] = 0.0
+                self._count[key] = 0
+            idx = bisect.bisect_left(self.boundaries, value)
+            self._buckets[key][idx] += 1
+            self._sum[key] += value
+            self._count[key] += 1
+
+    def percentile(self, p: float,
+                   tags: Optional[Dict[str, str]] = None) -> float:
+        """Linear-interpolated percentile estimate from bucket counts."""
+        key = _tags_key(self._merged(tags))
+        counts = self._buckets.get(key)
+        if not counts or self._count[key] == 0:
+            return 0.0
+        target = self._count[key] * p / 100.0
+        acc = 0.0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            hi = (self.boundaries[i] if i < len(self.boundaries)
+                  else self.boundaries[-1])
+            if acc + c >= target and c > 0:
+                frac = (target - acc) / c
+                return lo + frac * (hi - lo)
+            acc += c
+            lo = hi
+        return self.boundaries[-1]
+
+    def _series(self):
+        return [(k, (self._buckets[k], self._sum[k], self._count[k]))
+                for k in self._buckets]
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, tags=None):
+        self.hist, self.tags = hist, tags
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0, self.tags)
+        return False
+
+
+def timer(hist: Histogram, tags: Optional[Dict[str, str]] = None) -> _Timer:
+    return _Timer(hist, tags)
+
+
+def _fmt_tags(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def exposition() -> str:
+    """Prometheus text exposition of every registered metric."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        if m.description:
+            lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for key, (buckets, total, count) in m._series():
+                acc = 0
+                for i, b in enumerate(m.boundaries):
+                    acc += buckets[i]
+                    tk = key + (("le", str(b)),)
+                    lines.append(f"{m.name}_bucket{_fmt_tags(tk)} {acc}")
+                tk = key + (("le", "+Inf"),)
+                lines.append(f"{m.name}_bucket{_fmt_tags(tk)} {count}")
+                lines.append(f"{m.name}_sum{_fmt_tags(key)} {total}")
+                lines.append(f"{m.name}_count{_fmt_tags(key)} {count}")
+        else:
+            for key, v in m._series():
+                lines.append(f"{m.name}{_fmt_tags(key)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def get_metric(name: str) -> Optional[Metric]:
+    with _registry_lock:
+        return _registry.get(name)
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()

@@ -1,0 +1,159 @@
+"""State API: live introspection of the running cluster.
+
+Reference counterpart: python/ray/util/state (list_actors/list_tasks/
+list_objects/list_nodes/list_workers, summarize_*) backed by
+python/ray/_private/state.py. Here the driver IS the control store, so
+these read GCS tables directly and return plain dicts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..core.runtime import get_runtime
+
+
+def _match(row: Dict[str, Any], filters) -> bool:
+    for f in filters or ():
+        key, op, val = f
+        have = row.get(key)
+        if op in ("=", "=="):
+            if str(have) != str(val):
+                return False
+        elif op == "!=":
+            if str(have) == str(val):
+                return False
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return True
+
+
+def list_actors(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for ae in list(rt.gcs.actors.values()):
+        rows.append({
+            "actor_id": ae.actor_id, "class_name": ae.class_name,
+            "state": ae.state, "name": ae.name or "",
+            "namespace": ae.namespace, "worker_id": ae.worker_id,
+            "num_restarts": ae.num_restarts,
+            "death_cause": ae.death_cause,
+            "resources": dict(ae.resources),
+        })
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_tasks(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for te in list(rt.gcs.tasks.values()):
+        rows.append({
+            "task_id": te.task_id, "name": te.name, "state": te.state,
+            "worker_id": te.worker_id, "actor_id": te.actor_id,
+            "submitted_at": te.submitted_at, "started_at": te.started_at,
+            "finished_at": te.finished_at,
+            "duration_s": (te.finished_at - te.started_at
+                           if te.finished_at and te.started_at else None),
+        })
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_objects(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for oe in list(rt.gcs.objects.values()):
+        loc = oe.loc
+        rows.append({
+            "object_id": oe.object_id, "state": oe.state,
+            "owner_task": oe.owner_task,
+            "size_bytes": getattr(loc, "size", None),
+            "store_kind": getattr(loc, "kind", None),
+            "created_at": oe.created_at,
+        })
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_nodes(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for ne in list(rt.gcs.nodes.values()):
+        rows.append({
+            "node_id": ne.node_id, "hostname": ne.hostname,
+            "alive": ne.alive, "resources": dict(ne.resources),
+            "labels": dict(ne.labels),
+        })
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_workers(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for w in list(rt.workers.values()):
+        rows.append({
+            "worker_id": w.worker_id, "pid": w.pid, "state": w.state,
+            "current_task": w.current_task, "actor_id": w.actor_id,
+            "tpu_capable": w.tpu_capable,
+            "uptime_s": time.time() - w.started_at,
+        })
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 100
+                          ) -> List[Dict[str, Any]]:
+    rt = get_runtime()
+    rows = []
+    for pg in list(rt.placement_groups.values()):
+        rows.append({"placement_group_id": pg.pg_id, "name": pg.name,
+                     "strategy": pg.strategy, "state": pg.state,
+                     "bundles": list(pg.bundles)})
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Reference: `ray summary tasks` — counts per (name, state)."""
+    rt = get_runtime()
+    summary: Dict[str, Dict[str, int]] = {}
+    for te in list(rt.gcs.tasks.values()):
+        per = summary.setdefault(te.name, {})
+        per[te.state] = per.get(te.state, 0) + 1
+    return {"by_func_name": summary,
+            "total": len(rt.gcs.tasks)}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    rt = get_runtime()
+    summary: Dict[str, Dict[str, int]] = {}
+    for ae in list(rt.gcs.actors.values()):
+        per = summary.setdefault(ae.class_name, {})
+        per[ae.state] = per.get(ae.state, 0) + 1
+    return {"by_class_name": summary, "total": len(rt.gcs.actors)}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rt = get_runtime()
+    counts: Dict[str, int] = {}
+    total_bytes = 0
+    for oe in list(rt.gcs.objects.values()):
+        counts[oe.state] = counts.get(oe.state, 0) + 1
+        total_bytes += getattr(oe.loc, "size", 0) or 0
+    return {"by_state": counts, "total": len(rt.gcs.objects),
+            "total_size_bytes": total_bytes,
+            "store_used_bytes": rt.store.used_bytes(),
+            "store_capacity_bytes": getattr(rt.store, "capacity",
+                                            None)}
+
+
+def cluster_summary() -> Dict[str, Any]:
+    rt = get_runtime()
+    return {
+        "job_id": rt.job_id,
+        "namespace": rt.namespace,
+        "nodes": len(rt.gcs.nodes),
+        "workers": {s: sum(1 for w in list(rt.workers.values()) if w.state == s)
+                    for s in ("starting", "idle", "busy", "actor", "dead")},
+        "resources_total": rt.get_resources(),
+        "resources_available": rt.available_resources(),
+        "tasks": summarize_tasks()["total"],
+        "actors": summarize_actors()["total"],
+        "objects": summarize_objects()["total"],
+    }

@@ -1,0 +1,206 @@
+"""Observability & util tests (parity model: python/ray/tests/test_state_api.py,
+test_metrics_agent.py, test_queue.py, test_actor_pool.py)."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import state as state_mod
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.collective import init_collective_group
+from ray_tpu.util.queue import Queue, Empty
+
+
+@ray_tpu.remote
+def _square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+# ---------- metrics ----------
+
+def test_counter_gauge_histogram():
+    metrics_mod.clear_registry()
+    c = metrics_mod.Counter("req_total", "requests", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    assert c.get({"route": "/a"}) == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = metrics_mod.Gauge("inflight")
+    g.set(5)
+    g.dec()
+    assert g.get() == 4.0
+
+    h = metrics_mod.Histogram("latency_s", boundaries=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    text = metrics_mod.exposition()
+    assert "req_total" in text and 'route="/a"' in text
+    assert "latency_s_bucket" in text and "latency_s_count 4" in text
+    assert 0.1 <= h.percentile(50) <= 1.0
+
+
+def test_metrics_timer():
+    metrics_mod.clear_registry()
+    h = metrics_mod.Histogram("op_s", boundaries=(0.001, 1.0))
+    with metrics_mod.timer(h):
+        time.sleep(0.002)
+    assert h._count[()] == 1
+
+
+# ---------- state API ----------
+
+def test_state_api_lists(rt):
+    refs = [_square.remote(i) for i in range(3)]
+    ray_tpu.get(refs)
+    d = _Doubler.remote()
+    assert ray_tpu.get(d.double.remote(4)) == 8
+
+    tasks = state_mod.list_tasks(limit=1000)
+    assert any(t["name"].startswith("_square") and t["state"] == "FINISHED"
+               for t in tasks)
+    actors = state_mod.list_actors()
+    assert any(a["class_name"] == "_Doubler" and a["state"] == "ALIVE"
+               for a in actors)
+    nodes = state_mod.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    workers = state_mod.list_workers()
+    assert any(w["state"] == "actor" for w in workers)
+    objs = state_mod.list_objects(limit=1000)
+    assert any(o["state"] == "ready" for o in objs)
+
+    filtered = state_mod.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(a["state"] == "ALIVE" for a in filtered)
+
+    summ = state_mod.summarize_tasks()
+    assert summ["total"] >= 4
+    cs = state_mod.cluster_summary()
+    assert cs["nodes"] == 1 and cs["actors"] >= 1
+
+
+# ---------- timeline ----------
+
+def test_timeline_export(rt, tmp_path):
+    ray_tpu.get([_square.remote(i) for i in range(2)])
+    from ray_tpu.observability import timeline
+    path = timeline(str(tmp_path / "trace.json"))
+    events = json.load(open(path))
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans, "no task spans exported"
+    assert all("ts" in e and "dur" in e for e in spans)
+
+
+# ---------- dashboard ----------
+
+def test_dashboard_endpoints(rt):
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+    dash = start_dashboard()
+    try:
+        for route in ("/api/cluster", "/api/nodes", "/api/actors",
+                      "/api/tasks", "/api/objects", "/api/workers",
+                      "/api/timeline"):
+            with urllib.request.urlopen(dash.url + route, timeout=5) as r:
+                assert r.status == 200
+                json.loads(r.read())
+        with urllib.request.urlopen(dash.url + "/metrics", timeout=5) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(dash.url + "/nope", timeout=5) as r:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        stop_dashboard()
+
+
+# ---------- queue ----------
+
+def test_queue_fifo_and_batch(rt):
+    q = Queue(maxsize=4)
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait_batch([7, 8])
+    assert q.get_nowait_batch(5) == [7, 8]
+    q.shutdown()
+
+
+def test_queue_cross_task(rt):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i * 10)
+        return n
+
+    ray_tpu.get(producer.remote(q, 3))
+    assert sorted(q.get() for _ in range(3)) == [0, 10, 20]
+    q.shutdown()
+
+
+# ---------- actor pool ----------
+
+def test_actor_pool_ordered_and_unordered(rt):
+    actors = [_Doubler.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(5)))
+    assert out == [0, 2, 4, 6, 8]
+    out_u = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                      range(5)))
+    assert out_u == [0, 2, 4, 6, 8]
+
+
+def test_actor_pool_more_work_than_actors(rt):
+    pool = ActorPool([_Doubler.remote()])
+    for v in range(4):
+        pool.submit(lambda a, v: a.double.remote(v), v)
+    results = [pool.get_next() for _ in range(4)]
+    assert results == [0, 2, 4, 6]
+    assert not pool.has_next()
+
+
+# ---------- collective ----------
+
+def test_collective_allreduce_across_tasks(rt):
+    @ray_tpu.remote
+    def rank_worker(rank, world):
+        from ray_tpu.util.collective import init_collective_group
+        g = init_collective_group(world, rank, "testgrp")
+        g.barrier()
+        total = g.allreduce(np.array([rank + 1.0]), op="sum")
+        gathered = g.allgather(rank)
+        bc = g.broadcast(value="hello" if rank == 0 else None, src=0)
+        return float(total[0]), sorted(gathered), bc
+
+    world = 3
+    outs = ray_tpu.get([rank_worker.remote(r, world) for r in range(world)])
+    for total, gathered, bc in outs:
+        assert total == 6.0            # 1+2+3
+        assert gathered == [0, 1, 2]
+        assert bc == "hello"
+
+
+# ---------- memory monitor ----------
+
+def test_memory_summary(rt):
+    from ray_tpu.observability import memory_summary
+    ray_tpu.get(_square.remote(3))
+    s = memory_summary()
+    assert s["host_total_bytes"] > 0
+    assert s["driver_rss_bytes"] > 0
+    assert s["store_capacity_bytes"] is not None
