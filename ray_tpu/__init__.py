@@ -24,7 +24,7 @@ __version__ = "0.1.0"
 
 _LAZY_SUBMODULES = ("data", "train", "tune", "serve", "rllib", "util",
                     "models", "ops", "parallel", "observability", "dag",
-                    "workflow")
+                    "workflow", "job_submission")
 
 
 def __getattr__(name):

@@ -72,7 +72,8 @@ class RemoteFunction:
     def __init__(self, fn, *, num_cpus=None, num_tpus=None, resources=None,
                  num_returns=1, max_retries=0, retry_exceptions=False,
                  placement_group=None, bundle_index=-1,
-                 scheduling_strategy=None):
+                 scheduling_strategy=None, runtime_env=None):
+        from .core import runtime_env as renv_mod
         self._fn = fn
         functools.update_wrapper(self, fn)
         self._opts = dict(num_cpus=num_cpus, num_tpus=num_tpus,
@@ -81,7 +82,8 @@ class RemoteFunction:
                           retry_exceptions=retry_exceptions,
                           placement_group=placement_group,
                           bundle_index=bundle_index,
-                          scheduling_strategy=scheduling_strategy)
+                          scheduling_strategy=scheduling_strategy,
+                          runtime_env=renv_mod.validate(runtime_env) or None)
         self._func_bytes: Optional[bytes] = None
         self._func_id: str = ""
 
@@ -112,9 +114,15 @@ class RemoteFunction:
             func_bytes=self._func_bytes, func_id=self._func_id,
             placement_group_id=getattr(pg, "pg_id", None),
             bundle_index=o.get("bundle_index", -1),
-            scheduling_strategy=o.get("scheduling_strategy"))
+            scheduling_strategy=o.get("scheduling_strategy"),
+            runtime_env=o.get("runtime_env"))
         refs = rt.submit(spec)
         return refs[0] if o["num_returns"] == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        """Record a lazy DAG node (reference: ray.dag f.bind)."""
+        from .dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -128,13 +136,14 @@ def remote(*args, **kwargs):
         if isinstance(target, type):
             allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
                        "max_concurrency", "name", "namespace", "lifetime",
-                       "runtime_env", "placement_group", "bundle_index")
+                       "runtime_env", "placement_group", "bundle_index",
+                       "get_if_exists")
             return ActorClass(target,
                               **{k: v for k, v in opts.items()
                                  if k in allowed})
         allowed = ("num_cpus", "num_tpus", "resources", "num_returns",
                    "max_retries", "retry_exceptions", "placement_group",
-                   "bundle_index", "scheduling_strategy")
+                   "bundle_index", "scheduling_strategy", "runtime_env")
         return RemoteFunction(target,
                               **{k: v for k, v in opts.items()
                                  if k in allowed})

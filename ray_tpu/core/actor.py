@@ -83,6 +83,8 @@ class ActorClass:
                  namespace=None, lifetime=None, runtime_env=None,
                  placement_group=None, bundle_index=-1,
                  get_if_exists=False):
+        from . import runtime_env as renv_mod
+        runtime_env = renv_mod.validate(runtime_env) or None
         self._cls = cls
         self._default_opts = dict(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
@@ -147,6 +149,11 @@ class ActorClass:
         )
         rt.create_actor(acspec)
         return ActorHandle(actor_id, self._cls.__name__)
+
+    def bind(self, *args, **kwargs):
+        """Record a lazy actor-construction DAG node (ray.dag ClassNode)."""
+        from ..dag import ClassNode
+        return ClassNode(self, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

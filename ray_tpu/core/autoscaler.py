@@ -1,0 +1,149 @@
+"""Autoscaler: demand-driven node/slice count policy.
+
+Reference counterpart: python/ray/autoscaler (resource-demand scheduler
++ node launcher). In-image scope (SURVEY.md §2.1 C19): the POLICY —
+bin-pack pending demands onto node types, respect min/max and
+upscaling_speed, downscale idle nodes after a timeout — with no cloud
+provisioner; on a TPU pod the "node type" is a slice shape (e.g. a
+v5e-8 host with 8 chips).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeType:
+    name: str
+    resources: Dict[str, float]        # e.g. {"CPU": 8, "TPU": 8}
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType]
+    upscaling_speed: float = 1.0       # new nodes per existing node per round
+    idle_timeout_s: float = 300.0
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in demand.items())
+
+
+def _subtract(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class Autoscaler:
+    """Pure policy object: feed it demands + current nodes, get a plan."""
+
+    def __init__(self, config: AutoscalerConfig):
+        self.config = config
+        self._idle_since: Dict[str, float] = {}
+
+    def bin_pack(self, demands: List[Dict[str, float]],
+                 nodes_avail: List[Tuple[str, Dict[str, float]]]
+                 ) -> Tuple[List[Dict[str, float]], Dict[str, int]]:
+        """First-fit-decreasing pack of demands onto existing capacity,
+        then onto fresh nodes. Returns (unmet_after_plan, new_nodes)."""
+        avail = [dict(r) for _, r in nodes_avail]
+        unmet: List[Dict[str, float]] = []
+        for d in sorted(demands, key=lambda d: -sum(d.values())):
+            for a in avail:
+                if _fits(a, d):
+                    _subtract(a, d)
+                    break
+            else:
+                unmet.append(d)
+        new_nodes: Dict[str, int] = {}
+        virtual: List[Dict[str, float]] = []
+        still: List[Dict[str, float]] = []
+        for d in unmet:
+            for a in virtual:
+                if _fits(a, d):
+                    _subtract(a, d)
+                    break
+            else:
+                nt = self._best_node_type(d)
+                if nt is None:
+                    still.append(d)       # infeasible on any node type
+                    continue
+                new_nodes[nt.name] = new_nodes.get(nt.name, 0) + 1
+                fresh = dict(nt.resources)
+                _subtract(fresh, d)
+                virtual.append(fresh)
+        return still, new_nodes
+
+    def _best_node_type(self, demand: Dict[str, float]) -> Optional[NodeType]:
+        feasible = [nt for nt in self.config.node_types
+                    if _fits(dict(nt.resources), demand)]
+        if not feasible:
+            return None
+        # smallest node that fits: cheapest marginal capacity
+        return min(feasible, key=lambda nt: sum(nt.resources.values()))
+
+    def plan(self, *, demands: List[Dict[str, float]],
+             nodes: List[Dict],            # {id, type, avail, used}
+             now: Optional[float] = None) -> Dict:
+        """One reconcile round: scale-up for unmet demand, scale-down idle.
+
+        nodes entries: {"id": str, "type": str, "avail": {res: qty},
+        "used": {res: qty}}.
+        """
+        now = time.time() if now is None else now
+        cfg = self.config
+        counts: Dict[str, int] = {}
+        for n in nodes:
+            counts[n["type"]] = counts.get(n["type"], 0) + 1
+
+        infeasible, wanted = self.bin_pack(
+            demands, [(n["id"], n["avail"]) for n in nodes])
+
+        # clamp to max_workers and upscaling_speed
+        launches: Dict[str, int] = {}
+        for nt in cfg.node_types:
+            want = wanted.get(nt.name, 0)
+            have = counts.get(nt.name, 0)
+            room = max(0, nt.max_workers - have)
+            speed_cap = max(1, int(cfg.upscaling_speed * max(1, have)))
+            launches[nt.name] = min(want, room, speed_cap)
+            # honor min_workers even with zero demand
+            if have + launches[nt.name] < nt.min_workers:
+                launches[nt.name] = min(nt.min_workers - have, room)
+        launches = {k: v for k, v in launches.items() if v > 0}
+
+        # idle tracking + downscale candidates
+        terminate: List[str] = []
+        by_type = {nt.name: nt for nt in cfg.node_types}
+        for n in nodes:
+            busy = any(v > 0 for v in n.get("used", {}).values())
+            if busy:
+                self._idle_since.pop(n["id"], None)
+                continue
+            first_idle = self._idle_since.setdefault(n["id"], now)
+            nt = by_type.get(n["type"])
+            floor = nt.min_workers if nt else 0
+            if (now - first_idle >= cfg.idle_timeout_s
+                    and counts.get(n["type"], 0) - sum(
+                        1 for t in terminate
+                        if any(m["id"] == t and m["type"] == n["type"]
+                               for m in nodes)) > floor):
+                terminate.append(n["id"])
+        return {"launch": launches, "terminate": terminate,
+                "infeasible": infeasible}
+
+
+def demands_from_runtime(rt) -> List[Dict[str, float]]:
+    """Extract pending resource demands from a live DriverRuntime."""
+    demands = []
+    for spec in list(rt.pending_tasks):
+        if spec.resources:
+            demands.append(dict(spec.resources))
+    for acspec in list(rt.pending_actors):
+        if acspec.resources:
+            demands.append(dict(acspec.resources))
+    return demands

@@ -31,6 +31,7 @@ class TaskSpec:
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
     scheduling_strategy: Optional[str] = None
+    runtime_env: Optional[dict] = None
     # bookkeeping
     func_id: str = ""                  # cache key for deserialized functions
     dep_object_ids: List[str] = dataclasses.field(default_factory=list)
@@ -69,7 +70,8 @@ def extract_arg_deps(args: Tuple, kwargs: Dict[str, Any]) -> List[str]:
 def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
                    resources=None, max_retries=0, retry_exceptions=False,
                    func_bytes=None, func_id="", placement_group_id=None,
-                   bundle_index=-1, scheduling_strategy=None) -> TaskSpec:
+                   bundle_index=-1, scheduling_strategy=None,
+                   runtime_env=None) -> TaskSpec:
     tid = new_task_id()
     spec = TaskSpec(
         task_id=tid,
@@ -87,6 +89,7 @@ def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
         placement_group_id=placement_group_id,
         bundle_index=bundle_index,
         scheduling_strategy=scheduling_strategy,
+        runtime_env=runtime_env,
         dep_object_ids=extract_arg_deps(args, kwargs or {}),
     )
     return spec

@@ -246,9 +246,11 @@ class WorkerLoop:
             return
         self.rt.current_task_id = spec.task_id
         try:
+            from . import runtime_env as renv_mod  # noqa: PLC0415
             fn = self.rt.load_func(spec)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
-            result = fn(*args, **kwargs)
+            with renv_mod.applied(spec.runtime_env):
+                result = fn(*args, **kwargs)
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
         except BaseException as e:  # noqa: BLE001
@@ -259,6 +261,9 @@ class WorkerLoop:
 
     def _create_actor(self, acspec: ActorCreationSpec) -> None:
         try:
+            from . import runtime_env as renv_mod  # noqa: PLC0415
+            # dedicated worker: the actor's runtime_env holds for its life
+            renv_mod.apply_permanent(acspec.runtime_env)
             cls = serialization.loads_call(acspec.class_bytes)
             args, kwargs = _resolve_args(self.rt, acspec.args, acspec.kwargs)
             self._actor_instance = cls(*args, **kwargs)

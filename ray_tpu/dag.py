@@ -1,0 +1,183 @@
+"""Lazy task/actor DAGs: `.bind()` builds, `.execute()` runs.
+
+Reference counterpart: python/ray/dag (DAGNode, FunctionNode, ClassNode,
+ClassMethodNode, InputNode, MultiOutputNode). Binding records the graph
+without running anything; execute() walks it, submits every function/
+method node as a normal task with ObjectRefs wired as dependencies, and
+returns the terminal ObjectRef(s). The scheduler's dependency tracking
+(C4) gives the same pipelining the reference's compiled DAGs get from
+ownership: downstream tasks are queued immediately and start the moment
+their upstream refs seal.
+
+Serve's deployment graphs (`ray_tpu/serve`) build on the same bind()
+idiom.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_ids = itertools.count()
+
+
+class DAGNode:
+    """Base: a recorded, not-yet-executed computation."""
+
+    def __init__(self, bound_args: Tuple, bound_kwargs: Dict[str, Any]):
+        self._node_id = next(_node_ids)
+        self._bound_args = bound_args
+        self._bound_kwargs = bound_kwargs
+
+    # -- traversal --
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _resolve_args(self, ctx: "_ExecContext"):
+        args = tuple(ctx.resolve(a) if isinstance(a, DAGNode) else a
+                     for a in self._bound_args)
+        kwargs = {k: ctx.resolve(v) if isinstance(v, DAGNode) else v
+                  for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _exec(self, ctx: "_ExecContext"):
+        raise NotImplementedError
+
+    def execute(self, *input_args, **input_kwargs):
+        """Run the DAG; returns ObjectRef (or list for MultiOutputNode)."""
+        ctx = _ExecContext(input_args, input_kwargs)
+        return ctx.resolve(self)
+
+
+class _ExecContext:
+    def __init__(self, input_args: Tuple, input_kwargs: Dict[str, Any]):
+        self.input_args = input_args
+        self.input_kwargs = input_kwargs
+        self._memo: Dict[int, Any] = {}
+
+    def resolve(self, node: DAGNode):
+        if node._node_id not in self._memo:
+            self._memo[node._node_id] = node._exec(self)
+        return self._memo[node._node_id]
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference: ray.dag.InputNode).
+
+    Usable as a context manager for the reference idiom:
+        with InputNode() as inp:
+            dag = f.bind(inp)
+    Attribute/index access binds a sub-field of the input.
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _exec(self, ctx: _ExecContext):
+        if ctx.input_kwargs or len(ctx.input_args) != 1:
+            if not ctx.input_args and not ctx.input_kwargs:
+                raise TypeError("DAG has an InputNode; execute() needs an "
+                                "argument")
+            return (ctx.input_args, ctx.input_kwargs)
+        return ctx.input_args[0]
+
+    def __getattr__(self, key: str):
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key, "attr")
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key, "item")
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key, kind: str):
+        super().__init__((parent,), {})
+        self._key = key
+        self._kind = kind
+
+    def _exec(self, ctx: _ExecContext):
+        base = ctx.resolve(self._bound_args[0])
+        if self._kind == "attr":
+            return getattr(base, self._key)
+        return base[self._key]
+
+
+class FunctionNode(DAGNode):
+    """A bound remote-function call (reference: ray.dag.FunctionNode)."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _exec(self, ctx: _ExecContext):
+        args, kwargs = self._resolve_args(ctx)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """A bound actor construction. The actor is created on first execute
+    and reused across executions (reference: compiled-DAG actor reuse)."""
+
+    def __init__(self, actor_cls, args: Tuple, kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._handle = None
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        return _MethodBinder(self, method_name)
+
+    def _exec(self, ctx: _ExecContext):
+        if self._handle is None:
+            args, kwargs = self._resolve_args(ctx)
+            self._handle = self._actor_cls.remote(*args, **kwargs)
+        return self._handle
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name,
+                               args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args: Tuple, kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def _exec(self, ctx: _ExecContext):
+        handle = ctx.resolve(self._class_node)
+        args, kwargs = self._resolve_args(ctx)
+        return getattr(handle, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several terminal nodes (reference: ray.dag.MultiOutputNode);
+    execute() returns their refs as a list."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _exec(self, ctx: _ExecContext):
+        return [ctx.resolve(n) for n in self._bound_args]
+
+
+__all__ = ["DAGNode", "InputNode", "InputAttributeNode", "FunctionNode",
+           "ClassNode", "ClassMethodNode", "MultiOutputNode"]

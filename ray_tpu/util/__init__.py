@@ -1,8 +1,13 @@
 """Utilities (reference: python/ray/util)."""
 from .actor_pool import ActorPool
+from .placement_group import (PlacementGroup, get_placement_group,
+                              placement_group, placement_group_table,
+                              remove_placement_group)
 from .queue import Queue
 
 from . import metrics  # noqa: F401
 from . import state    # noqa: F401
 
-__all__ = ["ActorPool", "Queue", "metrics", "state"]
+__all__ = ["ActorPool", "Queue", "metrics", "state", "PlacementGroup",
+           "placement_group", "remove_placement_group",
+           "get_placement_group", "placement_group_table"]

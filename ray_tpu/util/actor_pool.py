@@ -41,13 +41,28 @@ class ActorPool:
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
         """Next result in submission order. A timeout raises without
-        consuming the slot, so the call is retryable."""
+        consuming the slot (retryable); a task error consumes the slot and
+        releases the actor, so the pool keeps working."""
+        from ..exceptions import GetTimeoutError
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        self._drain_pending()
+        # skip indices already consumed by get_next_unordered
+        while (self._next_return_index < self._next_task_index
+               and self._next_return_index not in self._index_to_future):
+            self._next_return_index += 1
         if self._next_return_index not in self._index_to_future:
-            if not self.has_next():
-                raise StopIteration("no pending results")
-            self._drain_pending()
+            raise StopIteration("no pending results")
         ref = self._index_to_future[self._next_return_index]
-        value = self._ray.get(ref, timeout=timeout)   # may raise: state kept
+        try:
+            value = self._ray.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise                       # state intact: retryable
+        except Exception:
+            del self._index_to_future[self._next_return_index]
+            self._next_return_index += 1
+            self._release(ref)
+            raise
         del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         self._release(ref)
@@ -68,9 +83,10 @@ class ActorPool:
             if r is ref:
                 del self._index_to_future[idx]
                 break
-        value = self._ray.get(ref)
-        self._release(ref)
-        return value
+        try:
+            return self._ray.get(ref)
+        finally:
+            self._release(ref)
 
     def _release(self, ref) -> None:
         actor = self._future_to_actor.pop(ref, None)

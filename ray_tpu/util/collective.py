@@ -30,6 +30,20 @@ class _CollectiveActor:
         self.world = world_size
         self._rounds: Dict[tuple, Dict[int, Any]] = {}
         self._results: Dict[tuple, Any] = {}
+        self._epochs: Dict[int, int] = {}
+
+    def join(self, rank: int, world_size: int) -> int:
+        """Per-rank init counter. Each CollectiveGroup handle gets its own
+        epoch, namespacing its round keys so a re-created group for the
+        same name never collides with cached results of the previous one.
+        Symmetric usage (every rank re-inits together) keeps epochs equal."""
+        if world_size != self.world:
+            raise ValueError(
+                f"collective group has world_size={self.world}, "
+                f"got {world_size}")
+        e = self._epochs.get(rank, 0)
+        self._epochs[rank] = e + 1
+        return e
 
     def contribute(self, key: tuple, rank: int, payload) -> None:
         self._rounds.setdefault(key, {})[rank] = payload
@@ -81,13 +95,14 @@ class CollectiveGroup:
             # the loser's actor died on the name collision and lookup
             # returns the winner for everyone.
             self.actor = ray_tpu.get_actor(name)
+        self.epoch = ray_tpu.get(self.actor.join.remote(rank, world_size))
 
     def _round(self, kind: str, payload, op: Optional[str],
                timeout: float = 60.0):
         import ray_tpu
         seq = self._seq.get(kind, 0)
         self._seq[kind] = seq + 1
-        key = (kind, seq)
+        key = (self.epoch, kind, seq)
         ray_tpu.get(self.actor.contribute.remote(key, self.rank, payload))
         deadline = time.monotonic() + timeout
         delay = 0.001
@@ -120,3 +135,14 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> CollectiveGroup:
     """Reference: ray.util.collective.init_collective_group."""
     return CollectiveGroup(group_name, world_size, rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Kill the rendezvous actor (reference:
+    ray.util.collective.destroy_collective_group)."""
+    import ray_tpu
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(f"rtpu_collective:{group_name}",
+                                       timeout=0.0))
+    except ValueError:
+        pass

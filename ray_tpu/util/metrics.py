@@ -70,7 +70,8 @@ class Counter(Metric):
         return self._values.get(_tags_key(self._merged(tags)), 0.0)
 
     def _series(self):
-        return list(self._values.items())
+        with self._lock:
+            return list(self._values.items())
 
 
 class Gauge(Metric):
@@ -97,7 +98,8 @@ class Gauge(Metric):
         return self._values.get(_tags_key(self._merged(tags)), 0.0)
 
     def _series(self):
-        return list(self._values.items())
+        with self._lock:
+            return list(self._values.items())
 
 
 DEFAULT_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
@@ -132,10 +134,12 @@ class Histogram(Metric):
                    tags: Optional[Dict[str, str]] = None) -> float:
         """Linear-interpolated percentile estimate from bucket counts."""
         key = _tags_key(self._merged(tags))
-        counts = self._buckets.get(key)
-        if not counts or self._count[key] == 0:
+        with self._lock:
+            counts = list(self._buckets.get(key) or ())
+            total = self._count.get(key, 0)
+        if not counts or total == 0:
             return 0.0
-        target = self._count[key] * p / 100.0
+        target = total * p / 100.0
         acc = 0.0
         lo = 0.0
         for i, c in enumerate(counts):
@@ -149,8 +153,10 @@ class Histogram(Metric):
         return self.boundaries[-1]
 
     def _series(self):
-        return [(k, (self._buckets[k], self._sum[k], self._count[k]))
-                for k in self._buckets]
+        with self._lock:
+            return [(k, (list(self._buckets[k]), self._sum[k],
+                         self._count[k]))
+                    for k in self._buckets]
 
 
 class _Timer:
@@ -170,10 +176,16 @@ def timer(hist: Histogram, tags: Optional[Dict[str, str]] = None) -> _Timer:
     return _Timer(hist, tags)
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format escaping: \\ " and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 

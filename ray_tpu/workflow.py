@@ -1,0 +1,213 @@
+"""Durable workflows: run a DAG with a persistent step log + resume.
+
+Reference counterpart: python/ray/workflow (workflow.run over a ray.dag,
+checkpointed step results, resume by workflow_id, list/status APIs) —
+the "lite" scope from SURVEY.md §2.8 O10. Every FunctionNode /
+ClassMethodNode result is pickled under
+  <storage>/<workflow_id>/steps/<step_key>.pkl
+keyed by a deterministic hash of the node's position in the DAG, so a
+re-run (or a resume after a crash) skips completed steps and re-executes
+only what's missing.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from .dag import (ClassMethodNode, ClassNode, DAGNode, FunctionNode,
+                  InputAttributeNode, InputNode, MultiOutputNode)
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu/workflows")
+_storage = _DEFAULT_STORAGE
+
+
+def init(storage: Optional[str] = None) -> None:
+    global _storage
+    _storage = storage or _DEFAULT_STORAGE
+    os.makedirs(_storage, exist_ok=True)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage, workflow_id)
+
+
+def _step_key(node: DAGNode, child_keys: List[str]) -> str:
+    """Deterministic key: node kind + callable name + child keys. Bound
+    positions (not live ids) so re-built DAGs of the same shape match."""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__name__", "fn")
+    elif isinstance(node, ClassMethodNode):
+        name = f"{node._class_node._actor_cls._cls.__name__}.{node._method_name}"
+    else:
+        name = type(node).__name__
+    h = hashlib.sha1()
+    h.update(name.encode())
+    for ck in child_keys:
+        h.update(ck.encode())
+    # literal (non-node) args participate so different bindings differ
+    for a in list(node._bound_args) + sorted(
+            f"{k}={v}" for k, v in node._bound_kwargs.items()
+            if not isinstance(v, DAGNode)):
+        if not isinstance(a, DAGNode):
+            h.update(repr(a).encode())
+    return f"{name}-{h.hexdigest()[:12]}"
+
+
+class _DurableExec:
+    """Executes a DAG bottom-up, checkpointing durable-node results."""
+
+    def __init__(self, workflow_id: str, input_args, input_kwargs):
+        self.wf_dir = _wf_dir(workflow_id)
+        self.steps_dir = os.path.join(self.wf_dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+        self.input_args = input_args
+        self.input_kwargs = input_kwargs
+        self._memo: Dict[int, Any] = {}
+        self._keys: Dict[int, str] = {}
+        self._base_counts: Dict[str, int] = {}
+        self.steps_run = 0
+        self.steps_skipped = 0
+
+    def _ckpt_path(self, key: str) -> str:
+        return os.path.join(self.steps_dir, key + ".pkl")
+
+    def resolve(self, node: DAGNode) -> Any:
+        if node._node_id in self._memo:
+            return self._memo[node._node_id]
+        value = self._eval(node)
+        self._memo[node._node_id] = value
+        return value
+
+    def _eval(self, node: DAGNode) -> Any:
+        import ray_tpu
+        if isinstance(node, InputNode):
+            if self.input_kwargs or len(self.input_args) != 1:
+                return (self.input_args, self.input_kwargs)
+            return self.input_args[0]
+        if isinstance(node, InputAttributeNode):
+            base = self.resolve(node._bound_args[0])
+            return (getattr(base, node._key) if node._kind == "attr"
+                    else base[node._key])
+        if isinstance(node, MultiOutputNode):
+            return [self.resolve(n) for n in node._bound_args]
+        if isinstance(node, ClassNode):
+            args, kwargs = self._resolved_args(node)
+            if node._handle is None:
+                node._handle = node._actor_cls.remote(*args, **kwargs)
+            return node._handle
+
+        # durable step: FunctionNode / ClassMethodNode
+        key = self._key_of(node)
+        path = self._ckpt_path(key)
+        if os.path.exists(path):
+            self.steps_skipped += 1
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        args, kwargs = self._resolved_args(node)
+        if isinstance(node, FunctionNode):
+            ref = node._remote_fn.remote(*args, **kwargs)
+        else:
+            handle = self.resolve(node._class_node)
+            ref = getattr(handle, node._method_name).remote(*args, **kwargs)
+        value = ray_tpu.get(ref)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)          # atomic: crash never half-writes
+        self.steps_run += 1
+        return value
+
+    def _key_of(self, node: DAGNode) -> str:
+        if node._node_id not in self._keys:
+            base = _step_key(node, [self._key_of(c) for c in node._children()])
+            # identical sibling subtrees (e.g. two sample.bind(cfg) calls)
+            # must be distinct steps: suffix by occurrence. DFS resolution
+            # order is deterministic for a given DAG shape, so a rebuilt
+            # DAG assigns the same suffixes.
+            n = self._base_counts.get(base, 0)
+            self._base_counts[base] = n + 1
+            self._keys[node._node_id] = base if n == 0 else f"{base}-{n}"
+        return self._keys[node._node_id]
+
+    def _resolved_args(self, node: DAGNode):
+        args = tuple(self.resolve(a) if isinstance(a, DAGNode) else a
+                     for a in node._bound_args)
+        kwargs = {k: self.resolve(v) if isinstance(v, DAGNode) else v
+                  for k, v in node._bound_kwargs.items()}
+        return args, kwargs
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: tuple = (), kwargs: Optional[dict] = None) -> Any:
+    """Execute durably; returns the DAG output VALUE (not a ref)."""
+    os.makedirs(_storage, exist_ok=True)
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    wf_dir = _wf_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    meta_path = os.path.join(wf_dir, "meta.json")
+    meta = {"workflow_id": workflow_id, "status": "RUNNING",
+            "started_at": time.time()}
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    ex = _DurableExec(workflow_id, args, kwargs or {})
+    try:
+        result = ex.resolve(dag)
+    except BaseException as e:
+        meta.update(status="FAILED", error=repr(e),
+                    finished_at=time.time())
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        raise
+    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
+        pickle.dump(result, f)
+    meta.update(status="SUCCEEDED", finished_at=time.time(),
+                steps_run=ex.steps_run, steps_skipped=ex.steps_skipped)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    return result
+
+
+def resume(workflow_id: str, dag: DAGNode, *, args: tuple = (),
+           kwargs: Optional[dict] = None) -> Any:
+    """Re-run by id: completed steps load from the log, the rest execute."""
+    if not os.path.isdir(_wf_dir(workflow_id)):
+        raise ValueError(f"no workflow {workflow_id!r} under {_storage}")
+    return run(dag, workflow_id=workflow_id, args=args, kwargs=kwargs)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    try:
+        with open(os.path.join(_wf_dir(workflow_id), "meta.json")) as f:
+            return json.load(f)["status"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def get_output(workflow_id: str) -> Any:
+    path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status={get_status(workflow_id)})")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all() -> List[Dict[str, Any]]:
+    if not os.path.isdir(_storage):
+        return []
+    out = []
+    for wid in sorted(os.listdir(_storage)):
+        meta_path = os.path.join(_storage, wid, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                out.append(json.load(f))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)

@@ -1,0 +1,309 @@
+"""Core extras tests: placement groups, runtime_env, DAG, workflow, jobs,
+autoscaler (parity model: python/ray/tests/test_placement_group.py,
+test_runtime_env.py, dag tests, workflow tests, test_job_submission.py,
+autoscaler policy tests)."""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group,
+                                          get_placement_group,
+                                          placement_group_table)
+
+
+@ray_tpu.remote
+def _add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def _mul(x, y):
+    return x * y
+
+
+@ray_tpu.remote
+class _Accum:
+    def __init__(self, start=0):
+        self.v = start
+
+    def add(self, x):
+        self.v += x
+        return self.v
+
+
+# ---------- placement groups ----------
+
+def test_placement_group_lifecycle(rt):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK",
+                         name="pgtest")
+    assert pg.wait(10.0)
+    assert pg.bundle_count == 2
+    table = placement_group_table()
+    assert table[pg.pg_id]["state"] == "CREATED"
+    assert get_placement_group("pgtest") is not None
+
+    # actor scheduled into the group doesn't consume global resources twice
+    a = _Accum.options(placement_group=pg).remote()
+    assert ray_tpu.get(a.add.remote(5)) == 5
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert pg.pg_id not in placement_group_table()   # resources returned
+
+
+def test_placement_group_validation(rt):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+# ---------- runtime_env ----------
+
+def test_runtime_env_env_vars_task(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_VAR": "abc"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    @ray_tpu.remote
+    def read_env_plain():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) == "abc"
+    # scoped: must not leak into the next task on the same worker
+    assert ray_tpu.get(read_env_plain.remote()) is None
+
+
+def test_runtime_env_actor(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "xyz"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_VAR")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "xyz"
+    ray_tpu.kill(a)
+
+
+def test_runtime_env_validation():
+    with pytest.raises(ValueError):
+        ray_tpu.remote(runtime_env={"conda": "env"})(lambda: 1)
+
+
+# ---------- DAG ----------
+
+def test_dag_function_chain(rt):
+    from ray_tpu.dag import InputNode
+    with InputNode() as inp:
+        dag = _mul.bind(_add.bind(inp, 2), 10)
+    assert ray_tpu.get(dag.execute(3)) == 50
+    assert ray_tpu.get(dag.execute(0)) == 20
+
+
+def test_dag_diamond_single_execution(rt):
+    """A shared upstream node runs once per execute (memoized)."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def stamped(x):
+        import time as _t
+        return (x, _t.monotonic_ns())
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a, b
+
+    with InputNode() as inp:
+        shared = stamped.bind(inp)
+        dag = join.bind(shared, shared)
+    (xa, ta), (xb, tb) = ray_tpu.get(dag.execute(7))
+    assert xa == xb == 7
+    assert ta == tb         # same upstream execution, not two
+
+
+def test_dag_actor_nodes(rt):
+    from ray_tpu.dag import InputNode
+    acc = _Accum.bind(100)
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    assert ray_tpu.get(dag.execute(1)) == 101
+    assert ray_tpu.get(dag.execute(2)) == 103    # same actor, state kept
+
+
+def test_dag_multi_output(rt):
+    from ray_tpu.dag import InputNode, MultiOutputNode
+    with InputNode() as inp:
+        dag = MultiOutputNode([_add.bind(inp, 1), _mul.bind(inp, 2)])
+    refs = dag.execute(5)
+    assert ray_tpu.get(refs) == [6, 10]
+
+
+# ---------- workflow ----------
+
+def test_workflow_run_and_resume(rt, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+    workflow.init(str(tmp_path))
+
+    calls = {"n": 0}
+    marker = str(tmp_path / "count.txt")
+
+    @ray_tpu.remote
+    def counted_double(x):
+        with open(marker, "a") as f:
+            f.write("x")
+        return x * 2
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = plus_one.bind(counted_double.bind(inp))
+
+    out = workflow.run(dag, workflow_id="wf1", args=(5,))
+    assert out == 11
+    assert workflow.get_status("wf1") == "SUCCEEDED"
+    assert workflow.get_output("wf1") == 11
+    assert len(open(marker).read()) == 1
+
+    # resume: steps load from the log, nothing re-executes
+    out2 = workflow.resume("wf1", dag, args=(5,))
+    assert out2 == 11
+    assert len(open(marker).read()) == 1
+    assert any(w["workflow_id"] == "wf1" for w in workflow.list_all())
+
+
+def test_workflow_failure_then_resume(rt, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+    workflow.init(str(tmp_path))
+    flag = str(tmp_path / "fail.flag")
+    open(flag, "w").write("1")
+
+    @ray_tpu.remote
+    def base(x):
+        return x + 100
+
+    @ray_tpu.remote
+    def maybe_fail(x, flag_path):
+        if os.path.exists(flag_path):
+            raise RuntimeError("injected")
+        return x * 3
+
+    with InputNode() as inp:
+        dag = maybe_fail.bind(base.bind(inp), flag)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2", args=(1,))
+    assert workflow.get_status("wf2") == "FAILED"
+
+    os.unlink(flag)     # clear the injected fault; base step is cached
+    out = workflow.resume("wf2", dag, args=(1,))
+    assert out == 303
+    assert workflow.get_status("wf2") == "SUCCEEDED"
+
+
+# ---------- jobs ----------
+
+def test_job_submission_lifecycle(tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    sid = client.submit_job(
+        entrypoint="python -c \"print('job says hi')\"",
+        metadata={"owner": "test"})
+    status = client.wait_until_finished(sid, timeout=30)
+    assert status == JobStatus.SUCCEEDED
+    assert "job says hi" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info["metadata"]["owner"] == "test"
+
+
+def test_job_stop_and_env(tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    sid = client.submit_job(
+        entrypoint="python -c \"import os,time; "
+                   "print(os.environ['JOBVAR']); time.sleep(60)\"",
+        runtime_env={"env_vars": {"JOBVAR": "fromenv"}})
+    deadline = time.time() + 10
+    while "fromenv" not in client.get_job_logs(sid):
+        assert time.time() < deadline, client.get_job_logs(sid)
+        time.sleep(0.05)
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=10) == JobStatus.STOPPED
+
+
+# ---------- autoscaler ----------
+
+def test_autoscaler_scale_up_and_down():
+    from ray_tpu.core.autoscaler import (Autoscaler, AutoscalerConfig,
+                                         NodeType)
+    cfg = AutoscalerConfig(
+        node_types=[NodeType("v5e-host", {"CPU": 8, "TPU": 8},
+                             min_workers=1, max_workers=4)],
+        upscaling_speed=10.0, idle_timeout_s=10.0)
+    asc = Autoscaler(cfg)
+
+    nodes = [{"id": "n0", "type": "v5e-host",
+              "avail": {"CPU": 0, "TPU": 0}, "used": {"CPU": 8, "TPU": 8}}]
+    # demand for 12 more chips -> needs 2 new hosts
+    plan = asc.plan(demands=[{"TPU": 4}] * 3, nodes=nodes, now=0.0)
+    assert plan["launch"] == {"v5e-host": 2}
+    assert plan["infeasible"] == []
+
+    # infeasible demand is reported, not looped on
+    plan = asc.plan(demands=[{"TPU": 100}], nodes=nodes, now=0.0)
+    assert plan["launch"] == {}
+    assert plan["infeasible"] == [{"TPU": 100}]
+
+    # idle node above min_workers terminates after the timeout
+    idle_nodes = [
+        {"id": "n0", "type": "v5e-host",
+         "avail": {"CPU": 8, "TPU": 8}, "used": {}},
+        {"id": "n1", "type": "v5e-host",
+         "avail": {"CPU": 8, "TPU": 8}, "used": {}},
+    ]
+    asc2 = Autoscaler(cfg)
+    p1 = asc2.plan(demands=[], nodes=idle_nodes, now=0.0)
+    assert p1["terminate"] == []
+    p2 = asc2.plan(demands=[], nodes=idle_nodes, now=60.0)
+    assert len(p2["terminate"]) == 1     # keeps min_workers=1
+
+
+def test_autoscaler_respects_max_workers():
+    from ray_tpu.core.autoscaler import (Autoscaler, AutoscalerConfig,
+                                         NodeType)
+    cfg = AutoscalerConfig(
+        node_types=[NodeType("host", {"CPU": 4}, max_workers=2)],
+        upscaling_speed=100.0)
+    asc = Autoscaler(cfg)
+    plan = asc.plan(demands=[{"CPU": 4}] * 10, nodes=[], now=0.0)
+    assert plan["launch"] == {"host": 2}
+
+
+def test_workflow_identical_siblings_run_separately(rt, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+    workflow.init(str(tmp_path))
+    marker = str(tmp_path / "sib.txt")
+
+    @ray_tpu.remote
+    def stamp(x):
+        with open(marker, "a") as f:
+            f.write("s")
+        import time as _t
+        return _t.monotonic_ns()
+
+    @ray_tpu.remote
+    def pair(a, b):
+        return a, b
+
+    with InputNode() as inp:
+        dag = pair.bind(stamp.bind(inp), stamp.bind(inp))
+    a, b = workflow.run(dag, workflow_id="wfsib", args=(0,))
+    assert a != b                       # two separate executions
+    assert len(open(marker).read()) == 2

@@ -204,3 +204,43 @@ def test_memory_summary(rt):
     assert s["host_total_bytes"] > 0
     assert s["driver_rss_bytes"] > 0
     assert s["store_capacity_bytes"] is not None
+
+
+def test_actor_pool_survives_task_error(rt):
+    @ray_tpu.remote
+    def boom(a, v):
+        raise ValueError("kaboom")
+
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: boom.remote(a, v), 2)
+    pool.submit(lambda a, v: a.double.remote(v), 3)
+    assert pool.get_next() == 2
+    with pytest.raises(Exception):
+        pool.get_next()
+    assert pool.get_next() == 6          # actor released, pool still works
+    assert not pool.has_next()
+
+
+def test_collective_reinit_same_name_fresh_epoch(rt):
+    @ray_tpu.remote
+    def phase(rank, world, expected_sum):
+        from ray_tpu.util.collective import init_collective_group
+        g = init_collective_group(world, rank, "epochgrp")
+        out = g.allreduce(np.array([float(expected_sum) / world]))
+        return float(out[0])
+
+    w = 2
+    r1 = ray_tpu.get([phase.remote(r, w, 10.0) for r in range(w)])
+    assert all(abs(v - 10.0) < 1e-6 for v in r1)
+    # second phase, same group name: must compute fresh, not return cache
+    r2 = ray_tpu.get([phase.remote(r, w, 20.0) for r in range(w)])
+    assert all(abs(v - 20.0) < 1e-6 for v in r2)
+
+
+def test_metrics_label_escaping():
+    metrics_mod.clear_registry()
+    c = metrics_mod.Counter("esc_total")
+    c.inc(tags={"p": 'say "hi"\n'})
+    text = metrics_mod.exposition()
+    assert 'p="say \\"hi\\"\\n"' in text
