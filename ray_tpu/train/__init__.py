@@ -7,6 +7,9 @@ Layer map:
   session.py       report()/get_context() worker session
   checkpoint.py    orbax sharded checkpoints
   config.py        ScalingConfig/RunConfig/FailureConfig/CheckpointConfig
+  backend.py       per-worker JAX distributed init + mesh formation
+  utils.py         prepare_module / prepare_loader
+  adapters.py      HF weight import (GPT-2, Llama) + tokenizer glue
 """
 from .spmd import TrainState, make_train_step, next_token_loss, SpmdStep
 from .optim import make_optimizer, warmup_cosine
@@ -18,8 +21,15 @@ from .checkpoint import (Checkpoint, CheckpointManager, save_pytree,
 from .result import Result
 from .trainer import JaxTrainer
 from .spmd_trainer import SpmdTrainer, SpmdTrainerConfig
+from .backend import (JaxBackendConfig, detect_rank, detect_world_size,
+                      form_mesh, setup_worker)
+from .utils import prepare_module, prepare_loader
+
+from . import adapters  # noqa: F401  (lazy torch/transformers inside)
 
 __all__ = [
+    "JaxBackendConfig", "setup_worker", "form_mesh", "detect_rank",
+    "detect_world_size", "prepare_module", "prepare_loader", "adapters",
     "TrainState", "make_train_step", "next_token_loss", "SpmdStep",
     "make_optimizer", "warmup_cosine", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "report", "get_context",

@@ -1,0 +1,184 @@
+"""Framework adapters: HuggingFace weight import + tokenizer/dataset glue.
+
+Reference counterpart: python/ray/train/huggingface (TransformersTrainer,
+weight interop) and the torch-module prep in train/torch. TPU-first
+inversion: instead of wrapping torch modules, we IMPORT torch weights
+into the flax model zoo (GPT-2, Llama) once, then everything downstream
+is pure JAX. Gradient-boosting adapters (xgboost/lightgbm) are a
+documented scope cut (SURVEY.md §2 known cuts).
+
+All imports of torch/transformers are lazy: nothing here pulls them in
+unless an adapter is called.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+
+def torch_state_dict_to_numpy(state_dict) -> Dict[str, np.ndarray]:
+    """Detach a torch state_dict to host numpy (fp32)."""
+    out = {}
+    for k, v in state_dict.items():
+        arr = v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+        out[k] = np.asarray(arr, dtype=np.float32)
+    return out
+
+
+# ---------------------------------------------------------------- GPT-2 --
+
+def import_hf_gpt2_weights(source, cfg=None):
+    """HF GPT-2 (torch) -> ray_tpu.models.gpt2.GPT2 flax params.
+
+    source: a transformers GPT2LMHeadModel / GPT2Model, or a state_dict.
+    HF's Conv1D stores weights [in, out] — the same layout as flax Dense
+    kernels, so projections map without transposition.
+    Returns (params, cfg).
+    """
+    from ..models.gpt2 import GPT2Config
+
+    if hasattr(source, "state_dict"):
+        hf_cfg = getattr(source, "config", None)
+        sd = torch_state_dict_to_numpy(source.state_dict())
+    else:
+        hf_cfg = None
+        sd = {k: np.asarray(v, np.float32) for k, v in dict(source).items()}
+    # accept both GPT2Model ("h.0...") and GPT2LMHeadModel ("transformer.h.0...")
+    if any(k.startswith("transformer.") for k in sd):
+        sd = {k[len("transformer."):]: v for k, v in sd.items()
+              if k.startswith("transformer.")}
+
+    if cfg is None:
+        if hf_cfg is None:
+            raise ValueError("pass cfg= when importing from a raw state_dict")
+        cfg = GPT2Config(vocab_size=hf_cfg.vocab_size,
+                         d_model=hf_cfg.n_embd, n_layers=hf_cfg.n_layer,
+                         n_heads=hf_cfg.n_head,
+                         max_seq_len=hf_cfg.n_positions)
+
+    p: Dict[str, Any] = {
+        "wte": {"embedding": sd["wte.weight"]},
+        "wpe": {"embedding": sd["wpe.weight"]},
+        "ln_f_scale": sd["ln_f.weight"],
+        "ln_f_bias": sd["ln_f.bias"],
+    }
+    for i in range(cfg.n_layers):
+        hf = f"h.{i}."
+        p[f"h_{i}"] = {
+            "ln_1_scale": sd[hf + "ln_1.weight"],
+            "ln_1_bias": sd[hf + "ln_1.bias"],
+            "ln_2_scale": sd[hf + "ln_2.weight"],
+            "ln_2_bias": sd[hf + "ln_2.bias"],
+            "qkv": {"kernel": sd[hf + "attn.c_attn.weight"],
+                    "bias": sd[hf + "attn.c_attn.bias"]},
+            "attn_out": {"kernel": sd[hf + "attn.c_proj.weight"],
+                         "bias": sd[hf + "attn.c_proj.bias"]},
+            "fc_in": {"kernel": sd[hf + "mlp.c_fc.weight"],
+                      "bias": sd[hf + "mlp.c_fc.bias"]},
+            "fc_out": {"kernel": sd[hf + "mlp.c_proj.weight"],
+                       "bias": sd[hf + "mlp.c_proj.bias"]},
+        }
+    return p, cfg
+
+
+# ---------------------------------------------------------------- Llama --
+
+def import_hf_llama_weights(source, cfg=None):
+    """HF LlamaForCausalLM (torch) -> ray_tpu.models.llama.Llama params.
+
+    torch nn.Linear stores [out, in]; flax Dense kernels are [in, out],
+    so every projection transposes. Returns (params, cfg).
+    """
+    from ..models.llama import LlamaConfig
+
+    if hasattr(source, "state_dict"):
+        hf_cfg = getattr(source, "config", None)
+        sd = torch_state_dict_to_numpy(source.state_dict())
+    else:
+        hf_cfg = None
+        sd = {k: np.asarray(v, np.float32) for k, v in dict(source).items()}
+
+    if cfg is None:
+        if hf_cfg is None:
+            raise ValueError("pass cfg= when importing from a raw state_dict")
+        cfg = LlamaConfig(
+            vocab_size=hf_cfg.vocab_size, d_model=hf_cfg.hidden_size,
+            n_layers=hf_cfg.num_hidden_layers,
+            n_heads=hf_cfg.num_attention_heads,
+            n_kv_heads=hf_cfg.num_key_value_heads,
+            d_ff=hf_cfg.intermediate_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+            tie_embeddings="lm_head.weight" not in sd)
+
+    def lin(key):
+        return {"kernel": sd[key].T}
+
+    p: Dict[str, Any] = {
+        "token_embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": sd["model.norm.weight"],
+    }
+    if "lm_head.weight" in sd:
+        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.n_layers):
+        hf = f"model.layers.{i}."
+        p[f"layer_{i}"] = {
+            "attn_norm": sd[hf + "input_layernorm.weight"],
+            "mlp_norm": sd[hf + "post_attention_layernorm.weight"],
+            "attention": {
+                "q_proj": lin(hf + "self_attn.q_proj.weight"),
+                "k_proj": lin(hf + "self_attn.k_proj.weight"),
+                "v_proj": lin(hf + "self_attn.v_proj.weight"),
+                "o_proj": lin(hf + "self_attn.o_proj.weight"),
+            },
+            "mlp": {
+                "gate_proj": lin(hf + "mlp.gate_proj.weight"),
+                "up_proj": lin(hf + "mlp.up_proj.weight"),
+                "down_proj": lin(hf + "mlp.down_proj.weight"),
+            },
+        }
+    return p, cfg
+
+
+# ------------------------------------------------------------ tokenizer --
+
+def load_tokenizer(name_or_path: str, **kwargs):
+    """transformers AutoTokenizer (lazy import; needs local files or
+    network — callers in air-gapped images pass a local path)."""
+    from transformers import AutoTokenizer
+    return AutoTokenizer.from_pretrained(name_or_path, **kwargs)
+
+
+def tokenize_dataset(ds, tokenizer: Callable, *, text_column: str = "text",
+                     max_length: int = 512, pad_id: int = 0):
+    """Map a ray_tpu.data Dataset of text rows to fixed-length token ids.
+
+    tokenizer: HF tokenizer or any callable str -> list[int] (encode).
+    Produces columns input_ids [L] int32 and attention_mask [L] int8.
+    """
+    def encode_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        texts = [str(t) for t in batch[text_column]]
+        ids_rows, mask_rows = [], []
+        for t in texts:
+            if hasattr(tokenizer, "encode"):
+                ids = tokenizer.encode(t)
+            else:
+                ids = tokenizer(t)
+            ids = list(ids)[:max_length]
+            mask = [1] * len(ids) + [0] * (max_length - len(ids))
+            ids = ids + [pad_id] * (max_length - len(ids))
+            ids_rows.append(ids)
+            mask_rows.append(mask)
+        return {"input_ids": np.asarray(ids_rows, np.int32),
+                "attention_mask": np.asarray(mask_rows, np.int8)}
+
+    return ds.map_batches(encode_batch)
+
+
+def hf_dataset_to_ray(hf_dataset, columns: Optional[Iterable[str]] = None):
+    """`datasets` Dataset -> ray_tpu.data Dataset (columnar numpy)."""
+    from ..data import from_items
+    cols = list(columns) if columns else hf_dataset.column_names
+    rows = [{c: ex[c] for c in cols} for ex in hf_dataset]
+    return from_items(rows)

@@ -1,0 +1,78 @@
+"""Backend setup: per-worker JAX runtime + mesh formation.
+
+Reference counterpart: ray.train backend configs (train/backend.py,
+train/torch/config.py — the piece that runs `dist.init_process_group`
+on every worker with a rendezvous address). JAX translation: workers
+call `jax.distributed.initialize(coordinator, num_processes, process_id)`
+and then build one global Mesh; on a single host (or under the test CPU
+mesh) initialization is a no-op and the mesh forms over local devices.
+
+Multi-host TPU pods: each host runs one worker process that owns the
+host's local chips; the coordinator address is the rank-0 host. All
+cross-host tensor traffic happens inside jit via XLA collectives over
+ICI/DCN — this backend only forms the mesh, it never moves tensors.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+from ..parallel.mesh import MeshSpec, build_mesh
+
+
+@dataclasses.dataclass
+class JaxBackendConfig:
+    """Reference analogue: TorchConfig(backend='nccl', init_method=...)."""
+    coordinator_address: Optional[str] = None   # "host:port" of rank 0
+    num_processes: Optional[int] = None
+    heartbeat_timeout_s: int = 100
+
+
+def setup_worker(config: JaxBackendConfig, *, process_id: int,
+                 num_processes: Optional[int] = None) -> None:
+    """Initialize this worker's JAX distributed runtime (multi-host).
+
+    No-op when single-process: jax.distributed.initialize is only needed
+    (and only valid) when several processes form one XLA computation.
+    """
+    world = num_processes or config.num_processes or 1
+    if world <= 1 or config.coordinator_address is None:
+        return
+    if jax.process_count() > 1:
+        return          # already initialized
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator_address,
+        num_processes=world,
+        process_id=process_id,
+        initialization_timeout=config.heartbeat_timeout_s)
+
+
+def form_mesh(spec: Optional[MeshSpec] = None) -> jax.sharding.Mesh:
+    """Build the global device mesh (all processes' devices). Must be
+    called with identical spec on every worker."""
+    spec = spec or MeshSpec(dp=len(jax.devices()))
+    return build_mesh(spec)
+
+
+def worker_env(rank: int, world_size: int,
+               coordinator_address: Optional[str]) -> dict:
+    """Env block a launcher injects into each worker process (reference:
+    the env vars torch backend sets: RANK/WORLD_SIZE/MASTER_ADDR)."""
+    env = {
+        "RAY_TPU_TRAIN_RANK": str(rank),
+        "RAY_TPU_TRAIN_WORLD": str(world_size),
+    }
+    if coordinator_address:
+        env["RAY_TPU_COORDINATOR"] = coordinator_address
+    return env
+
+
+def detect_rank() -> int:
+    return int(os.environ.get("RAY_TPU_TRAIN_RANK", "0"))
+
+
+def detect_world_size() -> int:
+    return int(os.environ.get("RAY_TPU_TRAIN_WORLD", "1"))

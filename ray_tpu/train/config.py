@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from ..parallel.mesh import MeshSpec
 
@@ -57,6 +57,10 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
     verbose: int = 1
+    # tune: stop condition (dict | callable | Stopper) and lifecycle
+    # callbacks (reference: air.RunConfig(stop=..., callbacks=[...]))
+    stop: Optional[Any] = None
+    callbacks: Optional[list] = None
 
     def run_dir(self) -> str:
         base = self.storage_path or os.path.join(

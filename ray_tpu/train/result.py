@@ -15,6 +15,7 @@ class Result:
     metrics_history: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
     path: str = ""
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def best_checkpoints(self):

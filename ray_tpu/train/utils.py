@@ -1,0 +1,49 @@
+"""Training prep utilities: shard modules onto the mesh, split loaders.
+
+Reference counterpart: ray.train.torch prepare_model /
+prepare_data_loader (train/torch/train_loop_utils.py). TPU translation:
+"prepare" a model by device_put-ing its params with NamedShardings from
+the parallel sharding rules; "prepare" a loader by giving each worker
+its rank's shard and device-prefetching batches.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..parallel.sharding import shard_pytree, replicated
+
+
+def prepare_module(params: Any, mesh: Optional[jax.sharding.Mesh] = None,
+                   *, rules: Optional[Any] = None) -> Any:
+    """Place a param pytree onto the mesh per the sharding rules
+    (fsdp/tp axes); no mesh -> single-device put."""
+    if mesh is None:
+        return jax.device_put(params)
+    if rules is None:
+        return jax.device_put(params, replicated(mesh))
+    return shard_pytree(params, mesh, rules)
+
+
+def prepare_loader(dataset, *, rank: int, world_size: int,
+                   batch_size: int, sharding=None,
+                   prefetch: int = 2) -> Iterable:
+    """Per-worker shard of a ray_tpu.data Dataset as device batches.
+
+    Equivalent altitude to prepare_data_loader: rank-split, batch, then
+    double-buffered host->HBM prefetch (ray_tpu.data.device_loader).
+    """
+    from ..data.device_loader import device_put_iterator
+    shard = dataset.split_for_worker(rank, world_size)
+    return device_put_iterator(shard.iter_batches(batch_size=batch_size),
+                               sharding=sharding, prefetch=prefetch)
+
+
+def iter_batches_sharded(arrays_iter: Iterator[Any], sharding,
+                         prefetch: int = 2) -> Iterator[Any]:
+    """Wrap any host-batch iterator with sharded device_put prefetch."""
+    from ..data.device_loader import device_put_iterator
+    return device_put_iterator(arrays_iter, sharding=sharding,
+                               prefetch=prefetch)

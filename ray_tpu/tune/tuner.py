@@ -65,7 +65,22 @@ class _TrialActor:
 
         tune_session._init_trial(self.trial_id, sync_report)
         try:
-            fn(config)
+            from .trainable import Trainable
+            if isinstance(fn, type) and issubclass(fn, Trainable):
+                trainable = fn(config)
+                try:
+                    while True:
+                        result = trainable.train()
+                        new_cfg = tune_session.report(result)
+                        if new_cfg:        # PBT exploit: adopt new hparams
+                            trainable.config.update(new_cfg)
+                            trainable.setup(trainable.config)
+                        if result.get("done"):
+                            break
+                finally:
+                    trainable.stop()
+            else:
+                fn(config)
             return "COMPLETED"
         except tune_session.StopTrial:
             return "STOPPED"
@@ -108,7 +123,8 @@ class ResultGrid:
             raise ValueError(f"no trial reported metric {metric!r}")
         t = best[1]
         return Result(metrics=dict(t.last_metrics, config=t.config),
-                      checkpoint=None, metrics_history=t.history)
+                      checkpoint=None, metrics_history=t.history,
+                      config=dict(t.config))
 
     def dataframe(self):
         import pandas as pd
@@ -127,7 +143,8 @@ class ResultGrid:
     def __getitem__(self, i):
         t = self.trials[i]
         return Result(metrics=dict(t.last_metrics, config=t.config),
-                      checkpoint=None, metrics_history=t.history)
+                      checkpoint=None, metrics_history=t.history,
+                      config=dict(t.config))
 
 
 class Tuner:
@@ -150,28 +167,62 @@ class Tuner:
         rt = runtime_mod.get_runtime()
         tc = self.tune_config
         sched = tc.scheduler
+        from .stoppers import make_stopper
+        from .loggers import CSVLoggerCallback, JsonLoggerCallback
+        stopper = make_stopper(getattr(self.run_config, "stop", None))
+        run_dir = self.run_config.run_dir()
+        callbacks = list(getattr(self.run_config, "callbacks", None) or ())
+        callbacks += [CSVLoggerCallback(run_dir),
+                      JsonLoggerCallback(run_dir)]
+        searcher = tc.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self.param_space)
+            variants = []          # generated lazily via suggest()
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+        trials: List[Trial] = []
+        self._stop_all = False
 
-        variants = generate_variants(self.param_space, tc.num_samples,
-                                     tc.seed)
-        trials = [Trial(f"trial_{self._tid}_{i:04d}", cfg)
-                  for i, cfg in enumerate(variants)]
-        for t in trials:
+        def add_trial(cfg) -> Trial:
+            t = Trial(f"trial_{self._tid}_{len(trials):04d}", cfg)
+            trials.append(t)
             self._trials[t.trial_id] = t
             if isinstance(sched, PopulationBasedTraining):
                 sched.register(t.trial_id, t.config)
+            for cb in callbacks:
+                try:
+                    cb.on_trial_start(t.trial_id, t.config)
+                except Exception:
+                    traceback.print_exc()
+            return t
 
         def on_report(worker_id, payload):
             with self._lock:
                 trial = self._trials.get(payload["trial_id"])
                 if trial is None:
-                    return CONTINUE
+                    return {"decision": CONTINUE}
                 trial.iteration = payload.get("iteration", trial.iteration)
                 metrics = payload.get("metrics", {})
                 trial.last_metrics = metrics
                 trial.history.append(metrics)
+                for cb in callbacks:
+                    try:
+                        cb.on_trial_result(trial.trial_id, metrics)
+                    except Exception:
+                        traceback.print_exc()   # never break scheduling
                 value = metrics.get(tc.metric)
                 decision = CONTINUE
-                if value is not None:
+                if self._stop_all:
+                    decision = STOP
+                elif stopper is not None and (
+                        stopper(trial.trial_id, metrics)
+                        or stopper.stop_all()):
+                    decision = STOP
+                    if stopper.stop_all():
+                        self._stop_all = True
+                elif value is not None:
                     decision = sched.on_result(trial.trial_id,
                                                trial.iteration, float(value))
                 reply = {"decision": decision}
@@ -183,17 +234,36 @@ class Tuner:
 
         rt.register_report_handler(self.channel, on_report)
 
-        pending = list(trials)
+        pending = list(variants)       # configs (searcher=None) only
+        issued = 0
         running: List[Trial] = []
         finished: List[Trial] = []
-        while pending or running:
-            while pending and len(running) < tc.max_concurrent_trials:
-                t = pending.pop(0)
+
+        def next_config():
+            nonlocal issued
+            if self._stop_all:
+                return None
+            if searcher is not None:
+                if issued >= tc.num_samples:
+                    return None
+                cfg = searcher.suggest(f"trial_{self._tid}_{issued:04d}")
+                issued += 1
+                return cfg
+            return pending.pop(0) if pending else None
+
+        while True:
+            while len(running) < tc.max_concurrent_trials:
+                cfg = next_config()
+                if cfg is None:
+                    break
+                t = add_trial(cfg)
                 t.status = "RUNNING"
                 actor_cls = api.remote(num_cpus=1)(_TrialActor)
                 t.actor = actor_cls.remote(t.trial_id, self.channel)
                 t.done_ref = t.actor.run.remote(self._trainable, t.config)
                 running.append(t)
+            if not running:
+                break
             done_refs = [t.done_ref for t in running]
             ready, _ = api.wait(done_refs, num_returns=1, timeout=300.0)
             still = []
@@ -203,10 +273,23 @@ class Tuner:
                         outcome = api.get(t.done_ref)
                         t.status = ("TERMINATED" if outcome == "COMPLETED"
                                     else "STOPPED")
+                        for cb in callbacks:
+                            try:
+                                cb.on_trial_complete(t.trial_id)
+                            except Exception:
+                                traceback.print_exc()
                     except Exception as e:  # noqa: BLE001
                         t.status = "ERROR"
                         t.error = repr(e)
+                        for cb in callbacks:
+                            try:
+                                cb.on_trial_error(t.trial_id, t.error)
+                            except Exception:
+                                traceback.print_exc()
                     sched.on_complete(t.trial_id)
+                    if searcher is not None:
+                        searcher.on_trial_complete(t.trial_id,
+                                                   t.last_metrics)
                     try:
                         api.kill(t.actor)
                     except Exception:
@@ -216,6 +299,11 @@ class Tuner:
                     still.append(t)
             running = still
 
+        for cb in callbacks:
+            try:
+                cb.on_experiment_end(trials)
+            except Exception:
+                traceback.print_exc()
         self._write_experiment_state(trials)
         return ResultGrid(trials, tc.metric, tc.mode)
 

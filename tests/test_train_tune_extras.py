@@ -1,0 +1,225 @@
+"""Train/tune extras tests: HF weight import parity, prepare utils,
+backend, stoppers, loggers, TPE search, class Trainable.
+(parity model: ray train/tests/test_torch_trainer.py interop tests,
+tune/tests/test_trial_scheduler.py, test_searchers.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+# ---------- HF weight import ----------
+
+@pytest.mark.slow
+def test_gpt2_hf_import_forward_parity():
+    """Random-init HF GPT-2 (tiny) and our flax GPT-2 must produce the
+    same logits given the same weights."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.gpt2 import GPT2, GPT2Config
+    from ray_tpu.train.adapters import import_hf_gpt2_weights
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    params, cfg = import_hf_gpt2_weights(hf_model)
+    cfg = GPT2Config(vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+                     n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                     max_seq_len=cfg.max_seq_len, dtype=jnp.float32)
+    model = GPT2(cfg)
+
+    tokens = np.array([[1, 5, 9, 2, 7, 3]], np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params},
+                                  jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_llama_hf_import_forward_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import jax.numpy as jnp
+    from ray_tpu.models.llama import Llama, LlamaConfig
+    from ray_tpu.train.adapters import import_hf_llama_weights
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0)
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    params, cfg = import_hf_llama_weights(hf_model)
+    cfg = LlamaConfig(vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+                      n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                      n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+                      max_seq_len=cfg.max_seq_len,
+                      rope_theta=cfg.rope_theta,
+                      tie_embeddings=cfg.tie_embeddings,
+                      norm_eps=hf_cfg.rms_norm_eps, dtype=jnp.float32)
+    model = Llama(cfg)
+
+    tokens = np.array([[3, 1, 4, 1, 5, 9]], np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    out = model.apply({"params": params}, jnp.asarray(tokens))
+    ours = np.asarray(out[0] if isinstance(out, tuple) else out)
+    np.testing.assert_allclose(ours, ref, atol=3e-3, rtol=3e-3)
+
+
+def test_tokenize_dataset():
+    from ray_tpu.data import from_items
+    from ray_tpu.train.adapters import tokenize_dataset
+    ds = from_items([{"text": "ab"}, {"text": "abcd"}])
+    tok = lambda s: [ord(c) for c in s]
+    out = tokenize_dataset(ds, tok, max_length=6)
+    rows = out.take_all()
+    assert rows[0]["input_ids"].tolist()[:2] == [97, 98]
+    assert sum(rows[0]["attention_mask"]) == 2
+    assert sum(rows[1]["attention_mask"]) == 4
+
+
+# ---------- prepare utils / backend ----------
+
+def test_prepare_module_mesh():
+    import jax
+    from ray_tpu.train import prepare_module, form_mesh
+    from ray_tpu.parallel.mesh import MeshSpec
+    mesh = form_mesh(MeshSpec(dp=len(jax.devices())))
+    params = {"w": np.ones((8, 4), np.float32)}
+    placed = prepare_module(params, mesh)
+    assert placed["w"].sharding.mesh.shape == mesh.shape
+
+
+def test_prepare_loader_rank_split(rt):
+    from ray_tpu.data import range as ds_range
+    from ray_tpu.train.utils import prepare_loader
+    ds = ds_range(32).repartition(4)    # sharding is block-granular
+    batches = list(prepare_loader(ds, rank=0, world_size=2, batch_size=8))
+    total = sum(len(b["id"]) for b in batches)
+    assert total == 16
+
+
+def test_backend_env_roundtrip():
+    from ray_tpu.train.backend import (worker_env, detect_rank,
+                                       detect_world_size)
+    env = worker_env(3, 8, "10.0.0.1:1234")
+    old = dict(os.environ)
+    os.environ.update(env)
+    try:
+        assert detect_rank() == 3
+        assert detect_world_size() == 8
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+        os.environ.update({k: v for k, v in old.items() if k in env})
+
+
+# ---------- stoppers ----------
+
+def test_stoppers():
+    from ray_tpu.tune import (MaximumIterationStopper, TrialPlateauStopper,
+                              TimeoutStopper, CombinedStopper)
+    s = MaximumIterationStopper(3)
+    assert [s("t", {}) for _ in range(3)] == [False, False, True]
+
+    p = TrialPlateauStopper("loss", std=0.0, num_results=3, grace_period=3)
+    vals = [5.0, 4.0, 3.0, 3.0, 3.0]
+    out = [p("t", {"loss": v}) for v in vals]
+    assert out[-1] is True and not any(out[:3])
+
+    t = TimeoutStopper(1e9)
+    c = CombinedStopper(MaximumIterationStopper(1), t)
+    assert c("t", {"x": 1}) is True   # max-iter fires
+    assert c.stop_all() is False
+
+
+def test_make_stopper_dict():
+    from ray_tpu.tune.stoppers import make_stopper
+    s = make_stopper({"training_iteration": 5})
+    assert s("t", {"training_iteration": 4}) is False
+    assert s("t", {"training_iteration": 5}) is True
+
+
+# ---------- tuner integration: stop dict + loggers ----------
+
+def test_tuner_stop_and_loggers(rt, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    def trainable(config):
+        for i in range(100):
+            tune.report({"score": i, "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.choice([0.1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=1),
+        run_config=RunConfig(name="stoptest", storage_path=str(tmp_path),
+                             stop={"training_iteration": 5}))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["training_iteration"] == 5    # stopped early
+    trial_id = grid.trials[0].trial_id
+    assert os.path.exists(str(tmp_path) + f"/stoptest/{trial_id}/progress.csv")
+    assert os.path.exists(str(tmp_path) + f"/stoptest/{trial_id}/result.json")
+
+
+# ---------- TPE search ----------
+
+def test_tpe_moves_toward_optimum(rt, tmp_path):
+    """Quadratic bowl: after warmup, TPE suggestions should concentrate
+    near the optimum x=0.7 better than uniform random."""
+    from ray_tpu.train.config import RunConfig
+
+    def objective(config):
+        x = config["x"]
+        tune.report({"score": -(x - 0.7) ** 2})
+
+    sampler = tune.TPESampler(n_startup=10, seed=1)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=40, search_alg=sampler,
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 40
+    best = grid.get_best_result()
+    assert abs(best.config["x"] - 0.7) < 0.15
+    # suggestions after warmup should average closer to optimum than random
+    late = [t.config["x"] for t in grid.trials[20:]]
+    assert abs(np.mean(late) - 0.7) < 0.2
+
+
+# ---------- class Trainable ----------
+
+def test_class_trainable(rt, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["start"]
+
+        def step(self):
+            self.x += 1
+            return {"score": self.x, "done": self.x >= self.config["until"]}
+
+    tuner = tune.Tuner(
+        MyTrainable,
+        param_space={"start": tune.grid_search([0, 10]), "until": 13},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert grid.get_best_result().metrics["score"] == 13
