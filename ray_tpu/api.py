@@ -28,7 +28,8 @@ AUTO_PUT_THRESHOLD = 256 * 1024  # large ndarray args go through the store
 
 def init(*, num_cpus=None, num_tpus=None, resources=None,
          object_store_memory=None, namespace="default",
-         max_workers=None, ignore_reinit_error=True, **_ignored):
+         max_workers=None, ignore_reinit_error=True, log_to_driver=True,
+         **_ignored):
     """Start the ray_tpu runtime in this (driver) process."""
     with _init_lock:
         if runtime_mod.runtime_initialized():
@@ -38,7 +39,8 @@ def init(*, num_cpus=None, num_tpus=None, resources=None,
         rt = DriverRuntime(num_cpus=num_cpus, num_tpus=num_tpus,
                            resources=resources,
                            object_store_memory=object_store_memory,
-                           namespace=namespace, max_workers=max_workers)
+                           namespace=namespace, max_workers=max_workers,
+                           log_to_driver=log_to_driver)
         runtime_mod.set_runtime(rt)
         return rt
 

@@ -1,0 +1,91 @@
+"""Worker log capture + driver streaming.
+
+Reference counterpart: python/ray/_private/ray_logging — per-worker log
+files under the session dir, with `log_to_driver=True` tailing them into
+the driver's stdout prefixed `(worker_id pid)` the way `(raylet)` /
+`(pid=...)` prefixes work in the reference.
+
+Capture is fd-level (dup2), so C/C++ native prints (XLA, the shm arena)
+land in the file too, not just Python's sys.stdout.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+
+def redirect_process_output(log_path: str) -> None:
+    """In the worker: point fd 1/2 at log_path (line-buffered)."""
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    # rebind the Python-level streams to the new fds, line-buffered
+    sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+    sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+
+
+class LogStreamer:
+    """In the driver: tail every worker log file, prefix, and echo."""
+
+    def __init__(self, log_dir: str, *, out=None, poll_interval_s: float = 0.2):
+        self.log_dir = log_dir
+        self.out = out or sys.stdout
+        self.poll_interval_s = poll_interval_s
+        self._pos: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-log-stream")
+        self._thread.start()
+
+    def _emit(self, fname: str, chunk: str) -> None:
+        label = fname.rsplit(".", 1)[0]          # worker-w0001
+        for line in chunk.splitlines():
+            if line.strip():
+                self.out.write(f"({label}) {line}\n")
+        try:
+            self.out.flush()
+        except Exception:
+            pass
+
+    def _scan_once(self, final: bool = False) -> None:
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            return
+        for fname in names:
+            if not fname.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, fname)
+            pos = self._pos.get(fname, 0)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    raw = f.read()
+            except OSError:
+                continue
+            if not raw:
+                continue
+            # consume only whole lines so a poll landing mid-write never
+            # splits a line (or a multi-byte char); the final drain takes
+            # whatever remains.
+            cut = len(raw) if final else raw.rfind(b"\n") + 1
+            if cut <= 0:
+                continue
+            self._pos[fname] = pos + cut
+            self._emit(fname, raw[:cut].decode("utf-8", errors="replace"))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self._scan_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)   # no concurrent scans
+        self._scan_once(final=True)      # drain, including partial lines

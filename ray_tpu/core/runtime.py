@@ -132,6 +132,12 @@ class DriverRuntime:
         self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_")
         self.socket_path = os.path.join(self._tmpdir, "driver.sock")
         self._listener = unix_listener(self.socket_path)
+        self.log_dir = os.path.join(self._tmpdir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._log_streamer = None
+        if log_to_driver:
+            from .logging import LogStreamer  # noqa: PLC0415
+            self._log_streamer = LogStreamer(self.log_dir)
 
         self.inbox: "queue.Queue" = queue.Queue()
         self.workers: Dict[str, WorkerState] = {}
@@ -576,6 +582,7 @@ class DriverRuntime:
         wid = f"w{self._wid_counter:04d}"
         env = dict(os.environ)
         env["RAY_TPU_JOB_ID"] = self.job_id
+        env["RAY_TPU_LOG_DIR"] = self.log_dir
         env.setdefault("PYTHONPATH", "")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -1005,13 +1012,12 @@ class DriverRuntime:
             self._listener.close()
         except Exception:
             pass
+        if self._log_streamer is not None:
+            self._log_streamer.stop()
         self.inbox.put(None)
         self.store.shutdown()
-        try:
-            os.unlink(self.socket_path)
-            os.rmdir(self._tmpdir)
-        except OSError:
-            pass
+        import shutil
+        shutil.rmtree(self._tmpdir, ignore_errors=True)
         global _runtime
         with _runtime_lock:
             if _runtime is self:

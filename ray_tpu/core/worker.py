@@ -329,6 +329,11 @@ class WorkerLoop:
 
 def main() -> None:
     socket_path, worker_id = sys.argv[1], sys.argv[2]
+    log_dir = os.environ.get("RAY_TPU_LOG_DIR")
+    if log_dir:
+        from .logging import redirect_process_output  # noqa: PLC0415
+        redirect_process_output(
+            os.path.join(log_dir, f"worker-{worker_id}.log"))
     try:
         loop = WorkerLoop(socket_path, worker_id)
     except (ConnectionRefusedError, FileNotFoundError):

@@ -1,4 +1,5 @@
 """Pallas TPU kernels — the hand-written hot ops (SURVEY.md §2.2 P9)."""
 from .flash_attention import flash_attention
+from .rmsnorm import fused_rms_norm
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_rms_norm"]

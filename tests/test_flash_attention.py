@@ -61,3 +61,56 @@ def test_llama_pallas_impl_runs():
     logits, _ = model.apply({"params": params},
                             jnp.zeros((1, 16), jnp.int32))
     assert np.isfinite(np.asarray(logits)).all()
+
+
+# ---------- fused rmsnorm (pallas) ----------
+
+def test_fused_rms_norm_matches_xla():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.norms import rms_norm
+    from ray_tpu.ops.pallas import fused_rms_norm
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 33, 256), jnp.float32)   # ragged rows
+    w = jnp.asarray(rng.randn(256), jnp.float32)
+    ref = rms_norm(x, w)
+    out = fused_rms_norm(x, w, block_rows=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fused_rms_norm_grads_match():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.norms import rms_norm
+    from ray_tpu.ops.pallas import fused_rms_norm
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 64), jnp.float32)
+    w = jnp.asarray(rng.randn(64), jnp.float32)
+
+    def loss_p(x, w):
+        return jnp.sum(fused_rms_norm(x, w) ** 2)
+
+    def loss_x(x, w):
+        return jnp.sum(rms_norm(x, w) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1))(x, w)
+    gx = jax.grad(loss_x, argnums=(0, 1))(x, w)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_fused_rms_norm_bf16_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.pallas import fused_rms_norm
+    x = jnp.ones((4, 128), jnp.bfloat16) * 3
+    w = jnp.ones((128,), jnp.bfloat16)
+    out = fused_rms_norm(x, w)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), 1.0, atol=2e-2)

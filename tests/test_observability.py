@@ -244,3 +244,29 @@ def test_metrics_label_escaping():
     c.inc(tags={"p": 'say "hi"\n'})
     text = metrics_mod.exposition()
     assert 'p="say \\"hi\\"\\n"' in text
+
+
+def test_worker_logs_captured_and_streamed(capfd):
+    """O7: worker prints land in per-worker files and stream to the driver
+    prefixed with the worker id."""
+    import subprocess, sys, textwrap
+    code = textwrap.dedent("""
+        import time
+        import ray_tpu
+
+        @ray_tpu.remote
+        def noisy():
+            print("hello-from-worker")
+            return 1
+
+        ray_tpu.init(num_cpus=2)
+        ray_tpu.get(noisy.remote())
+        time.sleep(0.6)         # let the streamer poll
+        ray_tpu.shutdown()
+    """)
+    env = {**__import__('os').environ,
+           "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120, env=env)
+    assert "hello-from-worker" in out.stdout
+    assert "(worker-" in out.stdout       # prefixed streaming
