@@ -1,0 +1,165 @@
+"""`python -m ray_tpu` — cluster state CLI.
+
+Reference counterpart: the `ray` CLI (`ray status`, `ray summary
+tasks|actors|objects`, `ray list actors|tasks|...`, `ray timeline`,
+`ray job submit|status|logs`). Single-controller twist: there is no
+long-lived head node to dial into from a cold process, so state
+subcommands attach to a live driver via its dashboard URL (--address),
+while `job` runs locally.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _open(address: str, route: str) -> bytes:
+    url = address.rstrip("/") + route
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read()
+    except (urllib.error.URLError, OSError) as e:
+        sys.stderr.write(
+            f"error: cannot reach dashboard at {address} ({e}).\n"
+            "Start one in the driver with "
+            "ray_tpu.observability.start_dashboard(port=8265) and pass "
+            "--address.\n")
+        sys.exit(2)
+
+
+def _fetch(address: str, route: str):
+    return json.loads(_open(address, route))
+
+
+def _print_table(rows, columns):
+    if not rows:
+        print("(empty)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.ljust(widths[c]) for c in columns))
+    print("  ".join("-" * widths[c] for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+
+
+def cmd_status(args):
+    s = _fetch(args.address, "/api/cluster")
+    print(json.dumps(s, indent=2))
+
+
+def cmd_list(args):
+    route = {"actors": "/api/actors", "tasks": "/api/tasks",
+             "objects": "/api/objects", "nodes": "/api/nodes",
+             "workers": "/api/workers",
+             "placement-groups": "/api/placement_groups"}[args.kind]
+    data = _fetch(args.address, route + f"?limit={args.limit}")
+    if args.kind == "objects":
+        data = data["objects"]
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return
+    cols = {
+        "actors": ["actor_id", "class_name", "state", "name", "worker_id"],
+        "tasks": ["task_id", "name", "state", "worker_id", "duration_s"],
+        "objects": ["object_id", "state", "size_bytes", "store_kind"],
+        "nodes": ["node_id", "hostname", "alive"],
+        "workers": ["worker_id", "pid", "state", "actor_id"],
+        "placement-groups": ["placement_group_id", "name", "strategy",
+                             "state"],
+    }[args.kind]
+    _print_table(data, cols)
+
+
+def cmd_summary(args):
+    print(json.dumps(_fetch(args.address, f"/api/summary/{args.kind}"),
+                     indent=2))
+
+
+def cmd_timeline(args):
+    events = _fetch(args.address, "/api/timeline")
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} "
+          "(load in chrome://tracing or Perfetto)")
+
+
+def cmd_metrics(args):
+    sys.stdout.write(_open(args.address, "/metrics").decode())
+
+
+_job_client = None
+
+
+def _jobs():
+    global _job_client
+    if _job_client is None:
+        from .core.jobs import JobSubmissionClient
+        _job_client = JobSubmissionClient()
+    return _job_client
+
+
+def cmd_job(args):
+    client = _jobs()
+    if args.job_cmd == "submit":
+        entry = list(args.entrypoint)
+        if entry and entry[0] == "--":       # `job submit -- cmd ...`
+            entry = entry[1:]
+        if not entry:
+            sys.stderr.write("error: job submit needs an entrypoint, "
+                             "e.g. `ray_tpu job submit -- python x.py`\n")
+            sys.exit(2)
+        sid = client.submit_job(entrypoint=" ".join(entry))
+        status = client.wait_until_finished(sid, timeout=args.timeout)
+        print(client.get_job_logs(sid), end="")
+        print(f"job {sid}: {status}")
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster state CLI")
+    p.add_argument("--address", default="http://127.0.0.1:8265",
+                   help="dashboard URL of a live driver "
+                        "(start one with ray_tpu.observability."
+                        "start_dashboard(port=8265))")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster summary").set_defaults(
+        fn=cmd_status)
+
+    lp = sub.add_parser("list", help="list cluster entities")
+    lp.add_argument("kind", choices=["actors", "tasks", "objects", "nodes",
+                                     "workers", "placement-groups"])
+    lp.add_argument("--limit", type=int, default=100)
+    lp.add_argument("--json", action="store_true")
+    lp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="rollups by name/state")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    tp = sub.add_parser("timeline", help="export chrome-trace JSON")
+    tp.add_argument("-o", "--output", default="timeline.json")
+    tp.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser("metrics", help="Prometheus exposition").set_defaults(
+        fn=cmd_metrics)
+
+    jp = sub.add_parser("job", help="run a driver script as a job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    jsp = jsub.add_parser("submit")
+    jsp.add_argument("--timeout", type=float, default=3600.0)
+    jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    jsp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(state_mod.list_workers(limit=limit))
             elif route == "/api/placement_groups":
                 self._json(state_mod.list_placement_groups(limit=limit))
+            elif route == "/api/summary/tasks":
+                self._json(state_mod.summarize_tasks())
+            elif route == "/api/summary/actors":
+                self._json(state_mod.summarize_actors())
+            elif route == "/api/summary/objects":
+                self._json(state_mod.summarize_objects())
             elif route == "/api/timeline":
                 self._json(timeline_mod.timeline_events())
             elif route == "/metrics":
@@ -73,6 +79,9 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/actors", "/api/tasks",
                                        "/api/objects", "/api/workers",
                                        "/api/placement_groups",
+                                       "/api/summary/tasks",
+                                       "/api/summary/actors",
+                                       "/api/summary/objects",
                                        "/api/timeline", "/metrics"]})
             else:
                 self._json({"error": f"no route {route}"}, 404)

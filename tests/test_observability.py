@@ -270,3 +270,37 @@ def test_worker_logs_captured_and_streamed(capfd):
                          text=True, timeout=120, env=env)
     assert "hello-from-worker" in out.stdout
     assert "(worker-" in out.stdout       # prefixed streaming
+
+
+def test_cli_against_dashboard(rt, tmp_path):
+    """The `python -m ray_tpu` CLI reads the live dashboard endpoints."""
+    import io
+    from contextlib import redirect_stdout
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+    from ray_tpu.cli import main as cli_main
+
+    ray_tpu.get(_square.remote(2))
+    dash = start_dashboard()
+    try:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "status"])
+        assert json.loads(buf.getvalue())["nodes"] == 1
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "list", "tasks", "--json"])
+        assert any(t["state"] == "FINISHED" for t in json.loads(buf.getvalue()))
+
+        out_path = str(tmp_path / "tl.json")
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "timeline", "-o", out_path])
+        assert json.load(open(out_path))
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "summary", "tasks"])
+        assert json.loads(buf.getvalue())["total"] >= 1
+    finally:
+        stop_dashboard()
