@@ -1,0 +1,27 @@
+"""Streaming data pipeline: lazy plan -> fused execution -> HBM batches.
+
+Run: python examples/data_pipeline.py
+"""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+
+
+def main():
+    ray_tpu.init()
+    ds = (data.range(100_000)
+          .map_batches(lambda b: {"x": b["id"].astype(np.float32)})
+          .map_batches(lambda b: {"x": b["x"], "y": np.sqrt(b["x"])})
+          .random_shuffle(seed=0))
+    print(ds)
+    total = 0
+    for batch in ds.iter_jax_batches(batch_size=4096):
+        total += batch["x"].shape[0]         # batch already on device
+    print("rows streamed to device:", total)
+    print(ds.stats())
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""PPO on CartPole: CPU env runners feed a jitted JAX learner.
+
+Run: python examples/rllib_cartpole.py
+"""
+from ray_tpu.rllib import PPOConfig, CartPole
+
+
+def main():
+    algo = (PPOConfig()
+            .environment(CartPole)
+            .env_runners(num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(lr=3e-4, num_epochs=6, minibatch_size=256,
+                      entropy_coeff=0.01)
+            .build())
+    for i in range(10):
+        result = algo.train()
+        print(f"iter {i}: return={result['episode_return_mean']}")
+    print("eval:", algo.evaluate())
+
+
+if __name__ == "__main__":
+    main()

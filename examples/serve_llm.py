@@ -1,0 +1,42 @@
+"""North-star flow, part 2: serve the decoder with continuous batching.
+
+One replica owns the TPU chip; the engine packs concurrent requests into
+shared prefill/decode jit-steps over a paged-slot KV cache, streaming
+tokens per request (SSE over HTTP, or handle.remote for in-process).
+
+Run: python examples/serve_llm.py
+"""
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.llm import build_llm_deployment
+
+
+def model_factory():
+    import jax
+    from ray_tpu.models import Llama, LlamaConfig
+    cfg = LlamaConfig.debug()
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    # production: params = restore_pytree(<pretrain checkpoint path>)
+    return model, params
+
+
+def main():
+    ray_tpu.init()
+    app = build_llm_deployment(model_factory,
+                               engine_config={"max_slots": 4,
+                                              "max_seq_len": 128,
+                                              "max_new_tokens_default": 8})
+    handle = serve.run(app)
+    out = handle.remote({"prompt": [1, 2, 3, 4], "max_tokens": 8}).result()
+    print("generated token ids:", out["tokens"])
+    # streaming: tokens arrive as they decode
+    for tok in handle.options(stream=True).remote(
+            {"prompt": [1, 2, 3], "max_tokens": 4, "stream": True}):
+        print("streamed:", tok)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Hyperparameter sweep with ASHA early stopping over trial actors.
+
+Run: python examples/tune_asha.py
+"""
+import ray_tpu
+from ray_tpu import tune
+
+
+def train_fn(config):
+    # stand-in objective: converges faster with better lr
+    acc = 0.0
+    for step in range(20):
+        acc += config["lr"] * (1.0 - acc)
+        tune.report({"accuracy": acc, "training_iteration": step + 1})
+
+
+def main():
+    ray_tpu.init()
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.loguniform(1e-3, 1.0),
+                     "wd": tune.choice([0.0, 0.1])},
+        tune_config=tune.TuneConfig(metric="accuracy", mode="max",
+                                    num_samples=8,
+                                    scheduler=tune.ASHAScheduler(
+                                        metric="accuracy", mode="max"))
+    ).fit()
+    best = grid.get_best_result()
+    print("best config:", best.config, "accuracy:",
+          best.metrics["accuracy"])
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,10 @@ def _open(address: str, route: str) -> bytes:
     try:
         with urllib.request.urlopen(url, timeout=10) as r:
             return r.read()
+    except urllib.error.HTTPError as e:       # dashboard is up: show body
+        body = e.read().decode(errors="replace")
+        sys.stderr.write(f"error: {url} -> HTTP {e.code}: {body}\n")
+        sys.exit(2)
     except (urllib.error.URLError, OSError) as e:
         sys.stderr.write(
             f"error: cannot reach dashboard at {address} ({e}).\n"
@@ -92,19 +96,9 @@ def cmd_metrics(args):
     sys.stdout.write(_open(args.address, "/metrics").decode())
 
 
-_job_client = None
-
-
-def _jobs():
-    global _job_client
-    if _job_client is None:
-        from .core.jobs import JobSubmissionClient
-        _job_client = JobSubmissionClient()
-    return _job_client
-
-
 def cmd_job(args):
-    client = _jobs()
+    from .core.jobs import JobSubmissionClient
+    client = JobSubmissionClient()
     if args.job_cmd == "submit":
         entry = list(args.entrypoint)
         if entry and entry[0] == "--":       # `job submit -- cmd ...`
@@ -114,7 +108,13 @@ def cmd_job(args):
                              "e.g. `ray_tpu job submit -- python x.py`\n")
             sys.exit(2)
         sid = client.submit_job(entrypoint=" ".join(entry))
-        status = client.wait_until_finished(sid, timeout=args.timeout)
+        try:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+        except TimeoutError:
+            client.stop_job(sid)             # don't orphan the subprocess
+            print(client.get_job_logs(sid), end="")
+            print(f"job {sid}: TIMEOUT after {args.timeout}s (stopped)")
+            sys.exit(1)
         print(client.get_job_logs(sid), end="")
         print(f"job {sid}: {status}")
         sys.exit(0 if status == "SUCCEEDED" else 1)

@@ -26,6 +26,7 @@ class GPT2Config:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    attn_impl: str = "xla"          # "xla" | "pallas"
 
     @property
     def d_ff(self) -> int:
@@ -71,7 +72,8 @@ class GPT2Block(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        att = multi_head_attention(q, k, v, causal=True)
+        att = multi_head_attention(q, k, v, causal=True,
+                                   impl=cfg.attn_impl)
         att = att.reshape(b, s, cfg.d_model)
         x = x + nn.Dense(cfg.d_model, name="attn_out", dtype=cfg.dtype)(att)
 
@@ -102,10 +104,14 @@ class GPT2(nn.Module):
         lnf_w = self.param("ln_f_scale", nn.initializers.ones, (cfg.d_model,))
         lnf_b = self.param("ln_f_bias", nn.initializers.zeros, (cfg.d_model,))
         x = layer_norm(x, lnf_w, lnf_b, cfg.norm_eps)
-        # Tied head with true fp32 logits: Embed.attend would demote to the
-        # module dtype (bf16), so contract against the table explicitly.
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            wte.embedding.astype(jnp.float32))
+        # Tied head: bf16 operands + fp32 accumulation. Casting both sides
+        # to fp32 would force fp32 MXU passes on the single biggest matmul
+        # (d_model x vocab); preferred_element_type gives fp32 logits at
+        # bf16 matmul speed (Embed.attend would demote the ACCUMULATION to
+        # bf16, which does hurt the loss).
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            wte.embedding.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
         return logits
 
     def init_params(self, rng, batch=1, seq=8):

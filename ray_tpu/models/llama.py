@@ -166,6 +166,20 @@ class LlamaBlock(nn.Module):
         return x, new_cache
 
 
+class _LMHead(nn.Module):
+    """Untied head, kernel stored fp32 at params['lm_head']['kernel']
+    (same tree as nn.Dense). Matmul runs bf16-in/fp32-accumulate — MXU
+    native — instead of nn.Dense(dtype=fp32)'s full-fp32 pass."""
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.vocab_size))
+        return jnp.einsum("bsd,dv->bsv", x, kernel.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -196,13 +210,14 @@ class Llama(nn.Module):
                              (cfg.d_model,))
         x = rms_norm(x, final_w, cfg.norm_eps)
         if cfg.tie_embeddings:
-            # Embed.attend would demote to bf16; contract in fp32 explicitly.
-            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                                embed.embedding.astype(jnp.float32))
+            # bf16 operands + fp32 accumulation: fp32-quality logits at
+            # bf16 MXU speed (casting both sides to fp32 would force slow
+            # fp32 passes on the biggest matmul in the model).
+            logits = jnp.einsum("bsd,vd->bsv", x,
+                                embed.embedding.astype(x.dtype),
+                                preferred_element_type=jnp.float32)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False,
-                              name="lm_head", dtype=jnp.float32)(
-                                  x.astype(jnp.float32))
+            logits = _LMHead(cfg.vocab_size, name="lm_head")(x)
         return logits, (new_cache if cache is not None else None)
 
     # ---- convenience ----
