@@ -500,7 +500,7 @@ class DriverRuntime:
             task_needs_tpu = spec.resources.get("TPU", 0) > 0
             w = self._find_idle_worker(needs_tpu=task_needs_tpu)
             if w is None:
-                if self._can_spawn():
+                if self._can_spawn(needs_tpu=task_needs_tpu):
                     self._spawn_worker(purpose=None,
                                        tpu_capable=task_needs_tpu)
                 still.append(spec)
@@ -571,11 +571,23 @@ class DriverRuntime:
                 return w
         return None
 
-    def _can_spawn(self) -> bool:
-        live = sum(1 for w in self.workers.values()
-                   if w.state in ("starting", "idle"))
-        return live == 0 or len([w for w in self.workers.values()
-                                 if w.state != "dead"]) < self.max_workers
+    def _can_spawn(self, needs_tpu: bool = False) -> bool:
+        # A worker can only serve tasks of its own capability kind
+        # (_find_idle_worker matches tpu_capable exactly), so an idle
+        # worker of the WRONG kind must not satisfy demand for the other.
+        ready = sum(1 for w in self.workers.values()
+                    if w.state in ("starting", "idle")
+                    and w.tpu_capable == needs_tpu)
+        if ready == 0:
+            return True
+        # Don't spawn more general workers than could ever run at once:
+        # CPU capacity bounds useful parallelism (reference: worker_pool
+        # caps at num_cpus); max_workers is the hard ceiling. Dedicated
+        # actor workers hold their own resources and don't count.
+        general_alive = len([w for w in self.workers.values()
+                             if w.state != "dead" and w.purpose is None])
+        cpu_cap = int(self.total_resources.get("CPU", 1)) or 1
+        return general_alive < min(self.max_workers, cpu_cap)
 
     def _spawn_worker(self, purpose, tpu_capable: bool = False) -> str:
         self._wid_counter += 1
