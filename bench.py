@@ -1,10 +1,18 @@
 #!/usr/bin/env python
-"""Headline benchmark (BASELINE.json): train tokens/sec/chip.
+"""Headline benchmark (BASELINE.json): train tokens/sec/chip (+ serve).
 
-Config: GPT-2 124M (the reference's single-host config in BASELINE.json),
-seq 1024, causal-LM objective, adamw — run via the ray_tpu SPMD train step
-on the real TPU chip (single-chip mesh). Prints ONE json line:
-  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+Architecture: the PARENT process never imports jax — it spawns one child
+per phase (`--phase train`, `--phase serve`) under a hard wall-clock
+timeout, streams the child's stderr progress lines through, retries on
+any failure, and ALWAYS prints exactly one JSON line at the end:
+  {"metric": ..., "value": N|null, "unit": "tokens/sec/chip",
+   "vs_baseline": N|null, "extra": {...}}
+so a hung TPU init (the image's 'axon' tunnel can take minutes and the
+round-1 bench died rc=124 with no output) degrades to a parseable
+partial result instead of silence.
+
+Children enable the persistent XLA compilation cache, so a retry (or the
+next round) skips recompilation.
 
 vs_baseline compares against the reference-style torch-CPU GPT-2 path
 measured on this host (see TORCH_CPU_BASELINE below; re-measure with
@@ -15,67 +23,165 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 # Measured on this image (1-core CPU host, torch GPT-2 124M fwd+bwd+adamw,
 # batch 4 x seq 256) via `python bench.py --measure-torch-baseline`:
 # {"torch_cpu_tokens_per_s": 24.08} on 2026-07-29.
 TORCH_CPU_BASELINE_TOKENS_PER_S = 24.1
 
-BATCH = 8
-SEQ = 1024
-WARMUP_STEPS = 3
-MEASURE_STEPS = 20
+BATCH = int(os.environ.get("RAY_TPU_BENCH_BATCH", 8))
+SEQ = int(os.environ.get("RAY_TPU_BENCH_SEQ", 1024))
+WARMUP_STEPS = int(os.environ.get("RAY_TPU_BENCH_WARMUP", 3))
+MEASURE_STEPS = int(os.environ.get("RAY_TPU_BENCH_STEPS", 20))
+
+TRAIN_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TRAIN_TIMEOUT", 1500))
+SERVE_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_SERVE_TIMEOUT", 900))
+ATTEMPTS = int(os.environ.get("RAY_TPU_BENCH_ATTEMPTS", 2))
 
 
-def measure_ray_tpu() -> dict:
+def _progress(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _setup_jax_child() -> "tuple":
+    """Child-side jax init: compilation cache + timed backend bring-up."""
     import jax
+    _progress("initializing jax backend (TPU tunnel init can take minutes)")
+    t0 = time.time()
+    devs = jax.devices()
+    _progress(f"backend up in {time.time() - t0:.1f}s: "
+              f"{len(devs)}x {devs[0].platform}")
+    if devs[0].platform == "tpu":
+        # Persistent cache: a retry (or next round) skips recompiles.
+        # TPU-only — XLA:CPU AOT cache entries embed host CPU features
+        # and can SIGILL when loaded on a different machine.
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         os.path.join(REPO, ".jax_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return jax, devs
+
+
+def phase_train() -> dict:
+    jax, devs = _setup_jax_child()
     import jax.numpy as jnp
     import numpy as np
     from ray_tpu.models import GPT2, GPT2Config
     from ray_tpu.parallel import MeshSpec, build_mesh
     from ray_tpu.train import make_train_step, make_optimizer
 
-    platform = jax.devices()[0].platform
-    n_chips = len([d for d in jax.devices() if d.platform == platform])
+    platform = devs[0].platform
     cfg = GPT2Config.small()
     model = GPT2(cfg)
-    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    mesh = build_mesh(MeshSpec(), devices=devs[:1])
     tx = make_optimizer("adamw", learning_rate=3e-4)
     rng = np.random.RandomState(0)
     batch = {"tokens": jnp.asarray(
         rng.randint(0, cfg.vocab_size, (BATCH, SEQ + 1)), jnp.int32)}
 
+    _progress("compiling train step (gpt2-124m, seq 1024)")
     init_fn = make_train_step(model, tx, mesh)
     t0 = time.time()
     state, step = init_fn(jax.random.PRNGKey(0), batch)
     state, m = step(state, batch)
     jax.block_until_ready(m["loss"])
     compile_s = time.time() - t0
+    _progress(f"compiled in {compile_s:.1f}s; warming up")
 
     for _ in range(WARMUP_STEPS):
         state, m = step(state, batch)
     jax.block_until_ready(m["loss"])
 
+    _progress(f"measuring {MEASURE_STEPS} steps")
     t0 = time.time()
     for _ in range(MEASURE_STEPS):
         state, m = step(state, batch)
     jax.block_until_ready(m["loss"])
     dt = time.time() - t0
 
-    tokens_per_step = BATCH * SEQ
-    tps = tokens_per_step * MEASURE_STEPS / dt
+    tps = BATCH * SEQ * MEASURE_STEPS / dt
     # MFU: 6 * N * tokens/s over peak (v5e ~197e12 bf16 FLOP/s)
     n_params = 124e6
     peak = 197e12 if platform == "tpu" else 1e12
     mfu = 6 * n_params * tps / peak
+    _progress(f"train: {tps:.0f} tok/s, {dt / MEASURE_STEPS * 1000:.1f} "
+              f"ms/step, mfu={mfu:.3f}")
     return {"tokens_per_s": tps, "compile_s": compile_s,
             "step_ms": dt / MEASURE_STEPS * 1000,
             "platform": platform, "mfu": mfu,
             "final_loss": float(m["loss"])}
+
+
+def phase_serve() -> dict:
+    """Serve req/s + p50 TTFT (BASELINE metric) on the continuous-batching
+    LLM engine with a llama-family model."""
+    jax, devs = _setup_jax_child()
+    import numpy as np
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+
+    cfg = LlamaConfig(vocab_size=32000, d_model=512, n_layers=8,
+                      n_heads=8, n_kv_heads=4, d_ff=1408, max_seq_len=512)
+    model = Llama(cfg)
+    _progress("initializing serve model params")
+    params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=8)
+    ecfg = LLMEngineConfig(max_slots=8, max_seq_len=512,
+                           prefill_buckets=(64, 128, 256),
+                           max_new_tokens_default=32)
+    engine = LLMEngine(model, params, ecfg)
+    rng = np.random.RandomState(0)
+
+    def run_load(n_requests: int, prompt_len: int = 48,
+                 new_tokens: int = 32):
+        import threading
+        ttfts, done = [], []
+        lock = threading.Lock()
+
+        def one(i):
+            prompt = rng.randint(0, cfg.vocab_size, (prompt_len,))
+            t0 = time.time()
+            rid = engine.submit(prompt, max_new_tokens=new_tokens)
+            first = True
+            for _tok in engine.stream(rid):
+                if first:
+                    with lock:
+                        ttfts.append(time.time() - t0)
+                    first = False
+            with lock:
+                done.append(i)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.time() - t0, ttfts
+
+    _progress("serve warmup (compiles prefill buckets + decode step)")
+    run_load(4)
+    _progress("serve measuring")
+    tokens_before = engine.stats["tokens_generated"]
+    n_req = 32
+    wall, ttfts = run_load(n_req)
+    tokens_measured = engine.stats["tokens_generated"] - tokens_before
+    engine.shutdown()
+    p50 = float(np.percentile(ttfts, 50) * 1000)
+    p95 = float(np.percentile(ttfts, 95) * 1000)
+    req_s = n_req / wall
+    _progress(f"serve: {req_s:.1f} req/s, ttft p50={p50:.0f}ms")
+    return {"serve_req_s": req_s, "serve_ttft_p50_ms": p50,
+            "serve_ttft_p95_ms": p95,
+            "serve_tokens_s": tokens_measured / wall,
+            "platform": devs[0].platform}
 
 
 def measure_torch_baseline() -> float:
@@ -137,40 +243,93 @@ def measure_torch_baseline() -> float:
     return b * s * n / dt
 
 
+# ---- parent orchestration --------------------------------------------------
+
+def _run_phase(phase: str, timeout_s: float) -> "tuple[dict | None, str]":
+    """Run `bench.py --phase X` in a child under a hard timeout. Returns
+    (result dict or None, error string)."""
+    err = ""
+    for attempt in range(1, ATTEMPTS + 1):
+        if attempt > 1:
+            time.sleep(10)  # TPU tunnel is single-holder; let it settle
+        _progress(f"phase {phase}: attempt {attempt}/{ATTEMPTS} "
+                  f"(timeout {timeout_s:.0f}s)")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--phase", phase],
+                stdout=subprocess.PIPE, stderr=None,  # stderr streams through
+                timeout=timeout_s, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            err = f"{phase} attempt {attempt} timed out after {timeout_s}s"
+            _progress(err)
+            continue
+        out = proc.stdout.decode(errors="replace").strip()
+        if proc.returncode == 0 and out:
+            try:
+                return json.loads(out.splitlines()[-1]), ""
+            except json.JSONDecodeError:
+                err = f"{phase} attempt {attempt}: unparseable output"
+                _progress(err + f": {out[-200:]}")
+                continue
+        err = (f"{phase} attempt {attempt}: rc={proc.returncode} "
+               f"out={out[-200:]!r}")
+        _progress(err)
+    return None, err
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--measure-torch-baseline", action="store_true")
+    ap.add_argument("--phase", choices=["train", "serve"])
+    ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
 
     if args.measure_torch_baseline:
-        tps = measure_torch_baseline()
-        print(json.dumps({"torch_cpu_tokens_per_s": tps}))
+        print(json.dumps(
+            {"torch_cpu_tokens_per_s": measure_torch_baseline()}))
+        return
+    if args.phase:  # child mode: emit phase JSON on the last stdout line
+        try:
+            r = phase_train() if args.phase == "train" else phase_serve()
+        except BaseException as e:  # noqa: BLE001
+            _progress(f"phase {args.phase} failed: {e!r}")
+            raise SystemExit(3)
+        print(json.dumps(r), flush=True)
         return
 
-    last_err = None
-    for attempt in range(3):
-        try:
-            r = measure_ray_tpu()
-            break
-        except RuntimeError as e:
-            # TPU tunnel is single-holder; retry if another process has it.
-            last_err = e
-            time.sleep(20)
+    t_start = time.time()
+    train, train_err = _run_phase("train", TRAIN_TIMEOUT_S)
+    serve, serve_err = (None, "skipped") if args.skip_serve else \
+        _run_phase("serve", SERVE_TIMEOUT_S)
+
+    extra = {"elapsed_s": round(time.time() - t_start, 1),
+             "baseline": "torch-cpu gpt2-124m train step on this host"}
+    if train:
+        extra.update(step_ms=round(train["step_ms"], 2),
+                     compile_s=round(train["compile_s"], 1),
+                     mfu=round(train["mfu"], 4),
+                     platform=train["platform"],
+                     final_loss=round(train["final_loss"], 3))
     else:
-        raise SystemExit(f"bench failed after retries: {last_err}")
+        extra["train_error"] = train_err
+    if serve:
+        extra.update(
+            serve_req_s=round(serve["serve_req_s"], 1),
+            serve_ttft_p50_ms=round(serve["serve_ttft_p50_ms"], 1),
+            serve_ttft_p95_ms=round(serve["serve_ttft_p95_ms"], 1),
+            serve_tokens_s=round(serve["serve_tokens_s"], 1))
+    else:
+        extra["serve_error"] = serve_err
 
     out = {
         "metric": "gpt2-124m train tokens/sec/chip (seq 1024, adamw, bf16)",
-        "value": round(r["tokens_per_s"], 1),
+        "value": round(train["tokens_per_s"], 1) if train else None,
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(
-            r["tokens_per_s"] / TORCH_CPU_BASELINE_TOKENS_PER_S, 2),
-        "extra": {"step_ms": round(r["step_ms"], 2),
-                  "compile_s": round(r["compile_s"], 1),
-                  "mfu": round(r["mfu"], 3),
-                  "platform": r["platform"],
-                  "baseline": "torch-cpu gpt2-124m train step on this host",
-                  "final_loss": round(r["final_loss"], 3)},
+        "vs_baseline": (round(train["tokens_per_s"]
+                              / TORCH_CPU_BASELINE_TOKENS_PER_S, 2)
+                        if train else None),
+        "extra": extra,
     }
     print(json.dumps(out))
 

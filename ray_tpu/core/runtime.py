@@ -477,6 +477,11 @@ class DriverRuntime:
 
         # 2. normal tasks
         still = collections.deque()
+        # CPU tasks may fall back onto idle TPU workers only when no TPU
+        # task is waiting — otherwise a CPU backlog ahead of a TPU task
+        # would repeatedly steal the one worker that can run it.
+        tpu_demand = any(s.resources.get("TPU", 0) > 0
+                         for s in self.pending_tasks)
         while self.pending_tasks:
             spec = self.pending_tasks.popleft()
             te = self.gcs.tasks[spec.task_id]
@@ -498,7 +503,9 @@ class DriverRuntime:
                 still.append(spec)
                 continue
             task_needs_tpu = spec.resources.get("TPU", 0) > 0
-            w = self._find_idle_worker(needs_tpu=task_needs_tpu)
+            w = self._find_idle_worker(
+                needs_tpu=task_needs_tpu,
+                allow_tpu_fallback=not tpu_demand)
             if w is None:
                 if self._can_spawn(needs_tpu=task_needs_tpu):
                     self._spawn_worker(purpose=None,
@@ -564,30 +571,46 @@ class DriverRuntime:
                                                          w.worker_id,
                                                          time.time())
 
-    def _find_idle_worker(self, needs_tpu: bool = False) -> Optional[WorkerState]:
+    def _find_idle_worker(self, needs_tpu: bool = False,
+                          allow_tpu_fallback: bool = True
+                          ) -> Optional[WorkerState]:
+        # Prefer an exact capability match; a CPU task may fall back to an
+        # idle TPU-capable worker (running plain Python there is harmless)
+        # so capacity is never stranded — unless the caller knows TPU
+        # demand is queued. A TPU task never runs on a worker without the
+        # device.
+        fallback = None
         for w in self.workers.values():
-            if (w.state == "idle" and w.conn is not None
-                    and w.tpu_capable == needs_tpu):
+            if w.state != "idle" or w.conn is None:
+                continue
+            if w.tpu_capable == needs_tpu:
                 return w
-        return None
+            if not needs_tpu and w.tpu_capable and allow_tpu_fallback:
+                fallback = w
+        return fallback
 
     def _can_spawn(self, needs_tpu: bool = False) -> bool:
-        # A worker can only serve tasks of its own capability kind
-        # (_find_idle_worker matches tpu_capable exactly), so an idle
-        # worker of the WRONG kind must not satisfy demand for the other.
+        # max_workers (bounded by CPU capacity for general workers) is a
+        # hard ceiling — it applies even when no starting/idle worker of
+        # the needed kind exists, otherwise sustained load with all
+        # workers busy would spawn one more worker per scheduling pass.
+        general_alive = len([w for w in self.workers.values()
+                             if w.state != "dead" and w.purpose is None])
+        cpu_cap = int(self.total_resources.get("CPU", 1)) or 1
+        under_cap = general_alive < min(self.max_workers, cpu_cap)
         ready = sum(1 for w in self.workers.values()
                     if w.state in ("starting", "idle")
                     and w.tpu_capable == needs_tpu)
         if ready == 0:
-            return True
-        # Don't spawn more general workers than could ever run at once:
-        # CPU capacity bounds useful parallelism (reference: worker_pool
-        # caps at num_cpus); max_workers is the hard ceiling. Dedicated
-        # actor workers hold their own resources and don't count.
-        general_alive = len([w for w in self.workers.values()
-                             if w.state != "dead" and w.purpose is None])
-        cpu_cap = int(self.total_resources.get("CPU", 1)) or 1
-        return general_alive < min(self.max_workers, cpu_cap)
+            # Demand with no ready worker of this kind: spawn if under the
+            # cap, or if the cap is consumed entirely by the other
+            # capability kind and none of this kind is alive (a TPU task
+            # must always be able to get at least one TPU worker).
+            alive_kind = sum(1 for w in self.workers.values()
+                             if w.state != "dead" and w.purpose is None
+                             and w.tpu_capable == needs_tpu)
+            return under_cap or alive_kind == 0
+        return under_cap
 
     def _spawn_worker(self, purpose, tpu_capable: bool = False) -> str:
         self._wid_counter += 1

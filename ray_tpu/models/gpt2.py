@@ -26,7 +26,7 @@ class GPT2Config:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
-    attn_impl: str = "xla"          # "xla" | "pallas"
+    attn_impl: str = "auto"         # "auto" | "xla" | "pallas"
 
     @property
     def d_ff(self) -> int:

@@ -38,7 +38,7 @@ class LlamaConfig:
     tie_embeddings: bool = False
     remat: bool = False
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"          # "xla" | "pallas"
+    attn_impl: str = "auto"         # "auto" | "xla" | "pallas"
 
     def __post_init__(self):
         if self.d_model % self.n_heads:

@@ -42,7 +42,7 @@ class MixtralConfig:
     router_z_coef: float = 1e-3
     remat: bool = False
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:

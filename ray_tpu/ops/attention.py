@@ -20,15 +20,35 @@ def causal_attention_mask(seq_len: int, dtype=jnp.bool_) -> jax.Array:
     return jnp.tril(jnp.ones((seq_len, seq_len), dtype=dtype))
 
 
+def _resolve_impl(impl: str, q: jax.Array, k: jax.Array, causal: bool,
+                  segment_ids) -> str:
+    """"auto" = the Pallas flash kernel on TPU whenever the shape suits it
+    (self-attention, long enough to tile); XLA otherwise — notably cached
+    decode (Sq != Sk under causal), segment masking, and CPU, where
+    interpret-mode Pallas would crawl."""
+    if impl != "auto":
+        return impl
+    if jax.default_backend() != "tpu":
+        return "xla"
+    if segment_ids is not None:
+        return "xla"
+    if causal and q.shape[1] != k.shape[1]:
+        return "xla"
+    if q.shape[1] < 128:
+        return "xla"
+    return "pallas"
+
+
 def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          *, causal: bool = True,
                          segment_ids: Optional[jax.Array] = None,
-                         impl: str = "xla",
+                         impl: str = "auto",
                          scale: Optional[float] = None) -> jax.Array:
     """q: (B, Sq, Hq, D); k/v: (B, Sk, Hkv, D) with Hq % Hkv == 0 (GQA).
 
     Returns (B, Sq, Hq, D).
     """
+    impl = _resolve_impl(impl, q, k, causal, segment_ids)
     if impl == "pallas":
         from .pallas.flash_attention import flash_attention  # noqa: PLC0415
         return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -1,16 +1,20 @@
-"""Flash attention for TPU in Pallas.
+"""Flash attention for TPU in Pallas — forward AND backward kernels.
 
 Online-softmax tiled attention: Q/K/V blocks stream HBM -> VMEM, logits
 never materialize in HBM, accumulators live in VMEM scratch across the
-innermost (k-block) grid dimension — the standard TPU flash schedule.
+innermost grid dimension — the standard TPU flash schedule.
 
-Forward is the Pallas kernel; backward currently recomputes through the
-XLA attention path via jax.custom_vjp (correct gradients, HBM-heavier —
-a Pallas backward is a later optimization). The kernel auto-runs in
-interpret mode on CPU so tests exercise the same code path.
+Forward emits the per-row logsumexp; backward is two Pallas kernels
+(FlashAttention-2 style): a dQ kernel accumulating over key blocks and a
+dK/dV kernel accumulating over query blocks, with
+delta = rowsum(dO * O) precomputed in XLA. Logits are rebuilt in VMEM
+from the saved logsumexp, so the backward is O(S) HBM like the forward.
+The kernels auto-run in interpret mode on CPU so tests exercise the same
+code path.
 
 Replaces the reference's flash-attn/CUDA dependency (torch
-scaled_dot_product_attention in its model stacks).
+scaled_dot_product_attention in its model stacks, e.g.
+python/ray/train/torch/train_loop_utils.py models).
 """
 from __future__ import annotations
 
@@ -25,7 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
                 seq_len: int):
     iq = pl.program_id(1)
@@ -81,11 +85,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool,
                block_q: int, block_k: int, interpret: bool):
-    """q,k,v: (BH, S, D) with identical head counts (GQA pre-expanded)."""
+    """q,k,v: (BH, S, D) with identical head counts (GQA pre-expanded).
+    Returns (out (BH, S, D), lse (BH, S) fp32)."""
     bh, s, d = q.shape
     sk = k.shape[1]
     bq = min(block_q, s)
@@ -103,7 +109,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         seq_len=sk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -111,8 +117,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -122,36 +134,186 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :s, :]
+    return out[:, :s, :], lse[:, :s]
 
 
-def _xla_reference(q, k, v, scale, causal):
-    s = jnp.einsum("bqd,bkd->bqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+def _bwd_p_ds(q, k, v, do, lse, delta, q_start, k_start, *, scale,
+              causal, sq, sk, block_q, block_k):
+    """Shared VMEM math for both backward kernels: rebuild the normalized
+    probabilities p from the saved logsumexp and form
+    ds = p * (dO V^T - delta) * scale. Returns (p, ds) in fp32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # (bq, bk)
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    mask = jnp.logical_and(k_pos < sk, q_pos < sq)
     if causal:
-        sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=jnp.bool_), k=sk - sq)
-        s = jnp.where(mask[None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bqk,bkd->bqd", p, v)
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    # p = exp(s - lse): already normalized. Padded/fully-masked rows have
+    # lse == 0 from re-padding; their dO rows are 0 so contributions die,
+    # but mask them anyway so no inf/nan can form.
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)      # (bq, bk)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # (bq, bk)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   sq, sk):
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * block_q
+    k_start = jk * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0],
+                          q_start, k_start, scale=scale, causal=causal,
+                          sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, sq, sk):
+    ik = pl.program_id(1)
+    jq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k_start = ik * block_k
+    q_start = jq * block_q
+    run = True
+    if causal:
+        # Skip q blocks entirely before this k block (they can't see it).
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0],
+                          q_start, k_start, scale=scale, causal=causal,
+                          sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+
+    @pl.when(jq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal,
+               block_q, block_k, interpret):
+    """Pallas backward. q/out/do: (BH, S, D); k/v: (BH, Sk, D)."""
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, s)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(s, bq)
+    nk = pl.cdiv(sk, bk)
+    s_pad, sk_pad = nq * bq, nk * bk
+
+    # delta_i = sum_j dO_ij * O_ij  (fp32, one cheap XLA pass)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                  # (BH, S)
+    if s_pad != s:
+        pad = ((0, 0), (0, s_pad - s), (0, 0))
+        q, do = jnp.pad(q, pad), jnp.pad(do, pad)
+        lse = jnp.pad(lse, ((0, 0), (0, s_pad - s)))
+        delta = jnp.pad(delta, ((0, 0), (0, s_pad - s)))
+    if sk_pad != sk:
+        pad = ((0, 0), (0, sk_pad - sk), (0, 0))
+        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  sq=s, sk=sk)
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: swap loop order — k blocks in the grid, q blocks innermost.
+    qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
+    rowspec2 = pl.BlockSpec((1, bq), lambda b, i, j: (b, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq[:, :s, :], dk[:, :sk, :], dv[:, :sk, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # Correct-by-construction backward via the XLA path (recompute).
-    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, scale, causal),
-                     q, k, v)
-    return vjp(g.astype(jnp.float32))
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g.astype(q.dtype), scale, causal,
+                      block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -39,12 +39,14 @@ def _fwd(x2d, weight, eps: float, block_rows: int, interpret: bool):
         grid=(padded // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            # rank-2 weight tile: Mosaic wants (sublane, lane)-tileable
+            # operands; a rank-1 ref lowers poorly on real TPU
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded, d), x2d.dtype),
         interpret=interpret,
-    )(x2d, weight)
+    )(x2d, weight.reshape(1, d))
     return out[:rows]
 
 
@@ -84,5 +86,9 @@ def fused_rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6,
         # never pad a small input up to a much bigger tile
         block_rows = max(8, min(256, (2 << 20) // max(d * 4, 1),
                                 x2d.shape[0]))
+    # Mosaic fp32 tiles are (8, 128): a block_rows that isn't a multiple
+    # of 8 fails to lower on real TPU (grid already pads rows, so
+    # rounding up is free).
+    block_rows = -(-int(block_rows) // 8) * 8
     out = _rmsnorm(x2d, weight, eps, int(block_rows), bool(interpret))
     return out.reshape(*lead, d)

@@ -30,6 +30,7 @@ class _CollectiveActor:
         self.world = world_size
         self._rounds: Dict[tuple, Dict[int, Any]] = {}
         self._results: Dict[tuple, Any] = {}
+        self._fetched: Dict[tuple, set] = {}
         self._epochs: Dict[int, int] = {}
 
     def join(self, rank: int, world_size: int) -> int:
@@ -48,10 +49,14 @@ class _CollectiveActor:
     def contribute(self, key: tuple, rank: int, payload) -> None:
         self._rounds.setdefault(key, {})[rank] = payload
 
-    def poll(self, key: tuple, op: Optional[str]):
-        """Returns (ready, result). Result computed once per round."""
+    def poll(self, key: tuple, op: Optional[str], rank: int = -1):
+        """Returns (ready, result). Result computed once per round, then
+        retained until every rank has fetched it (a result evicted before
+        a slow rank polls would strand that rank in a timeout spin)."""
         if key in self._results:
-            return True, self._results[key]
+            result = self._results[key]
+            self._mark_fetched(key, rank)
+            return True, result
         room = self._rounds.get(key, {})
         if len(room) < self.world:
             return False, None
@@ -67,12 +72,25 @@ class _CollectiveActor:
         else:
             result = _OPS[op]([np.asarray(v) for v in ordered])
         self._results[key] = result
-        # GC old rounds of the same kind to bound memory
         self._rounds.pop(key, None)
-        if len(self._results) > 64:
+        self._mark_fetched(key, rank)
+        return True, result
+
+    def _mark_fetched(self, key: tuple, rank: int) -> None:
+        fetched = self._fetched.setdefault(key, set())
+        fetched.add(rank)
+        if len(fetched - {-1}) >= self.world:
+            self._results.pop(key, None)
+            self._fetched.pop(key, None)
+            return
+        # Size cap only as a fallback for abandoned rounds (a rank died
+        # between contribute and poll): evict the oldest fully-computed
+        # result, preferring ones nobody is still waiting on is
+        # impossible to know, so cap generously.
+        if len(self._results) > 1024:
             oldest = next(iter(self._results))
             self._results.pop(oldest)
-        return True, result
+            self._fetched.pop(oldest, None)
 
 
 class CollectiveGroup:
@@ -107,7 +125,8 @@ class CollectiveGroup:
         deadline = time.monotonic() + timeout
         delay = 0.001
         while True:
-            ready, result = ray_tpu.get(self.actor.poll.remote(key, op))
+            ready, result = ray_tpu.get(
+                self.actor.poll.remote(key, op, self.rank))
             if ready:
                 return result
             if time.monotonic() >= deadline:

@@ -12,7 +12,14 @@ import os
 
 def force_cpu(n_virtual_devices: int | None = None) -> None:
     """Pin this process to the CPU backend, optionally with N virtual
-    devices (for testing multi-chip sharding without chips)."""
+    devices (for testing multi-chip sharding without chips).
+
+    Safe to call even after jax has been imported (or initialized on a
+    different platform): `jax_num_cpu_devices` takes effect at client
+    creation, so clearing already-created backends is sufficient — unlike
+    XLA_FLAGS, which absl parses only once per process (we still set it
+    for child processes that inherit the environment).
+    """
     if n_virtual_devices is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -21,11 +28,19 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
                         f"{n_virtual_devices}").strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
-    jax.config.update("jax_platforms", "cpu")
+    # Clear any live backends FIRST: jax refuses jax_num_cpu_devices
+    # updates while a client exists, and config changes only apply at the
+    # next client creation anyway.
     from jax._src import xla_bridge
     if xla_bridge.backends_are_initialized():
         from jax.extend.backend import clear_backends
         clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    if n_virtual_devices is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_virtual_devices)
+        except Exception:
+            pass  # older jax: XLA_FLAGS above covers it
 
 
 def subprocess_env_cpu(env: dict) -> dict:

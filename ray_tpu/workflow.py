@@ -35,9 +35,13 @@ def _wf_dir(workflow_id: str) -> str:
     return os.path.join(_storage, workflow_id)
 
 
-def _step_key(node: DAGNode, child_keys: List[str]) -> str:
+def _step_key(node: DAGNode, child_keys: List[str],
+              salt: str = "") -> str:
     """Deterministic key: node kind + callable name + child keys. Bound
-    positions (not live ids) so re-built DAGs of the same shape match."""
+    positions (not live ids) so re-built DAGs of the same shape match.
+    `salt` carries the run-input digest for nodes downstream of an
+    InputNode, so cached results computed from different execute()-time
+    inputs are never replayed."""
     if isinstance(node, FunctionNode):
         name = getattr(node._remote_fn, "__name__", "fn")
     elif isinstance(node, ClassMethodNode):
@@ -46,6 +50,7 @@ def _step_key(node: DAGNode, child_keys: List[str]) -> str:
         name = type(node).__name__
     h = hashlib.sha1()
     h.update(name.encode())
+    h.update(salt.encode())
     for ck in child_keys:
         h.update(ck.encode())
     # literal (non-node) args participate so different bindings differ
@@ -66,8 +71,16 @@ class _DurableExec:
         os.makedirs(self.steps_dir, exist_ok=True)
         self.input_args = input_args
         self.input_kwargs = input_kwargs
+        # pickle, not repr: repr elides large numpy arrays ('...') and
+        # embeds memory addresses for default-repr objects — both break
+        # the "same inputs <=> same salt" contract.
+        digest = hashlib.sha1(pickle.dumps(
+            (input_args, sorted((input_kwargs or {}).items()))
+        )).hexdigest()[:12]
+        self.input_salt = f"inputs:{digest}"
         self._memo: Dict[int, Any] = {}
         self._keys: Dict[int, str] = {}
+        self._uses_input_memo: Dict[int, bool] = {}
         self._base_counts: Dict[str, int] = {}
         self.steps_run = 0
         self.steps_skipped = 0
@@ -121,9 +134,19 @@ class _DurableExec:
         self.steps_run += 1
         return value
 
+    def _uses_input(self, node: DAGNode) -> bool:
+        nid = node._node_id
+        if nid not in self._uses_input_memo:
+            self._uses_input_memo[nid] = (
+                isinstance(node, (InputNode, InputAttributeNode))
+                or any(self._uses_input(c) for c in node._children()))
+        return self._uses_input_memo[nid]
+
     def _key_of(self, node: DAGNode) -> str:
         if node._node_id not in self._keys:
-            base = _step_key(node, [self._key_of(c) for c in node._children()])
+            salt = self.input_salt if self._uses_input(node) else ""
+            base = _step_key(node, [self._key_of(c) for c in node._children()],
+                             salt)
             # identical sibling subtrees (e.g. two sample.bind(cfg) calls)
             # must be distinct steps: suffix by occurrence. DFS resolution
             # order is deterministic for a given DAG shape, so a rebuilt

@@ -207,6 +207,24 @@ def test_workflow_failure_then_resume(rt, tmp_path):
     assert workflow.get_status("wf2") == "SUCCEEDED"
 
 
+def test_workflow_different_inputs_not_replayed(rt, tmp_path):
+    """Same workflow_id + different args must re-execute input-dependent
+    steps, not replay cached results computed from the old inputs."""
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    with InputNode() as inp:
+        dag = double.bind(inp)
+
+    assert workflow.run(dag, workflow_id="wf3", args=(5,)) == 10
+    assert workflow.run(dag, workflow_id="wf3", args=(7,)) == 14
+
+
 # ---------- jobs ----------
 
 def test_job_submission_lifecycle(tmp_path):
