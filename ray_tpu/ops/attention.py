@@ -10,14 +10,64 @@ dependency in its model code (e.g. rllib models and train examples).
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger("ray_tpu.ops.attention")
+
 
 def causal_attention_mask(seq_len: int, dtype=jnp.bool_) -> jax.Array:
     return jnp.tril(jnp.ones((seq_len, seq_len), dtype=dtype))
+
+
+# signature -> bool: does the Pallas flash kernel lower on this backend?
+_PALLAS_LOWER_CACHE: dict = {}
+
+
+def pallas_flash_lowers(q, k, v, causal: bool,
+                        scale: Optional[float]) -> bool:
+    """Compile-check the Pallas flash kernel (forward AND backward) for
+    this shape signature, once, off to the side of any surrounding trace.
+
+    A Mosaic lowering failure must degrade to the XLA path with a warning
+    — never kill the surrounding train/serve step (a single kernel bug
+    zeroed the round-2 headline bench). Both directions are probed because
+    whether the caller will take grads is unknowable at trace time and a
+    fwd-ok/bwd-broken split would die mid-train; the extra compile is
+    once per shape signature.
+    """
+    key = (q.shape, k.shape, str(q.dtype), str(k.dtype), bool(causal))
+    hit = _PALLAS_LOWER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if jax.default_backend() != "tpu":
+        # interpret mode: no Mosaic lowering to fail
+        _PALLAS_LOWER_CACHE[key] = True
+        return True
+    from .pallas.flash_attention import flash_attention  # noqa: PLC0415
+
+    def probe(q, k, v):
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, scale=scale)
+            return out.astype(jnp.float32).sum()
+        return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    try:
+        abstract = [jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    for x in (q, k, v)]
+        jax.jit(probe).lower(*abstract).compile()
+        ok = True
+    except Exception as exc:  # Mosaic/XLA lowering errors are varied
+        logger.warning(
+            "Pallas flash attention failed to lower for q=%s k=%s "
+            "(%s: %s); falling back to the XLA path for this signature.",
+            q.shape, k.shape, type(exc).__name__, exc)
+        ok = False
+    _PALLAS_LOWER_CACHE[key] = ok
+    return ok
 
 
 def _resolve_impl(impl: str, q: jax.Array, k: jax.Array, causal: bool,
@@ -48,8 +98,13 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Returns (B, Sq, Hq, D).
     """
+    explicit_pallas = impl == "pallas"
     impl = _resolve_impl(impl, q, k, causal, segment_ids)
-    if impl == "pallas":
+    # Explicitly-requested pallas runs unconditionally (a lowering bug
+    # must surface to the caller, not hide behind a silent fallback);
+    # only the "auto" route degrades to XLA when the probe fails.
+    if impl == "pallas" and (explicit_pallas
+                             or pallas_flash_lowers(q, k, v, causal, scale)):
         from .pallas.flash_attention import flash_attention  # noqa: PLC0415
         return flash_attention(q, k, v, causal=causal, scale=scale)
 

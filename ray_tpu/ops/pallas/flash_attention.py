@@ -85,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool,
@@ -119,11 +119,16 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # lse rides in a (bh, 1, s_pad) layout: the block's second-minor
+            # dim (1) then equals the full array dim, which Mosaic's
+            # (8, 128) tiling rule permits — a 2-D (bh, s_pad) array with a
+            # (1, bq) block does NOT lower on real TPU (sublane dim 1 is
+            # neither a multiple of 8 nor the array dim).
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -134,7 +139,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :s, :], lse[:, :s]
+    return out[:, :s, :], lse[:, 0, :s]
 
 
 def _bwd_p_ds(q, k, v, do, lse, delta, q_start, k_start, *, scale,
@@ -183,7 +188,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0],
+        _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
                           q_start, k_start, scale=scale, causal=causal,
                           sq=sq, sk=sk, block_q=block_q, block_k=block_k)
         dq_acc[:] += jax.lax.dot_general(
@@ -217,7 +222,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0],
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
                           q_start, k_start, scale=scale, causal=causal,
                           sq=sq, sk=sk, block_q=block_q, block_k=block_k)
         dv_acc[:] += jax.lax.dot_general(
@@ -255,12 +260,16 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal,
     if sk_pad != sk:
         pad = ((0, 0), (0, sk_pad - sk), (0, 0))
         k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+    # Per-row tensors travel as (BH, 1, S): see the fwd lse out_spec for why
+    # a 2-D (BH, S) layout cannot tile on real TPU.
+    lse = lse.reshape(bh, 1, s_pad)
+    delta = delta.reshape(bh, 1, s_pad)
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   sq=s, sk=sk)
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    rowspec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    rowspec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(bh, nq, nk),
@@ -276,7 +285,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal,
     # dk/dv: swap loop order — k blocks in the grid, q blocks innermost.
     qspec2 = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))
-    rowspec2 = pl.BlockSpec((1, bq), lambda b, i, j: (b, j))
+    rowspec2 = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(bh, nk, nq),

@@ -90,6 +90,33 @@ def test_flash_gradients_bf16_finite():
         assert np.isfinite(np.asarray(a, np.float32)).all()
 
 
+def test_pallas_lowering_failure_falls_back_to_xla(monkeypatch):
+    """A Mosaic lowering failure must degrade to the XLA path, never kill
+    the step (round-2 regression: one kernel bug zeroed the bench)."""
+    import ray_tpu.ops.attention as attn_mod
+
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(attn_mod, "_PALLAS_LOWER_CACHE", {})
+
+    import importlib
+    fa_mod = importlib.import_module("ray_tpu.ops.pallas.flash_attention")
+
+    def boom(*a, **kw):
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setattr(fa_mod, "flash_attention", boom)
+
+    rng = np.random.RandomState(5)
+    q, k, v = _rand_qkv(rng, 1, 128, 128, 2, 2, 32)
+    out = attn_mod.multi_head_attention(q, k, v, causal=True, impl="auto")
+    ref = attn_mod.multi_head_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # and the verdict is cached as "broken" for this signature
+    key = next(iter(attn_mod._PALLAS_LOWER_CACHE))
+    assert attn_mod._PALLAS_LOWER_CACHE[key] is False
+
+
 def test_llama_pallas_impl_runs():
     from ray_tpu.models import Llama, LlamaConfig
     cfg = LlamaConfig.debug(attn_impl="pallas", dtype=jnp.float32)

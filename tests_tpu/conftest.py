@@ -1,0 +1,14 @@
+"""On-hardware kernel tests: run on the REAL TPU backend, interpret=False.
+
+Unlike tests/, this suite does NOT force CPU — it exists precisely to
+exercise Mosaic lowering, the blind spot that let the round-2 flash
+kernel ship with a tiling bug no interpret-mode test could catch.
+Everything here skips unless jax.default_backend() == "tpu".
+
+Run: python -m pytest tests_tpu/ -x -q   (on a TPU host)
+bench.py also runs the same checks as its kernel-smoke phase.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
