@@ -96,6 +96,36 @@ class _Pin:
             pass  # interpreter teardown
 
 
+def _sweep_stale_arenas() -> None:
+    """Unlink arenas whose owner pid is dead (a SIGKILLed/SIGTERMed
+    driver never runs its atexit unlink, and a multi-GB /dev/shm segment
+    would otherwise leak until reboot). Arena names embed the creator's
+    pid: /rtpu_arena_<pid>_<hex>."""
+    try:
+        entries = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for fname in entries:
+        if not fname.startswith("rtpu_arena_"):
+            continue
+        parts = fname.split("_")
+        try:
+            pid = int(parts[2])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive: not ours to touch
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue
+        try:
+            os.unlink(os.path.join("/dev/shm", fname))
+        except OSError:
+            pass
+
+
 class NativeStore:
     """Per-process view of the node's C++ shared-memory arena."""
 
@@ -105,6 +135,7 @@ class NativeStore:
         self.capacity = capacity_bytes
         self.is_owner = is_owner
         if is_owner:
+            _sweep_stale_arenas()
             name = f"/rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
             os.environ[_ENV_NAME] = name
         else:
@@ -149,17 +180,26 @@ class NativeStore:
             self._lib.rtpu_arena_delete(self._handle, oid.encode())
             raise
         self._lib.rtpu_arena_seal(self._handle, oid.encode())
-        return ObjectLocation(kind="native", size=size, name=oid)
+        from ..core.object_store import current_node_id  # noqa: PLC0415
+        return ObjectLocation(kind="native", size=size, name=oid,
+                              node_id=current_node_id())
 
     # -- read path ----------------------------------------------------------
     def get_value(self, loc: ObjectLocation) -> Any:
         if loc.kind == "inline":
             return serialization.unpack(loc.data)
+        if loc.kind == "spill":
+            from ..core.object_store import _read_spill_loc  # noqa: PLC0415
+            return serialization.unpack(_read_spill_loc(loc))
         if loc.kind == "native":
             size = ctypes.c_uint64()
             off = self._lib.rtpu_arena_get(
                 self._handle, loc.name.encode(), ctypes.byref(size))
             if off < 0:
+                if loc.spill_path:
+                    from ..core.object_store import \
+                        _read_spill_loc  # noqa: PLC0415
+                    return serialization.unpack(_read_spill_loc(loc))
                 raise ObjectLostError(
                     f"object {loc.name} is gone from the arena (evicted?)")
             # The pin (refcount) lives exactly as long as the deserialized
@@ -173,6 +213,55 @@ class NativeStore:
             # A peer fell back to the pure-Python store; read its segment.
             return self._shm_fallback().get_value(loc)
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def get_bytes(self, loc: ObjectLocation) -> bytes:
+        """Raw packed payload for cross-node transfer (copies out of the
+        arena; the pin lives only for the copy)."""
+        if loc.kind == "inline":
+            return loc.data
+        if loc.kind == "spill":
+            from ..core.object_store import _read_spill_loc  # noqa: PLC0415
+            return _read_spill_loc(loc)
+        if loc.kind == "native":
+            size = ctypes.c_uint64()
+            off = self._lib.rtpu_arena_get(
+                self._handle, loc.name.encode(), ctypes.byref(size))
+            if off < 0:
+                if loc.spill_path:
+                    from ..core.object_store import \
+                        _read_spill_loc  # noqa: PLC0415
+                    return _read_spill_loc(loc)
+                raise ObjectLostError(
+                    f"object {loc.name} is gone from the arena (evicted?)")
+            try:
+                return bytes(self._data[off:off + size.value])
+            finally:
+                self._release_one(loc.name)
+        if loc.kind == "shm":
+            return self._shm_fallback().get_bytes(loc)
+        raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def put_packed(self, oid: str, data: bytes) -> ObjectLocation:
+        """Seal an already-packed payload (cross-node fetch re-hosting)."""
+        size = len(data)
+        if size <= INLINE_MAX:
+            return ObjectLocation(kind="inline", size=size, data=data)
+        key = oid + "c"   # distinct from any locally-created oid entry
+        off = self._lib.rtpu_arena_create_object(
+            self._handle, key.encode(), size)
+        if off == -2:
+            from ..core.object_store import current_node_id  # noqa: PLC0415
+            return ObjectLocation(kind="native", size=size, name=key,
+                                  node_id=current_node_id())
+        if off < 0:
+            raise ObjectStoreFullError(
+                f"re-hosted object {oid} ({size} B) does not fit in the "
+                f"arena")
+        self._data[off:off + size] = data
+        self._lib.rtpu_arena_seal(self._handle, key.encode())
+        from ..core.object_store import current_node_id  # noqa: PLC0415
+        return ObjectLocation(kind="native", size=size, name=key,
+                              node_id=current_node_id())
 
     def _shm_fallback(self):
         if not hasattr(self, "_fallback"):

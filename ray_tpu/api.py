@@ -29,8 +29,14 @@ AUTO_PUT_THRESHOLD = 256 * 1024  # large ndarray args go through the store
 def init(*, num_cpus=None, num_tpus=None, resources=None,
          object_store_memory=None, namespace="default",
          max_workers=None, ignore_reinit_error=True, log_to_driver=True,
-         **_ignored):
-    """Start the ray_tpu runtime in this (driver) process."""
+         listen=None, **_ignored):
+    """Start the ray_tpu runtime in this (driver) process.
+
+    listen="host:port" (port 0 = ephemeral) additionally opens a TCP
+    listener so remote hosts can join with
+    `python -m ray_tpu.core.node tcp://host:port`; the bound address is
+    `init(...).tcp_address`.
+    """
     with _init_lock:
         if runtime_mod.runtime_initialized():
             if ignore_reinit_error:
@@ -40,7 +46,7 @@ def init(*, num_cpus=None, num_tpus=None, resources=None,
                            resources=resources,
                            object_store_memory=object_store_memory,
                            namespace=namespace, max_workers=max_workers,
-                           log_to_driver=log_to_driver)
+                           log_to_driver=log_to_driver, listen=listen)
         runtime_mod.set_runtime(rt)
         return rt
 

@@ -22,6 +22,9 @@ class ObjectEntry:
     owner_task: str = ""
     created_at: float = 0.0
     pinned: bool = True
+    # Additional locations (e.g. the original remote copy after a fetch
+    # re-hosted the payload locally); all are freed together.
+    copies: List[Any] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,220 @@
+"""Node agent: joins a host to a running driver over TCP.
+
+Reference parity: src/ray/raylet/node_manager.cc (node registration,
+worker leasing) + src/ray/gcs/gcs_server/gcs_node_manager.cc (node table)
+— collapsed to the single-controller model: the agent owns this host's
+shared-memory object store and spawns workers on the driver's request;
+the workers connect straight back to the driver's TCP listener, so the
+driver keeps one scheduler for the whole cluster ("multi-host pods are a
+transport, not a rewrite").
+
+Run on each additional host:
+    python -m ray_tpu.core.node tcp://<driver-host>:<port> \
+        [--num-cpus N] [--num-tpus N] [--store-bytes B]
+
+The driver side opens the TCP listener via
+`ray_tpu.init(listen="0.0.0.0:6380")` (or RAY_TPU_LISTEN) and exposes the
+bound address as `runtime.tcp_address`.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from . import resources as res_mod
+from .ids import new_node_id
+from .object_store import make_store
+from .protocol import ConnectionClosed, connect_address
+
+# Cross-node payloads stream in frames well under protocol.MAX_MSG so one
+# huge object can never poison the connection with an oversized frame.
+FETCH_CHUNK = int(os.environ.get("RAY_TPU_FETCH_CHUNK", str(64 << 20)))
+
+
+class NodeAgent:
+    def __init__(self, driver_address: str, *, num_cpus=None, num_tpus=None,
+                 resources=None, store_bytes: Optional[int] = None):
+        self.driver_address = driver_address
+        self.node_id = new_node_id()
+        # This host's store is its own arena: drop any inherited owner env
+        # (tests run agents on the driver's host) and stamp our node id so
+        # every ObjectLocation written here names this node.
+        os.environ.pop("RAY_TPU_ARENA_NAME", None)
+        os.environ["RAY_TPU_NODE_ID"] = self.node_id
+        cap = store_bytes or int(
+            os.environ.get("RAY_TPU_STORE_BYTES", str(2 << 30)))
+        self.store = make_store(capacity_bytes=cap, is_owner=True)
+
+        node_res = res_mod.detect_node_resources(num_cpus, num_tpus)
+        if resources:
+            node_res.update(resources)
+        self.resources = node_res
+        self.labels = res_mod.detect_tpu_topology(
+            int(node_res.get("TPU", 0)))
+
+        self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_node_")
+        self.log_dir = os.path.join(self._tmpdir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        # This node's workers spill put-overflow here (core/spilling.py;
+        # the driver-side watermark spiller only covers the driver node).
+        # Overrides any env inherited from a same-host driver in tests.
+        os.environ["RAY_TPU_SPILL_DIR"] = os.path.join(self._tmpdir,
+                                                       "spill")
+        self.workers: Dict[str, subprocess.Popen] = {}
+        self.job_id = "job-default"
+        # Fetches run on threads (a multi-GB read must not head-of-line
+        # block spawns/frees), bounded so they can't starve the loop.
+        self._fetch_sem = threading.Semaphore(4)
+
+        self.conn = connect_address(driver_address)
+        self.conn.send(("register_node", {
+            "node_id": self.node_id,
+            "hostname": os.uname().nodename,
+            "resources": dict(node_res),
+            "labels": dict(self.labels),
+            "pid": os.getpid(),
+        }))
+
+    # ---- command loop -----------------------------------------------------
+    def run(self) -> None:
+        try:
+            while True:
+                m = self.conn.recv()
+                self._handle(m)
+                if m[0] == "shutdown":
+                    break
+        except ConnectionClosed:
+            pass  # driver gone: fall through to cleanup
+        finally:
+            self._cleanup()
+
+    def _handle(self, m) -> None:
+        mtype = m[0]
+        if mtype == "node_registered":
+            _, _driver_node, job_id = m
+            self.job_id = job_id
+        elif mtype == "spawn_worker":
+            _, wid, tpu_capable, job_id = m
+            self.job_id = job_id
+            try:
+                self._spawn(wid, tpu_capable)
+            except BaseException as e:  # noqa: BLE001
+                self.conn.send(("worker_spawn_failed", wid, repr(e)))
+        elif mtype == "fetch_object":
+            _, rid, loc = m
+            threading.Thread(target=self._serve_fetch, args=(rid, loc),
+                             daemon=True).start()
+        elif mtype == "free_object":
+            _, loc = m
+            try:
+                if loc.kind in ("shm", "native"):
+                    self.store.delete_segment(loc.name, loc.size)
+                if loc.spill_path and os.path.exists(loc.spill_path):
+                    os.remove(loc.spill_path)
+                elif loc.kind == "spill" and os.path.exists(loc.name):
+                    os.remove(loc.name)
+            except Exception:
+                traceback.print_exc()
+        elif mtype == "shutdown":
+            pass  # run() breaks and cleans up
+
+    def _serve_fetch(self, rid, loc) -> None:
+        """Read from the local store (arena or spill file) and stream the
+        payload back in chunks. Connection.send is thread-safe, so
+        concurrent fetches interleave at frame granularity."""
+        with self._fetch_sem:
+            try:
+                data = self.store.get_bytes(loc)
+            except BaseException as e:  # noqa: BLE001
+                try:
+                    self.conn.send(("fetched", rid, None, e))
+                except ConnectionClosed:
+                    pass
+                return
+            try:
+                total = len(data)
+                if total <= FETCH_CHUNK:
+                    self.conn.send(("fetched", rid, data, None))
+                    return
+                for off in range(0, total, FETCH_CHUNK):
+                    self.conn.send(("fetched_chunk", rid, off, total,
+                                    data[off:off + FETCH_CHUNK]))
+            except ConnectionClosed:
+                pass
+
+    def _spawn(self, wid: str, tpu_capable: bool) -> None:
+        env = dict(os.environ)
+        env["RAY_TPU_JOB_ID"] = self.job_id
+        env["RAY_TPU_LOG_DIR"] = self.log_dir
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        agent_paths = [p for p in sys.path
+                       if p and os.path.isdir(p) and p != repo_root]
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root, *agent_paths,
+             *[p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+               if p]])
+        if not tpu_capable:
+            from ..util.jaxenv import subprocess_env_cpu  # noqa: PLC0415
+            subprocess_env_cpu(env)
+        self.workers[wid] = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker",
+             self.driver_address, wid],
+            env=env, cwd=os.getcwd())
+
+    def _cleanup(self) -> None:
+        for proc in self.workers.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.time() + 2.0
+        for proc in self.workers.values():
+            try:
+                proc.wait(timeout=max(0.01, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        try:
+            self.store.shutdown()
+        except Exception:
+            traceback.print_exc()
+        import shutil
+        shutil.rmtree(self._tmpdir, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="ray_tpu node agent: join this host to a driver")
+    ap.add_argument("driver_address",
+                    help="tcp://<driver-host>:<port> of ray_tpu.init("
+                         "listen=...)")
+    ap.add_argument("--num-cpus", type=int, default=None)
+    ap.add_argument("--num-tpus", type=int, default=None)
+    ap.add_argument("--store-bytes", type=int, default=None)
+    ap.add_argument("--resources", type=str, default=None,
+                    help='extra custom resources as JSON, e.g. '
+                         '\'{"my_res": 2}\'')
+    args = ap.parse_args()
+    import json
+    extra = json.loads(args.resources) if args.resources else None
+    agent = NodeAgent(args.driver_address, num_cpus=args.num_cpus,
+                      num_tpus=args.num_tpus, resources=extra,
+                      store_bytes=args.store_bytes)
+    print(f"ray_tpu node {agent.node_id} joined {args.driver_address}",
+          flush=True)
+    agent.run()
+
+
+if __name__ == "__main__":
+    main()

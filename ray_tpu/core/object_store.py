@@ -18,6 +18,7 @@ the reference's in-band "plasma promotion" threshold
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -32,10 +33,36 @@ INLINE_MAX = 64 * 1024
 @dataclasses.dataclass
 class ObjectLocation:
     """Picklable descriptor of where a sealed object's payload lives."""
-    kind: str                      # "inline" | "shm"
+    kind: str                      # "inline" | "shm" | "native" | "spill"
     size: int
     data: Optional[bytes] = None   # inline payload
-    name: Optional[str] = None     # shm segment name
+    name: Optional[str] = None     # shm segment name / spill file path
+    # Which node's store holds the payload. None = the driver's node (the
+    # single-host case and all pre-multihost callers). Cross-node reads go
+    # through the driver's fetch path instead of attaching shm.
+    node_id: Optional[str] = None
+    # Disk copy written by the SpillManager; readers fall back to it when
+    # the arena copy has been evicted (core/spilling.py).
+    spill_path: Optional[str] = None
+
+
+def current_node_id() -> Optional[str]:
+    """The node this process's store writes into (env-inherited from the
+    driver or node agent that spawned it)."""
+    return os.environ.get("RAY_TPU_NODE_ID") or None
+
+
+def _read_spill_loc(loc: "ObjectLocation") -> bytes:
+    path = loc.spill_path or (loc.name if loc.kind == "spill" else None)
+    if not path:
+        raise ObjectLostError(
+            f"segment {loc.name} is gone (evicted?) and has no spill copy")
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError as e:
+        raise ObjectLostError(
+            f"spill file {path} unreadable: {e}") from e
 
 
 def _untrack(shm: shared_memory.SharedMemory) -> None:
@@ -55,6 +82,10 @@ class ShmStore:
         self.is_owner = is_owner
         self._used = 0
         self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        # Names THIS process created via put_value: _used only ever counted
+        # those, so deletes must only decrement for them — unlinking a
+        # worker-created segment must not corrupt the owner's accounting.
+        self._created: set = set()
         self._lock = threading.Lock()
 
     # -- write path ---------------------------------------------------------
@@ -80,18 +111,61 @@ class ShmStore:
             raise
         with self._lock:
             self._segments[name] = seg
+            self._created.add(name)
             self._used += size
-        return ObjectLocation(kind="shm", size=size, name=name)
+        return ObjectLocation(kind="shm", size=size, name=name,
+                              node_id=current_node_id())
 
     # -- read path ----------------------------------------------------------
     def get_value(self, loc: ObjectLocation) -> Any:
         if loc.kind == "inline":
             return serialization.unpack(loc.data)
+        if loc.kind == "spill":
+            return serialization.unpack(_read_spill_loc(loc))
         if loc.kind == "shm":
-            seg = self._attach(loc.name)
+            try:
+                seg = self._attach(loc.name)
+            except ObjectLostError:
+                # evicted from shm, but a spill copy survives on disk
+                return serialization.unpack(_read_spill_loc(loc))
             # memoryview aliases the mapped pages -> zero-copy numpy reads.
             return serialization.unpack(seg.buf[:loc.size])
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def get_bytes(self, loc: ObjectLocation) -> bytes:
+        """Raw packed payload — the cross-node transfer unit (the remote
+        side rebuilds the value with serialization.unpack)."""
+        if loc.kind == "inline":
+            return loc.data
+        if loc.kind == "spill":
+            return _read_spill_loc(loc)
+        if loc.kind == "shm":
+            try:
+                seg = self._attach(loc.name)
+            except ObjectLostError:
+                return _read_spill_loc(loc)
+            return bytes(seg.buf[:loc.size])
+        raise ObjectLostError(f"unknown location kind {loc.kind!r}")
+
+    def put_packed(self, oid: str, data: bytes) -> ObjectLocation:
+        """Seal an already-packed payload (a cross-node fetch re-hosted
+        into this node's store, so local readers get zero-copy shm)."""
+        size = len(data)
+        if size <= INLINE_MAX:
+            return ObjectLocation(kind="inline", size=size, data=data)
+        with self._lock:
+            if self._used + size > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object {oid} ({size} B) exceeds store capacity")
+        name = "rtpu_" + oid.replace("-", "") + "c"
+        seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        seg.buf[:size] = data
+        with self._lock:
+            self._segments[name] = seg
+            self._created.add(name)
+            self._used += size
+        return ObjectLocation(kind="shm", size=size, name=name,
+                              node_id=current_node_id())
 
     def _attach(self, name: str) -> shared_memory.SharedMemory:
         with self._lock:
@@ -119,6 +193,8 @@ class ShmStore:
         """Owner-side unlink (eviction / free)."""
         with self._lock:
             seg = self._segments.pop(name, None)
+            created_here = name in self._created
+            self._created.discard(name)
         if seg is None:
             try:
                 seg = shared_memory.SharedMemory(name=name, create=False)
@@ -131,8 +207,9 @@ class ShmStore:
                 seg.unlink()
             except FileNotFoundError:
                 pass
-            with self._lock:
-                self._used = max(0, self._used - size)
+            if created_here:
+                with self._lock:
+                    self._used = max(0, self._used - size)
 
     def used_bytes(self) -> int:
         return self._used

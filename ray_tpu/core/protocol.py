@@ -105,3 +105,35 @@ def unix_connect(path: str, timeout: Optional[float] = 10.0) -> Connection:
     s.connect(path)
     s.settimeout(None)
     return Connection(s)
+
+
+def tcp_listener(host: str = "127.0.0.1", port: int = 0) -> socket.socket:
+    """Multi-host transport: remote node agents and their workers speak
+    the same framed protocol over TCP (reference parity: the gRPC
+    services of src/ray/gcs/gcs_server/gcs_node_manager.cc — here one
+    listener serves workers AND node agents, demuxed by the first
+    message)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, port))
+    s.listen(128)
+    return s
+
+
+def tcp_connect(host: str, port: int,
+                timeout: Optional[float] = 10.0) -> Connection:
+    s = socket.create_connection((host, port), timeout=timeout)
+    s.settimeout(None)
+    return Connection(s)
+
+
+def connect_address(address: str,
+                    timeout: Optional[float] = 10.0) -> Connection:
+    """Connect to "tcp://host:port" or a unix-socket path (optionally
+    "unix://path")."""
+    if address.startswith("tcp://"):
+        host, _, port = address[len("tcp://"):].rpartition(":")
+        return tcp_connect(host, int(port), timeout=timeout)
+    if address.startswith("unix://"):
+        address = address[len("unix://"):]
+    return unix_connect(address, timeout=timeout)

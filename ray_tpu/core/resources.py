@@ -1,14 +1,21 @@
 """Resource model with TPU as a first-class accelerator.
 
 Reference parity: python/ray/_private/resource_spec.py and
-python/ray/_private/accelerators/tpu.py (TPU pod/slice detection, the
-"TPU-<version>-head" resource). Here TPU chips are native schedulable
-resources ("TPU") plus topology labels, so placement can be ICI-aware.
+python/ray/_private/accelerators/tpu.py (TPU pod/slice detection at
+:198, pod-type resources at :276-319). TPU chips are native schedulable
+resources ("TPU"); a node belonging to a pod slice additionally carries
+topology labels (pod type, slice name, worker index, chips per host) and
+— on the slice's worker 0 — the "TPU-<pod_type>-head" gang resource, so
+a whole slice can be claimed by scheduling one head task/actor and
+fanning out over the slice's nodes (the reference's multi-host gang
+idiom).
 """
 from __future__ import annotations
 
 import os
 from typing import Dict, Optional
+
+TPU_HEAD_FMT = "TPU-{pod_type}-head"
 
 
 def detect_node_resources(num_cpus: Optional[int] = None,
@@ -25,7 +32,43 @@ def detect_node_resources(num_cpus: Optional[int] = None,
     if num_tpus:
         res["TPU"] = float(num_tpus)
     res["memory"] = float(_detect_memory_bytes())
+    topo = detect_tpu_topology(num_tpus)
+    if topo.get("tpu-pod-type"):
+        # One gang resource per slice, held by the slice's first worker:
+        # scheduling {TPU-<pod>-head: 1} lands exactly one controller task
+        # on each slice (ref accelerators/tpu.py:276-319).
+        if int(topo.get("tpu-worker-id", "0") or 0) == 0:
+            res[TPU_HEAD_FMT.format(pod_type=topo["tpu-pod-type"])] = 1.0
     return res
+
+
+def detect_tpu_topology(num_chips: Optional[int] = None) -> Dict[str, str]:
+    """Slice/pod topology labels from the environment.
+
+    Mirrors the reference's TPU pod detection from TPU-VM metadata/env
+    (accelerators/tpu.py:198): on a real TPU VM, the runtime publishes
+    accelerator type (e.g. "v5e-8"), the slice/pod name, and this host's
+    worker index within the slice. Here they come from env so a pod can
+    also be modeled in tests.
+    """
+    labels: Dict[str, str] = {}
+    pod_type = (os.environ.get("RAY_TPU_POD_TYPE")
+                or os.environ.get("TPU_ACCELERATOR_TYPE", ""))
+    if pod_type:
+        labels["tpu-pod-type"] = pod_type
+    slice_name = (os.environ.get("RAY_TPU_SLICE")
+                  or os.environ.get("TPU_NAME", ""))
+    if slice_name:
+        labels["tpu-slice"] = slice_name
+    worker_id = (os.environ.get("RAY_TPU_WORKER_ID")
+                 or os.environ.get("TPU_WORKER_ID", ""))
+    if worker_id:
+        labels["tpu-worker-id"] = worker_id
+    if num_chips is None:
+        num_chips = _detect_tpu_chips()
+    if num_chips and labels:
+        labels["tpu-chips-per-host"] = str(num_chips)
+    return labels
 
 
 def _detect_tpu_chips() -> int:

@@ -28,15 +28,17 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import resources as res_mod
+from . import serialization
 from .gcs import GCS, ActorEntry, TaskEntry, NodeEntry
 from .ids import new_node_id, new_object_id
 from .object_ref import ObjectRef
 from .object_store import make_store
-from .protocol import Connection, ConnectionClosed, unix_listener
+from .protocol import (Connection, ConnectionClosed, tcp_listener,
+                       unix_listener)
 from .task import TaskSpec, ActorCreationSpec
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
-                          RuntimeNotInitializedError, TaskCancelledError,
-                          TaskError, WorkerCrashedError)
+                          PlacementGroupError, RuntimeNotInitializedError,
+                          TaskCancelledError, TaskError, WorkerCrashedError)
 
 _runtime: Optional[Any] = None
 _runtime_lock = threading.Lock()
@@ -61,12 +63,13 @@ def runtime_initialized() -> bool:
 class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
                  "actor_id", "held_resources", "blocked", "started_at",
-                 "purpose", "tpu_capable")
+                 "purpose", "tpu_capable", "node_id")
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen, purpose=None,
-                 tpu_capable: bool = False):
+    def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
+                 purpose=None, tpu_capable: bool = False,
+                 node_id: Optional[str] = None):
         self.worker_id = worker_id
-        self.proc = proc
+        self.proc = proc               # None for workers on remote nodes
         self.conn: Optional[Connection] = None
         self.pid: Optional[int] = None
         self.state = "starting"        # starting|idle|busy|actor|dead
@@ -77,6 +80,29 @@ class WorkerState:
         self.started_at = time.time()
         self.purpose = purpose         # None (general) | actor_id
         self.tpu_capable = tpu_capable
+        self.node_id = node_id
+
+
+class NodeState:
+    """Per-node scheduling view: capacity, availability, topology labels,
+    and (for remote nodes) the node-agent connection used to spawn
+    workers and fetch objects. The driver's own host is node 0 with
+    conn=None (reference parity: per-node resource views in
+    gcs_node_manager.cc / node_manager.cc)."""
+    __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
+                 "alive")
+
+    def __init__(self, node_id: str, hostname: str,
+                 resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 conn: Optional[Connection] = None):
+        self.node_id = node_id
+        self.hostname = hostname
+        self.total = dict(resources)
+        self.avail = dict(resources)
+        self.labels = dict(labels or {})
+        self.conn = conn
+        self.alive = True
 
 
 class Waiter:
@@ -100,8 +126,11 @@ class PlacementGroupState:
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
-        self.state = "PENDING"         # PENDING|CREATED|REMOVED
+        self.state = "PENDING"         # PENDING|CREATED|INFEASIBLE|REMOVED
         self.ready_ref: Optional[str] = None
+        # node_id per bundle, filled at admission by the strategy solver
+        self.bundle_nodes: List[str] = []
+        self.created_at = time.time()
 
 
 class DriverRuntime:
@@ -109,19 +138,24 @@ class DriverRuntime:
 
     def __init__(self, *, num_cpus=None, num_tpus=None, resources=None,
                  object_store_memory=None, max_workers=None, namespace="default",
-                 job_id=None, log_to_driver=True):
+                 job_id=None, log_to_driver=True, listen=None):
         self.namespace = namespace
         self.job_id = job_id or f"job-{os.getpid()}"
         self.gcs = GCS()
         self.node_id = new_node_id()
+        # Stamp this process's node id so ObjectLocations created by the
+        # driver (and env-inheriting local workers) carry it.
+        os.environ["RAY_TPU_NODE_ID"] = self.node_id
         node_res = res_mod.detect_node_resources(num_cpus, num_tpus)
         if resources:
             node_res.update(resources)
-        self.total_resources = dict(node_res)
-        self.avail = dict(node_res)
+        labels = res_mod.detect_tpu_topology(int(node_res.get("TPU", 0)))
+        self.cluster_nodes: Dict[str, NodeState] = {
+            self.node_id: NodeState(self.node_id, os.uname().nodename,
+                                    node_res, labels=labels, conn=None)}
         self.gcs.nodes[self.node_id] = NodeEntry(
             node_id=self.node_id, hostname=os.uname().nodename,
-            resources=dict(node_res))
+            resources=dict(node_res), labels=labels)
 
         cap = object_store_memory or int(
             os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30)))
@@ -130,8 +164,25 @@ class DriverRuntime:
             os.environ.get("RAY_TPU_MAX_WORKERS", "16"))
 
         self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_")
+        from .spilling import SpillManager  # noqa: PLC0415
+        self._spill_env_owned = "RAY_TPU_SPILL_DIR" not in os.environ
+        spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
+            self._tmpdir, "spill")
+        os.environ["RAY_TPU_SPILL_DIR"] = spill_dir  # workers inherit
+        self._spill = SpillManager(self.store, spill_dir, self.node_id)
         self.socket_path = os.path.join(self._tmpdir, "driver.sock")
         self._listener = unix_listener(self.socket_path)
+        # Multi-host: optional TCP listener for remote node agents and the
+        # workers they spawn ("host:port", port 0 = ephemeral).
+        listen = listen or os.environ.get("RAY_TPU_LISTEN")
+        self._tcp_listener = None
+        self.tcp_address: Optional[str] = None
+        if listen:
+            host, _, port = str(listen).rpartition(":")
+            host = host or "127.0.0.1"
+            self._tcp_listener = tcp_listener(host, int(port or 0))
+            lh, lp = self._tcp_listener.getsockname()[:2]
+            self.tcp_address = f"tcp://{lh}:{lp}"
         self.log_dir = os.path.join(self._tmpdir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
         self._log_streamer = None
@@ -143,6 +194,7 @@ class DriverRuntime:
         self.workers: Dict[str, WorkerState] = {}
         self.pending_tasks: collections.deque = collections.deque()
         self.pending_actors: collections.deque = collections.deque()
+        self.pending_restarts: collections.deque = collections.deque()
         self.actor_queues: Dict[str, collections.deque] = {}
         self.actor_inflight: Dict[str, int] = {}
         self.actor_max_conc: Dict[str, int] = {}
@@ -156,6 +208,10 @@ class DriverRuntime:
         self._wid_counter = 0
         self._shutdown = threading.Event()
         self._conn_by_wid: Dict[str, Connection] = {}
+        # cross-node fetch plumbing: rid -> (Event, box)
+        self._fetch_counter = 0
+        self._fetch_lock = threading.Lock()
+        self._fetch_events: Dict[int, Tuple[threading.Event, dict]] = {}
 
         self.report_handlers["sys.lookup_actor"] = self._sys_lookup_actor
 
@@ -170,17 +226,22 @@ class DriverRuntime:
             target=self._dispatch_loop, daemon=True, name="rtpu-dispatch")
         self._dispatcher.start()
         self._acceptor = threading.Thread(
-            target=self._accept_loop, daemon=True, name="rtpu-accept")
+            target=self._accept_loop, args=(self._listener,),
+            daemon=True, name="rtpu-accept")
         self._acceptor.start()
+        if self._tcp_listener is not None:
+            threading.Thread(target=self._accept_loop,
+                             args=(self._tcp_listener,), daemon=True,
+                             name="rtpu-accept-tcp").start()
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="rtpu-reaper")
         self._reaper.start()
 
     # ================= threads =================
-    def _accept_loop(self):
+    def _accept_loop(self, listener):
         while not self._shutdown.is_set():
             try:
-                sock, _ = self._listener.accept()
+                sock, _ = listener.accept()
             except OSError:
                 return
             conn = Connection(sock)
@@ -188,26 +249,47 @@ class DriverRuntime:
                              daemon=True).start()
 
     def _reader(self, conn: Connection):
+        """One thread per inbound connection; the first message decides
+        whether the peer is a worker ("register") or a remote node agent
+        ("register_node")."""
         wid = None
+        nid = None
         try:
             msg = conn.recv()
-            if msg[0] != "register":
+            if msg[0] == "register":
+                wid = msg[1]
+                self.inbox.put(("register", wid, conn, msg[2]))
+                while True:
+                    m = conn.recv()
+                    self.inbox.put(("worker_msg", wid, m))
+            elif msg[0] == "register_node":
+                nid = msg[1]["node_id"]
+                self.inbox.put(("register_node", msg[1], conn))
+                while True:
+                    m = conn.recv()
+                    self.inbox.put(("node_msg", nid, m))
+            else:
                 conn.close()
-                return
-            wid = msg[1]
-            self.inbox.put(("register", wid, conn, msg[2]))
-            while True:
-                m = conn.recv()
-                self.inbox.put(("worker_msg", wid, m))
         except ConnectionClosed:
             if wid is not None:
                 self.inbox.put(("worker_dead", wid))
+            if nid is not None:
+                self.inbox.put(("node_dead", nid))
 
     def _reap_loop(self):
         while not self._shutdown.is_set():
             time.sleep(0.5)
+            # Periodic tick: re-runs _schedule even with no worker events,
+            # so time-based decisions (pg infeasibility grace) fire.
+            self.inbox.put(("tick",))
             for w in list(self.workers.values()):
-                if w.state == "starting" and w.proc.poll() is not None:
+                if w.state != "starting":
+                    continue
+                if w.proc is not None and w.proc.poll() is not None:
+                    self.inbox.put(("worker_dead", w.worker_id))
+                elif w.proc is None and time.time() - w.started_at > 120:
+                    # remote worker that never registered (agent-side
+                    # spawn failure with no proc handle to poll)
                     self.inbox.put(("worker_dead", w.worker_id))
 
     def _dispatch_loop(self):
@@ -246,6 +328,19 @@ class DriverRuntime:
             self._handle_worker_msg(wid, m)
         elif kind == "worker_dead":
             self._on_worker_dead(item[1])
+        elif kind == "register_node":
+            self._on_register_node(item[1], item[2])
+        elif kind == "node_msg":
+            self._handle_node_msg(item[1], item[2])
+        elif kind == "node_dead":
+            self._on_node_dead(item[1])
+        elif kind == "object_copied":
+            e = self.gcs.objects.get(item[1])
+            if e is not None and e.state == "ready":
+                # future readers hit the local copy; the original stays
+                # freed alongside it (ObjectEntry.copies)
+                e.copies.append(e.loc)
+                e.loc = item[2]
         elif kind == "api_submit":
             self._register_task(item[1])
         elif kind == "api_submit_actor":
@@ -322,9 +417,125 @@ class DriverRuntime:
             if w and w.conn:
                 w.conn.send(("get_reply", rid, result))
 
+    # ---------------- nodes ----------------
+    def _on_register_node(self, info: dict, conn: Connection) -> None:
+        nid = info["node_id"]
+        ns = NodeState(nid, info.get("hostname", "?"), info["resources"],
+                       labels=info.get("labels"), conn=conn)
+        self.cluster_nodes[nid] = ns
+        self.gcs.nodes[nid] = NodeEntry(
+            node_id=nid, hostname=ns.hostname, resources=dict(ns.total),
+            labels=dict(ns.labels))
+        conn.send(("node_registered", self.node_id, self.job_id))
+
+    def _handle_node_msg(self, nid: str, m) -> None:
+        from .protocol import RECV_ERROR  # noqa: PLC0415
+        mtype = m[0]
+        if mtype == RECV_ERROR:
+            sys.stderr.write(f"[ray_tpu driver] dropped undeserializable "
+                             f"message from node {nid}:\n{m[1]}")
+        elif mtype == "fetched":
+            _, rid, data, err = m
+            with self._fetch_lock:
+                pair = self._fetch_events.pop(rid, None)
+            if pair is not None:
+                ev, box = pair
+                box["data"], box["err"] = data, err
+                ev.set()
+        elif mtype == "fetched_chunk":
+            # large payloads stream in frames under the protocol cap
+            _, rid, off, total, chunk = m
+            with self._fetch_lock:
+                pair = self._fetch_events.get(rid)
+            if pair is None:
+                return
+            ev, box = pair
+            buf = box.get("buf")
+            if buf is None:
+                buf = box["buf"] = bytearray(total)
+                box["got"] = 0
+            buf[off:off + len(chunk)] = chunk
+            box["got"] += len(chunk)
+            if box["got"] >= total:
+                with self._fetch_lock:
+                    self._fetch_events.pop(rid, None)
+                box["data"], box["err"] = bytes(buf), None
+                ev.set()
+        elif mtype == "worker_spawn_failed":
+            sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
+                             f"worker {m[1]}: {m[2]}\n")
+            self.inbox.put(("worker_dead", m[1]))
+
+    def _on_node_dead(self, nid: str) -> None:
+        ns = self.cluster_nodes.get(nid)
+        if ns is None or not ns.alive:
+            return
+        ns.alive = False
+        entry = self.gcs.nodes.get(nid)
+        if entry is not None:
+            entry.alive = False
+        # In-flight fetches against this node resolve via their timeout.
+        for w in list(self.workers.values()):
+            if w.node_id == nid and w.state != "dead":
+                self._on_worker_dead(w.worker_id)
+        # CREATED placement groups with a bundle on the dead node go back
+        # to PENDING (the reference's RESCHEDULING): surviving-node
+        # reservations are released and phase 0 re-solves against the
+        # remaining topology. ready_ref stays sealed — holders simply see
+        # their pg-bound work queue until capacity reappears (or the
+        # infeasibility grace declares it impossible).
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED" and nid in pg.bundle_nodes:
+                for b, bn in zip(pg.bundles, pg.bundle_nodes):
+                    node = self.cluster_nodes.get(bn)
+                    if bn != nid and node is not None and node.alive:
+                        res_mod.release(node.avail, b)
+                pg.bundle_nodes = []
+                pg.state = "PENDING"
+                pg.created_at = time.time()
+
+    def fetch_bytes(self, loc) -> bytes:
+        """Pull a remote object's packed payload through its node agent.
+        Called from API/helper threads (never the dispatcher — it blocks)."""
+        ns = self.cluster_nodes.get(loc.node_id or "")
+        if ns is None or not ns.alive or ns.conn is None:
+            raise ObjectLostError(
+                f"object payload lives on node {loc.node_id}, which is "
+                "gone")
+        with self._fetch_lock:
+            self._fetch_counter += 1
+            rid = self._fetch_counter
+            ev: threading.Event = threading.Event()
+            box: dict = {}
+            self._fetch_events[rid] = (ev, box)
+        try:
+            ns.conn.send(("fetch_object", rid, loc))
+        except ConnectionClosed:
+            with self._fetch_lock:
+                self._fetch_events.pop(rid, None)
+            raise ObjectLostError(
+                f"node {loc.node_id} connection lost during fetch") from None
+        if not ev.wait(timeout=60.0):
+            with self._fetch_lock:
+                self._fetch_events.pop(rid, None)
+            raise ObjectLostError(
+                f"fetch of {loc.name} from node {loc.node_id} timed out")
+        if box.get("err") is not None:
+            err = box["err"]
+            raise err if isinstance(err, BaseException) else \
+                ObjectLostError(str(err))
+        return box["data"]
+
+    def _load_location(self, loc) -> Any:
+        """Materialize a value wherever its payload lives."""
+        if loc.kind == "inline" or loc.node_id in (None, self.node_id):
+            return self.store.get_value(loc)
+        return serialization.unpack(self.fetch_bytes(loc))
+
     # ---------------- objects ----------------
     def _seal(self, oid: str, loc) -> None:
         e = self.gcs.seal_object(oid, loc)
+        self._spill.on_seal(oid, e.loc)
         self._notify_object(oid)
 
     def _fail_object(self, oid: str, err) -> None:
@@ -436,24 +647,127 @@ class DriverRuntime:
                 return None
         return ok
 
-    @staticmethod
-    def _pg_total(bundles) -> Dict[str, float]:
-        total: Dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        return total
+    def _alive_nodes(self) -> List[NodeState]:
+        """Driver node first (locality), then remote nodes by id."""
+        out = []
+        drv = self.cluster_nodes.get(self.node_id)
+        if drv is not None and drv.alive:
+            out.append(drv)
+        out.extend(sorted(
+            (n for n in self.cluster_nodes.values()
+             if n.alive and n.node_id != self.node_id),
+            key=lambda n: n.node_id))
+        return out
+
+    def _solve_pg(self, pg: PlacementGroupState) -> Optional[List[str]]:
+        """Assign each bundle a node per the strategy, against current
+        availability. Returns node ids per bundle, None if not (yet)
+        possible. Raises PlacementGroupError for STRICT_SPREAD that can
+        never fit the alive topology (ref: gcs_placement_group_scheduler.cc
+        strategy handling)."""
+        nodes = self._alive_nodes()
+        if not nodes:
+            return None
+
+        def fits_all_on(node: NodeState, bundles) -> bool:
+            total: Dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            return res_mod.fits(node.avail, total)
+
+        if pg.strategy in ("STRICT_PACK", "PACK"):
+            for n in nodes:
+                if fits_all_on(n, pg.bundles):
+                    return [n.node_id] * len(pg.bundles)
+            if pg.strategy == "STRICT_PACK":
+                return None
+            # PACK (non-strict): greedy first-fit across nodes
+            scratch = {n.node_id: dict(n.avail) for n in nodes}
+            assignment = []
+            for b in pg.bundles:
+                for n in nodes:
+                    if res_mod.fits(scratch[n.node_id], b):
+                        res_mod.acquire(scratch[n.node_id], b)
+                        assignment.append(n.node_id)
+                        break
+                else:
+                    return None
+            return assignment
+        if pg.strategy == "STRICT_SPREAD":
+            if len(pg.bundles) > len(nodes):
+                raise PlacementGroupError(
+                    f"STRICT_SPREAD needs {len(pg.bundles)} distinct "
+                    f"nodes; only {len(nodes)} alive")
+            # greedy distinct-node matching (bundles are usually uniform)
+            used: set = set()
+            assignment = []
+            for b in pg.bundles:
+                for n in nodes:
+                    if n.node_id not in used and res_mod.fits(n.avail, b):
+                        used.add(n.node_id)
+                        assignment.append(n.node_id)
+                        break
+                else:
+                    return None
+            return assignment
+        # SPREAD (best-effort round-robin, reusing nodes when needed)
+        scratch = {n.node_id: dict(n.avail) for n in nodes}
+        assignment = []
+        start = 0
+        for b in pg.bundles:
+            placed = False
+            for j in range(len(nodes)):
+                n = nodes[(start + j) % len(nodes)]
+                if res_mod.fits(scratch[n.node_id], b):
+                    res_mod.acquire(scratch[n.node_id], b)
+                    assignment.append(n.node_id)
+                    start = (start + j + 1) % len(nodes)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return assignment
+
+    def _pg_allowed_nodes(self, pg_id: Optional[str],
+                          bundle_index: int) -> Optional[List[str]]:
+        """Node ids a pg-bound task/actor may run on; None = pg not ready
+        (requeue); empty list = unconstrained."""
+        if pg_id is None:
+            return []
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg.state != "CREATED":
+            return None
+        if 0 <= bundle_index < len(pg.bundle_nodes):
+            return [pg.bundle_nodes[bundle_index]]
+        return list(dict.fromkeys(pg.bundle_nodes))
 
     def _schedule(self):
         # 0. pending placement groups admit as resources free up
         for pg in list(self.placement_groups.values()):
             if pg.state == "PENDING":
-                total = self._pg_total(pg.bundles)
-                if res_mod.fits(self.avail, total):
-                    res_mod.acquire(self.avail, total)
-                    pg.state = "CREATED"
-                    self._seal(pg.ready_ref,
-                               self.store.put_value(pg.ready_ref, True))
+                try:
+                    assignment = self._solve_pg(pg)
+                except PlacementGroupError as e:
+                    # Topology-infeasible *right now* — but nodes may
+                    # still be joining (a STRICT_SPREAD created before
+                    # remote agents register must not fail instantly).
+                    # Only declare infeasibility after a grace window.
+                    grace = float(os.environ.get(
+                        "RAY_TPU_PG_INFEASIBLE_GRACE_S", "10"))
+                    if time.time() - pg.created_at < grace:
+                        continue
+                    pg.state = "INFEASIBLE"
+                    self._fail_object(pg.ready_ref, e)
+                    continue
+                if assignment is None:
+                    continue
+                for b, nid in zip(pg.bundles, assignment):
+                    res_mod.acquire(self.cluster_nodes[nid].avail, b)
+                pg.bundle_nodes = assignment
+                pg.state = "CREATED"
+                self._seal(pg.ready_ref,
+                           self.store.put_value(pg.ready_ref, True))
 
         # 1. actor creations (dedicated worker each)
         still = collections.deque()
@@ -464,16 +778,58 @@ class DriverRuntime:
                 ae = self.gcs.actors[acspec.actor_id]
                 ae.state, ae.death_cause = "DEAD", "constructor arg errored"
                 continue
-            if dr is False or not res_mod.fits(self.avail, acspec.resources):
+            if dr is False:
                 still.append(acspec)
                 continue
-            res_mod.acquire(self.avail, acspec.resources)
+            allowed = self._pg_allowed_nodes(
+                getattr(acspec, "placement_group_id", None),
+                getattr(acspec, "bundle_index", -1))
+            if allowed is None:
+                still.append(acspec)
+                continue
+            need = {} if getattr(acspec, "placement_group_id", None) \
+                else acspec.resources
+            node = self._pick_node(need, allowed)
+            if node is None:
+                still.append(acspec)
+                continue
+            res_mod.acquire(node.avail, need)
             self._actor_create_specs[acspec.actor_id] = acspec
-            wid = self._spawn_worker(purpose=acspec.actor_id)
+            wid = self._spawn_worker(purpose=acspec.actor_id,
+                                     node_id=node.node_id)
             w = self.workers[wid]
-            w.held_resources = dict(acspec.resources)
+            w.held_resources = dict(need)
             w.actor_id = acspec.actor_id
         self.pending_actors = still
+
+        # 1.5 actor restarts: same fit/pg rules as creation, but without
+        # re-checking constructor deps (they were consumed at creation)
+        still = collections.deque()
+        while self.pending_restarts:
+            aid = self.pending_restarts.popleft()
+            ae = self.gcs.actors.get(aid)
+            if ae is None or ae.state != "RESTARTING":
+                continue
+            acspec: ActorCreationSpec = ae.create_spec
+            allowed = self._pg_allowed_nodes(
+                getattr(acspec, "placement_group_id", None),
+                getattr(acspec, "bundle_index", -1))
+            if allowed is None:
+                still.append(aid)
+                continue
+            need = {} if getattr(acspec, "placement_group_id", None) \
+                else acspec.resources
+            node = self._pick_node(need, allowed)
+            if node is None:
+                still.append(aid)
+                continue
+            res_mod.acquire(node.avail, need)
+            self._actor_create_specs[aid] = acspec
+            new_wid = self._spawn_worker(purpose=aid, node_id=node.node_id)
+            nw = self.workers[new_wid]
+            nw.held_resources = dict(need)
+            nw.actor_id = aid
+        self.pending_restarts = still
 
         # 2. normal tasks
         still = collections.deque()
@@ -498,18 +854,24 @@ class DriverRuntime:
             if dr is False:
                 still.append(spec)
                 continue
-            need = spec.resources if spec.placement_group_id is None else {}
-            if not res_mod.fits(self.avail, need):
+            allowed = self._pg_allowed_nodes(spec.placement_group_id,
+                                             spec.bundle_index)
+            if allowed is None:
                 still.append(spec)
                 continue
+            need = spec.resources if spec.placement_group_id is None else {}
             task_needs_tpu = spec.resources.get("TPU", 0) > 0
             w = self._find_idle_worker(
                 needs_tpu=task_needs_tpu,
-                allow_tpu_fallback=not tpu_demand)
+                allow_tpu_fallback=not tpu_demand,
+                allowed_nodes=allowed, need=need)
             if w is None:
-                if self._can_spawn(needs_tpu=task_needs_tpu):
+                node = self._pick_node(need, allowed)
+                if node is not None and self._can_spawn(
+                        node, needs_tpu=task_needs_tpu):
                     self._spawn_worker(purpose=None,
-                                       tpu_capable=task_needs_tpu)
+                                       tpu_capable=task_needs_tpu,
+                                       node_id=node.node_id)
                 still.append(spec)
                 continue
             try:
@@ -520,7 +882,7 @@ class DriverRuntime:
                 w.state = "dying"
                 still.append(spec)
                 continue
-            res_mod.acquire(self.avail, need)
+            res_mod.acquire(self.cluster_nodes[w.node_id].avail, need)
             w.state, w.current_task = "busy", spec.task_id
             w.held_resources = dict(need)
             te.state, te.worker_id, te.started_at = ("RUNNING", w.worker_id,
@@ -571,17 +933,45 @@ class DriverRuntime:
                                                          w.worker_id,
                                                          time.time())
 
+    def _wnode_avail(self, w: WorkerState) -> Dict[str, float]:
+        """The avail dict of the worker's node (a throwaway dict if the
+        node is gone — releases to dead nodes must not corrupt others)."""
+        node = self.cluster_nodes.get(w.node_id or self.node_id)
+        if node is None or not node.alive:
+            return {}
+        return node.avail
+
+    def _pick_node(self, need: Dict[str, float],
+                   allowed: List[str]) -> Optional[NodeState]:
+        """First alive node (driver-first) where `need` fits; `allowed`
+        non-empty restricts to those node ids (placement groups)."""
+        for n in self._alive_nodes():
+            if allowed and n.node_id not in allowed:
+                continue
+            if res_mod.fits(n.avail, need):
+                return n
+        return None
+
     def _find_idle_worker(self, needs_tpu: bool = False,
-                          allow_tpu_fallback: bool = True
+                          allow_tpu_fallback: bool = True,
+                          allowed_nodes: Optional[List[str]] = None,
+                          need: Optional[Dict[str, float]] = None
                           ) -> Optional[WorkerState]:
         # Prefer an exact capability match; a CPU task may fall back to an
         # idle TPU-capable worker (running plain Python there is harmless)
         # so capacity is never stranded — unless the caller knows TPU
         # demand is queued. A TPU task never runs on a worker without the
-        # device.
+        # device. The worker's node must also fit `need`.
         fallback = None
         for w in self.workers.values():
             if w.state != "idle" or w.conn is None:
+                continue
+            if allowed_nodes and w.node_id not in allowed_nodes:
+                continue
+            node = self.cluster_nodes.get(w.node_id)
+            if node is None or not node.alive:
+                continue
+            if need and not res_mod.fits(node.avail, need):
                 continue
             if w.tpu_capable == needs_tpu:
                 return w
@@ -589,16 +979,19 @@ class DriverRuntime:
                 fallback = w
         return fallback
 
-    def _can_spawn(self, needs_tpu: bool = False) -> bool:
-        # max_workers (bounded by CPU capacity for general workers) is a
-        # hard ceiling — it applies even when no starting/idle worker of
-        # the needed kind exists, otherwise sustained load with all
-        # workers busy would spawn one more worker per scheduling pass.
-        general_alive = len([w for w in self.workers.values()
+    def _can_spawn(self, node: NodeState, needs_tpu: bool = False) -> bool:
+        # max_workers (bounded by the node's CPU capacity for general
+        # workers) is a per-node hard ceiling — it applies even when no
+        # starting/idle worker of the needed kind exists, otherwise
+        # sustained load with all workers busy would spawn one more worker
+        # per scheduling pass.
+        on_node = [w for w in self.workers.values()
+                   if w.node_id == node.node_id]
+        general_alive = len([w for w in on_node
                              if w.state != "dead" and w.purpose is None])
-        cpu_cap = int(self.total_resources.get("CPU", 1)) or 1
+        cpu_cap = int(node.total.get("CPU", 1)) or 1
         under_cap = general_alive < min(self.max_workers, cpu_cap)
-        ready = sum(1 for w in self.workers.values()
+        ready = sum(1 for w in on_node
                     if w.state in ("starting", "idle")
                     and w.tpu_capable == needs_tpu)
         if ready == 0:
@@ -606,15 +999,30 @@ class DriverRuntime:
             # cap, or if the cap is consumed entirely by the other
             # capability kind and none of this kind is alive (a TPU task
             # must always be able to get at least one TPU worker).
-            alive_kind = sum(1 for w in self.workers.values()
+            alive_kind = sum(1 for w in on_node
                              if w.state != "dead" and w.purpose is None
                              and w.tpu_capable == needs_tpu)
             return under_cap or alive_kind == 0
         return under_cap
 
-    def _spawn_worker(self, purpose, tpu_capable: bool = False) -> str:
+    def _spawn_worker(self, purpose, tpu_capable: bool = False,
+                      node_id: Optional[str] = None) -> str:
         self._wid_counter += 1
         wid = f"w{self._wid_counter:04d}"
+        node_id = node_id or self.node_id
+        node = self.cluster_nodes[node_id]
+        acspec = self._actor_create_specs.get(purpose) if purpose else None
+        if acspec is not None and acspec.resources.get("TPU", 0) > 0:
+            tpu_capable = True
+        if node.conn is not None:
+            # remote node: its agent spawns the worker, which connects
+            # straight back to our TCP listener
+            node.conn.send(("spawn_worker", wid, bool(tpu_capable),
+                            self.job_id))
+            self.workers[wid] = WorkerState(wid, None, purpose=purpose,
+                                            tpu_capable=tpu_capable,
+                                            node_id=node_id)
+            return wid
         env = dict(os.environ)
         env["RAY_TPU_JOB_ID"] = self.job_id
         env["RAY_TPU_LOG_DIR"] = self.log_dir
@@ -634,9 +1042,6 @@ class DriverRuntime:
         # resources: the chip belongs to the driver-side SPMD step
         # (single-controller model), and letting every worker claim the
         # backend would deadlock the TPU tunnel.
-        acspec = self._actor_create_specs.get(purpose) if purpose else None
-        if acspec is not None and acspec.resources.get("TPU", 0) > 0:
-            tpu_capable = True
         if not tpu_capable:
             from ..util.jaxenv import subprocess_env_cpu  # noqa: PLC0415
             subprocess_env_cpu(env)
@@ -645,7 +1050,8 @@ class DriverRuntime:
              self.socket_path, wid],
             env=env, cwd=os.getcwd())
         self.workers[wid] = WorkerState(wid, proc, purpose=purpose,
-                                        tpu_capable=tpu_capable)
+                                        tpu_capable=tpu_capable,
+                                        node_id=node_id)
         return wid
 
     def _worker_for_actor(self, aid: str) -> Optional[WorkerState]:
@@ -682,7 +1088,7 @@ class DriverRuntime:
             self.actor_inflight[aid] = max(
                 0, self.actor_inflight.get(aid, 0) - 1)
         elif w is not None:
-            res_mod.release(self.avail, w.held_resources)
+            res_mod.release(self._wnode_avail(w), w.held_resources)
             w.held_resources = {}
             w.state, w.current_task, w.blocked = "idle", None, False
 
@@ -700,7 +1106,7 @@ class DriverRuntime:
             ae.state, ae.death_cause = "DEAD", repr(err)
             w = self.workers.get(wid)
             if w is not None:
-                res_mod.release(self.avail, w.held_resources)
+                res_mod.release(self._wnode_avail(w), w.held_resources)
                 w.held_resources = {}
                 self._terminate_worker(w)
             # propagate the constructor error to queued method calls
@@ -718,7 +1124,7 @@ class DriverRuntime:
         if not w.blocked:
             # Blocked workers already returned their resources when they
             # entered get() — releasing again would inflate capacity.
-            res_mod.release(self.avail, w.held_resources)
+            res_mod.release(self._wnode_avail(w), w.held_resources)
         w.held_resources = {}
         w.blocked = False
         self._conn_by_wid.pop(wid, None)
@@ -756,12 +1162,11 @@ class DriverRuntime:
         if ae.num_restarts < ae.max_restarts:
             ae.num_restarts += 1
             ae.state = "RESTARTING"
-            acspec: ActorCreationSpec = ae.create_spec
-            res_mod.acquire(self.avail, acspec.resources)
-            new_wid = self._spawn_worker(purpose=aid)
-            nw = self.workers[new_wid]
-            nw.held_resources = dict(acspec.resources)
-            nw.actor_id = aid
+            # Restart placement goes through the scheduler (phase 1.5):
+            # spawning here unconditionally could land the actor on a
+            # node that lacks its resources (or violate its placement
+            # group) and drive that node's avail negative.
+            self.pending_restarts.append(aid)
             # _on_actor_created flips state back to ALIVE on success.
         else:
             ae.state = "DEAD"
@@ -780,21 +1185,69 @@ class DriverRuntime:
             for oid in oids:
                 full[oid] = results.get(
                     oid, ("error", ObjectLostError(f"{oid} unavailable")))
-            if w is not None and w.conn is not None:
-                try:
-                    w.conn.send(("get_reply", rid, full))
-                except ConnectionClosed:
-                    pass
+            # Cross-node payloads can't be read from the requester's shm:
+            # fetch the packed bytes, re-host them in the driver's store
+            # (so same-host readers get zero-copy shm and repeat reads
+            # skip the network), and for workers on other nodes stream
+            # the bytes in chunks under the protocol frame cap. Fetching
+            # can block on another node, so it runs on a helper thread —
+            # never the dispatcher.
+            wnode = w.node_id if w is not None else self.node_id
+            cross = [oid for oid, (kind, p) in full.items()
+                     if kind == "loc" and p.kind != "inline"
+                     and (p.node_id or self.node_id) != wnode]
+
+            def finish(full=full, cross=cross, w=w, rid=rid, wnode=wnode):
+                chunk_sz = int(os.environ.get("RAY_TPU_FETCH_CHUNK",
+                                              str(64 << 20)))
+                for oid in cross:
+                    _, loc = full[oid]
+                    try:
+                        if (loc.node_id or self.node_id) == self.node_id:
+                            data = self.store.get_bytes(loc)
+                        else:
+                            data = self.fetch_bytes(loc)
+                            try:
+                                newloc = self.store.put_packed(oid, data)
+                            except Exception:
+                                newloc = None
+                            if newloc is not None:
+                                self.inbox.put(("object_copied", oid,
+                                                newloc))
+                                if wnode == self.node_id:
+                                    full[oid] = ("loc", newloc)
+                                    continue
+                        if (w is not None and w.conn is not None
+                                and len(data) > chunk_sz):
+                            for off in range(0, len(data), chunk_sz):
+                                w.conn.send(("value_chunk", rid, oid, off,
+                                             len(data),
+                                             data[off:off + chunk_sz]))
+                            full[oid] = ("value_staged", len(data))
+                        else:
+                            full[oid] = ("value", data)
+                    except BaseException as e:  # noqa: BLE001
+                        full[oid] = ("error", e)
+                if w is not None and w.conn is not None:
+                    try:
+                        w.conn.send(("get_reply", rid, full))
+                    except ConnectionClosed:
+                        pass
+
+            if cross:
+                threading.Thread(target=finish, daemon=True).start()
+            else:
+                finish()
             if w is not None and w.blocked:
                 w.blocked = False
-                res_mod.acquire(self.avail, w.held_resources)
+                res_mod.acquire(self._wnode_avail(w), w.held_resources)
         waiter = Waiter(oids, None, cb)
         if w is not None and w.state == "busy" and not w.blocked:
             # Worker blocks in user get(): release its resources so other
             # tasks can run (reference: raylet "blocked worker" CPU release,
             # src/ray/raylet/node_manager.cc HandleTaskBlocked).
             w.blocked = True
-            res_mod.release(self.avail, w.held_resources)
+            res_mod.release(self._wnode_avail(w), w.held_resources)
         self._add_waiter(waiter, timeout=timeout)
 
     def _worker_wait(self, w, rid, oids, num_returns, timeout):
@@ -877,9 +1330,21 @@ class DriverRuntime:
     def _free(self, oids: List[str]):
         for oid in oids:
             e = self.gcs.objects.pop(oid, None)
-            if e is not None and e.loc is not None and e.loc.kind in (
-                    "shm", "native"):
-                self.store.delete_segment(e.loc.name, e.loc.size)
+            if e is None or e.loc is None:
+                continue
+            for loc in [e.loc, *e.copies]:
+                holder = loc.node_id or self.node_id
+                if holder == self.node_id:
+                    if loc.kind in ("shm", "native"):
+                        self.store.delete_segment(loc.name, loc.size)
+                else:
+                    ns = self.cluster_nodes.get(holder)
+                    if ns is not None and ns.alive and ns.conn is not None:
+                        try:
+                            ns.conn.send(("free_object", loc))
+                        except ConnectionClosed:
+                            pass
+                self._spill.on_free(loc, oid)
 
     def _create_pg(self, pg: PlacementGroupState):
         # Registration only; admission happens in _schedule phase 0.
@@ -888,7 +1353,10 @@ class DriverRuntime:
     def _remove_pg(self, pg_id: str):
         pg = self.placement_groups.pop(pg_id, None)
         if pg is not None and pg.state == "CREATED":
-            res_mod.release(self.avail, self._pg_total(pg.bundles))
+            for b, nid in zip(pg.bundles, pg.bundle_nodes):
+                node = self.cluster_nodes.get(nid)
+                if node is not None and node.alive:
+                    res_mod.release(node.avail, b)
 
     # ================= public API (called from any thread) =================
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -903,8 +1371,12 @@ class DriverRuntime:
         self.inbox.put(("api_submit_actor", acspec))
 
     def put(self, value: Any) -> ObjectRef:
+        from .spilling import put_value_or_spill  # noqa: PLC0415
         oid = new_object_id()
-        loc = self.store.put_value(oid, value)
+        loc = put_value_or_spill(self.store, oid, value)
+        # Register for spilling NOW (not at dispatch): a burst of puts
+        # must not evict an object the dispatcher hasn't sealed yet.
+        self._spill.on_seal(oid, loc)
         self.inbox.put(("api_seal", oid, loc))
         return ObjectRef(oid)
 
@@ -931,7 +1403,7 @@ class DriverRuntime:
                 if isinstance(payload, BaseException):
                     raise payload
                 raise TaskError(str(payload))
-            out.append(self.store.get_value(payload))
+            out.append(self._load_location(payload))
         return out
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
@@ -996,10 +1468,20 @@ class DriverRuntime:
         self.inbox.put(("api_remove_pg", pg_id))
 
     def get_resources(self) -> Dict[str, float]:
-        return dict(self.total_resources)
+        total: Dict[str, float] = {}
+        for n in self.cluster_nodes.values():
+            if n.alive:
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
 
     def available_resources(self) -> Dict[str, float]:
-        return dict(self.avail)
+        avail: Dict[str, float] = {}
+        for n in self.cluster_nodes.values():
+            if n.alive:
+                for k, v in n.avail.items():
+                    avail[k] = avail.get(k, 0.0) + v
+        return avail
 
     def actor_state(self, actor_id: str) -> Optional[str]:
         ae = self.gcs.actors.get(actor_id)
@@ -1022,6 +1504,12 @@ class DriverRuntime:
         if self._shutdown.is_set():
             return
         self._shutdown.set()
+        for n in list(self.cluster_nodes.values()):
+            if n.conn is not None:
+                try:
+                    n.conn.send(("shutdown",))
+                except Exception:
+                    pass
         for w in list(self.workers.values()):
             try:
                 if w.conn:
@@ -1047,10 +1535,21 @@ class DriverRuntime:
             self._listener.close()
         except Exception:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except Exception:
+                pass
         if self._log_streamer is not None:
             self._log_streamer.stop()
         self.inbox.put(None)
         self.store.shutdown()
+        # Undo env we set so a later init() in this process gets a fresh
+        # spill dir / node id instead of this runtime's dead paths.
+        if self._spill_env_owned:
+            os.environ.pop("RAY_TPU_SPILL_DIR", None)
+        if os.environ.get("RAY_TPU_NODE_ID") == self.node_id:
+            os.environ.pop("RAY_TPU_NODE_ID", None)
         import shutil
         shutil.rmtree(self._tmpdir, ignore_errors=True)
         global _runtime

@@ -22,7 +22,7 @@ from . import serialization
 from .ids import new_object_id
 from .object_ref import ObjectRef
 from .object_store import ShmStore, ObjectLocation, INLINE_MAX, make_store
-from .protocol import Connection, ConnectionClosed, unix_connect
+from .protocol import Connection, ConnectionClosed, connect_address
 from .task import TaskSpec, ActorCreationSpec
 from ..exceptions import TaskError, GetTimeoutError, ObjectLostError
 
@@ -42,6 +42,9 @@ class WorkerRuntime:
         self.store = store
         self._replies: Dict[str, queue.Queue] = {}
         self._replies_lock = threading.Lock()
+        # (rid, oid) -> bytearray for cross-node values streamed in
+        # chunks ahead of the final get_reply (same socket => in order)
+        self._value_chunks: Dict[tuple, bytearray] = {}
         self._req_counter = 0
         self._func_cache: Dict[str, Any] = {}
         self.current_task_id: Optional[str] = None
@@ -67,6 +70,16 @@ class WorkerRuntime:
             with self._replies_lock:
                 self._replies.pop(rid, None)
 
+    def stash_value_chunk(self, rid: str, oid: str, off: int,
+                          total: int, chunk: bytes) -> None:
+        buf = self._value_chunks.get((rid, oid))
+        if buf is None:
+            buf = self._value_chunks[(rid, oid)] = bytearray(total)
+        buf[off:off + len(chunk)] = chunk
+
+    def take_staged_value(self, rid: str, oid: str) -> bytes:
+        return bytes(self._value_chunks.pop((rid, oid)))
+
     def deliver_reply(self, rid: str, payload: Any) -> None:
         with self._replies_lock:
             q = self._replies.get(rid)
@@ -78,18 +91,49 @@ class WorkerRuntime:
         oids = [r.id for r in refs]
         rid = self._new_req()
         self.conn.send(("get_request", rid, oids, timeout))
-        results = self._take_reply(rid, timeout)  # {oid: ("loc"|"error", payload)}
+        results = self._take_reply(rid, timeout)  # {oid: (kind, payload)}
         out = []
         for oid in oids:
             kind, payload = results[oid]
             if kind == "error":
                 raise payload if isinstance(payload, BaseException) else TaskError(str(payload))
-            out.append(self.store.get_value(payload))
+            if kind == "value":
+                # cross-node object: the driver shipped the packed bytes
+                # (its node fetched them from the holder's store)
+                out.append(serialization.unpack(payload))
+            elif kind == "value_staged":
+                # big cross-node object: bytes arrived ahead of the reply
+                # as value_chunk frames
+                out.append(serialization.unpack(
+                    self.take_staged_value(rid, oid)))
+            else:
+                try:
+                    out.append(self.store.get_value(payload))
+                except ObjectLostError:
+                    # The spiller (or arena LRU) dropped the segment after
+                    # this loc was serialized but before we read it; a
+                    # fresh request returns a spill-aware loc (or the
+                    # re-hosted bytes). One retry closes the race.
+                    out.append(self._get_one_fresh(oid, timeout))
         return out
 
+    def _get_one_fresh(self, oid: str, timeout: Optional[float]) -> Any:
+        rid = self._new_req()
+        self.conn.send(("get_request", rid, [oid], timeout))
+        kind, payload = self._take_reply(rid, timeout)[oid]
+        if kind == "error":
+            raise payload if isinstance(payload, BaseException) \
+                else TaskError(str(payload))
+        if kind == "value":
+            return serialization.unpack(payload)
+        if kind == "value_staged":
+            return serialization.unpack(self.take_staged_value(rid, oid))
+        return self.store.get_value(payload)
+
     def put(self, value: Any) -> ObjectRef:
+        from .spilling import put_value_or_spill  # noqa: PLC0415
         oid = new_object_id()
-        loc = self.store.put_value(oid, value)
+        loc = put_value_or_spill(self.store, oid, value)
         self.conn.send(("put", oid, loc))
         return ObjectRef(oid)
 
@@ -161,7 +205,9 @@ def _resolve_args(rt: WorkerRuntime, args, kwargs):
 
 class WorkerLoop:
     def __init__(self, socket_path: str, worker_id: str):
-        self.conn = unix_connect(socket_path)
+        # socket_path is a unix path for same-host workers or
+        # "tcp://host:port" for workers spawned by a remote node agent.
+        self.conn = connect_address(socket_path)
         self.store = make_store(capacity_bytes=int(
             os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30))), is_owner=False)
         self.rt = WorkerRuntime(self.conn, worker_id, self.store)
@@ -220,6 +266,9 @@ class WorkerLoop:
                 self._task_q.put(("actor_task", msg[1]))
             elif mtype == "get_reply":
                 self.rt.deliver_reply(msg[1], msg[2])
+            elif mtype == "value_chunk":
+                self.rt.stash_value_chunk(msg[1], msg[2], msg[3], msg[4],
+                                          msg[5])
             elif mtype == "cancel":
                 self._cancelled.add(msg[1])
             elif mtype == "shutdown":
@@ -234,9 +283,10 @@ class WorkerLoop:
             raise ValueError(
                 f"task {spec.name} declared num_returns={n} but returned "
                 f"{len(values)} values")
+        from .spilling import put_value_or_spill  # noqa: PLC0415
         sealed = []
         for oid, val in zip(spec.return_ids, values):
-            loc = self.store.put_value(oid, val)
+            loc = put_value_or_spill(self.store, oid, val)
             sealed.append((oid, loc))
         return sealed
 

@@ -61,3 +61,8 @@ class RuntimeNotInitializedError(RayTpuError):
 
 class ObjectStoreFullError(RayTpuError):
     """The shared-memory object store could not satisfy an allocation."""
+
+
+class PlacementGroupError(RayTpuError):
+    """A placement group cannot be satisfied (e.g. STRICT_SPREAD with more
+    bundles than alive nodes)."""
