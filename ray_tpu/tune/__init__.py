@@ -4,7 +4,7 @@ from .space import (uniform, loguniform, quniform, randint, choice,
                     grid_search, generate_variants)
 from .schedulers import (FIFOScheduler, ASHAScheduler, HyperBandScheduler,
                          MedianStoppingRule, PopulationBasedTraining)
-from .tuner import Tuner, TuneConfig, ResultGrid, Trial
+from .tuner import Tuner, TuneConfig, ResultGrid, Trial, with_resources
 from .session import report, get_trial_id, StopTrial
 from .stoppers import (CombinedStopper, ExperimentPlateauStopper,
                        FunctionStopper, MaximumIterationStopper, Stopper,
@@ -22,4 +22,4 @@ __all__ = ["uniform", "loguniform", "quniform", "randint", "choice",
            "ExperimentPlateauStopper", "TimeoutStopper", "CombinedStopper",
            "FunctionStopper", "Callback", "CSVLoggerCallback",
            "JsonLoggerCallback", "Searcher", "TPESampler",
-           "BasicVariantGenerator", "Trainable"]
+           "BasicVariantGenerator", "Trainable", "with_resources"]

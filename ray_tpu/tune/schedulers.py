@@ -83,9 +83,64 @@ class MedianStoppingRule(TrialScheduler):
         return CONTINUE if mine >= median else STOP
 
 
-class HyperBandScheduler(ASHAScheduler):
-    """Async variant == ASHA with aggressive halving (reference keeps both
-    names; the async algorithm subsumes the bracketed one for our scale)."""
+class HyperBandScheduler(TrialScheduler):
+    """Multi-bracket asynchronous HyperBand (reference:
+    python/ray/tune/schedulers/hyperband.py + async_hyperband.py with
+    brackets > 1).
+
+    Each trial is assigned round-robin to one of `brackets` successive-
+    halving brackets whose grace periods are geometrically staggered
+    (grace, grace*rf, grace*rf^2, ...): aggressive brackets kill weak
+    trials early, conservative ones give slow starters a longer runway —
+    the HyperBand exploration/exploitation hedge, unlike plain ASHA's
+    single bracket."""
+
+    def __init__(self, *, metric: str = "", mode: str = "max",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100, brackets: int = 3):
+        self.mode = mode
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.brackets: List[Dict[int, List[float]]] = []
+        self.bracket_grace: List[int] = []
+        for s in range(max(1, brackets)):
+            grace = grace_period * (reduction_factor ** s)
+            if grace >= max_t:
+                break
+            rungs: Dict[int, List[float]] = {}
+            rung = grace
+            while rung < max_t:
+                rungs[rung] = []
+                rung *= reduction_factor
+            self.brackets.append(rungs)
+            self.bracket_grace.append(grace)
+        if not self.brackets:
+            raise ValueError(
+                f"grace_period ({grace_period}) must be < max_t ({max_t}) "
+                "to form at least one HyperBand bracket")
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def bracket_of(self, trial_id: str) -> int:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._next_bracket
+            self._assignment[trial_id] = b
+            self._next_bracket = (b + 1) % len(self.brackets)
+        return b
+
+    def on_result(self, trial_id, iteration, value) -> str:
+        if iteration >= self.max_t:
+            return STOP
+        rungs = self.brackets[self.bracket_of(trial_id)]
+        if iteration not in rungs:
+            return CONTINUE
+        v = value if self.mode == "max" else -value
+        rung = rungs[iteration]
+        rung.append(v)
+        k = max(1, len(rung) // self.rf)
+        top_k = sorted(rung, reverse=True)[:k]
+        return CONTINUE if v >= top_k[-1] else STOP
 
 
 class PopulationBasedTraining(TrialScheduler):

@@ -31,6 +31,23 @@ from .space import generate_variants
 _tuner_ids = itertools.count()
 
 
+def with_resources(trainable: Callable,
+                   resources: Dict[str, float]) -> Callable:
+    """Attach a per-trial resource request to a trainable (reference:
+    python/ray/tune/trainable/util.py tune.with_resources). Keys: "CPU",
+    "TPU", or any custom node resource; the Tuner reserves them for each
+    trial's actor, so e.g. {"TPU": 4} trials queue against real chip
+    capacity."""
+    try:
+        trainable._tune_resources = dict(resources)
+        return trainable
+    except (AttributeError, TypeError):
+        def wrapped(*a, **kw):
+            return trainable(*a, **kw)
+        wrapped._tune_resources = dict(resources)
+        return wrapped
+
+
 class TuneConfig:
     def __init__(self, *, metric: str = "score", mode: str = "max",
                  num_samples: int = 1, max_concurrent_trials: int = 4,
@@ -258,7 +275,8 @@ class Tuner:
                     break
                 t = add_trial(cfg)
                 t.status = "RUNNING"
-                actor_cls = api.remote(num_cpus=1)(_TrialActor)
+                actor_cls = api.remote(**self._trial_actor_options())(
+                    _TrialActor)
                 t.actor = actor_cls.remote(t.trial_id, self.channel)
                 t.done_ref = t.actor.run.remote(self._trainable, t.config)
                 running.append(t)
@@ -306,6 +324,21 @@ class Tuner:
                 traceback.print_exc()
         self._write_experiment_state(trials)
         return ResultGrid(trials, tc.metric, tc.mode)
+
+    def _trial_actor_options(self) -> Dict[str, Any]:
+        """Per-trial resource request, from tune.with_resources(...) —
+        a TPU-marked trial reserves chips so trials gang-schedule against
+        real accelerator capacity instead of all racing num_cpus=1."""
+        res = dict(getattr(self._trainable, "_tune_resources", None)
+                   or {"CPU": 1})
+        num_cpus = res.pop("CPU", res.pop("cpu", 1))
+        num_tpus = res.pop("TPU", res.pop("tpu", 0))
+        opts: Dict[str, Any] = {"num_cpus": num_cpus}
+        if num_tpus:
+            opts["num_tpus"] = num_tpus
+        if res:
+            opts["resources"] = res
+        return opts
 
     def _write_experiment_state(self, trials: List[Trial]):
         state = [{"trial_id": t.trial_id, "config": t.config,

@@ -1,0 +1,118 @@
+"""Per-trial resources (tune.with_resources) + bracketed HyperBand."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+
+# ---------- HyperBandScheduler unit behavior ----------
+
+def test_hyperband_brackets_stagger_grace():
+    hb = HyperBandScheduler(grace_period=1, reduction_factor=2, max_t=16,
+                            brackets=3)
+    assert hb.bracket_grace == [1, 2, 4]
+    # round-robin assignment
+    assert hb.bracket_of("a") == 0
+    assert hb.bracket_of("b") == 1
+    assert hb.bracket_of("c") == 2
+    assert hb.bracket_of("d") == 0
+    assert hb.bracket_of("a") == 0  # sticky
+
+
+def test_hyperband_aggressive_bracket_stops_early_conservative_waits():
+    hb = HyperBandScheduler(grace_period=1, reduction_factor=2, max_t=16,
+                            brackets=2)
+    # trial A -> bracket 0 (rungs at 1,2,4,8), trial B -> bracket 1
+    # (rungs at 2,4,8)
+    assert hb.bracket_of("good") == 0
+    assert hb.bracket_of("slow") == 1
+    # seed bracket 0's first rung with a strong result
+    assert hb.on_result("good", 1, 10.0) == CONTINUE
+    # a weak trial in bracket 0 dies at iteration 1 ...
+    assert hb.bracket_of("weak0") == 0
+    assert hb.on_result("weak0", 1, 1.0) == STOP
+    # ... but the SAME weak value in bracket 1 survives iteration 1
+    # (bracket 1 has no rung there: longer runway)
+    assert hb.on_result("slow", 1, 1.0) == CONTINUE
+    # bracket 1's first cut is at iteration 2
+    assert hb.on_result("slow", 2, 1.0) == CONTINUE  # first in its rung
+
+
+def test_hyperband_max_t_stops():
+    hb = HyperBandScheduler(grace_period=1, reduction_factor=3, max_t=9,
+                            brackets=2)
+    assert hb.on_result("t", 9, 100.0) == STOP
+
+
+def test_hyperband_rung_cut_within_bracket():
+    hb = HyperBandScheduler(grace_period=2, reduction_factor=2, max_t=32,
+                            brackets=1)
+    rung_vals = [("t1", 5.0), ("t2", 9.0), ("t3", 1.0), ("t4", 8.0)]
+    decisions = {t: hb.on_result(t, 2, v) for t, v in rung_vals}
+    assert decisions["t3"] == STOP           # bottom of 4 with rf=2
+    assert decisions["t2"] == CONTINUE
+
+
+# ---------- with_resources end-to-end ----------
+
+@pytest.fixture(scope="module")
+def tpu2_rt():
+    rt = ray_tpu.init(num_cpus=8, num_tpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _Gauge:
+    def __init__(self):
+        self.cur = 0
+        self.peak = 0
+
+    def enter(self):
+        self.cur += 1
+        self.peak = max(self.peak, self.cur)
+        return self.peak
+
+    def leave(self):
+        self.cur -= 1
+
+    def peak_seen(self):
+        return self.peak
+
+
+def test_tpu_trials_respect_chip_capacity(tpu2_rt):
+    gauge = _Gauge.options(name="tune-gauge").remote()
+    ray_tpu.get(gauge.peak_seen.remote())  # ensure alive
+
+    def trial(config):
+        import ray_tpu as rtpu
+        g = rtpu.get_actor("tune-gauge")
+        rtpu.get(g.enter.remote())
+        time.sleep(0.6)
+        g.leave.remote()
+        tune.report({"score": config["x"], "done": True})
+
+    tuner = tune.Tuner(
+        tune.with_resources(trial, {"CPU": 1, "TPU": 1}),
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=4),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.get_best_result().metrics["score"] == 4
+    # only 2 chips exist -> never more than 2 TPU trials at once
+    peak = ray_tpu.get(gauge.peak_seen.remote())
+    assert peak <= 2, f"TPU reservation not enforced: peak={peak}"
+    ray_tpu.kill(gauge)
+
+
+def test_with_resources_survives_wrapping():
+    def f(config):
+        pass
+
+    g = tune.with_resources(f, {"TPU": 4})
+    assert g._tune_resources == {"TPU": 4}
