@@ -10,7 +10,8 @@ from .dqn import DQN, DQNConfig
 from .env import (BanditEnv, CartPole, Env, GridWorld, Space, VectorEnv,
                   make_env, register_env)
 from .env_runner import EnvRunner
-from .grpo import GRPOConfig, GRPOLearner, GRPOTrainer, group_relative_advantages
+from .grpo import (EngineSampler, GRPOConfig, GRPOLearner, GRPOTrainer,
+                   group_relative_advantages)
 from .learner import Learner, LearnerGroup
 from .ppo import PPO, PPOConfig
 from .replay import EpisodeReplayBuffer, ReplayBuffer
@@ -20,7 +21,8 @@ from .sample_batch import SampleBatch, compute_gae, concat_samples
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "GRPOConfig", "GRPOLearner", "GRPOTrainer", "group_relative_advantages",
+    "EngineSampler", "GRPOConfig", "GRPOLearner", "GRPOTrainer",
+    "group_relative_advantages",
     "Env", "Space", "CartPole", "GridWorld", "BanditEnv", "VectorEnv",
     "make_env", "register_env", "EnvRunner", "Learner", "LearnerGroup",
     "ReplayBuffer", "EpisodeReplayBuffer", "RLModule", "RLModuleSpec",

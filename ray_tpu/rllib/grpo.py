@@ -106,24 +106,96 @@ class GRPOLearner:
         return {k: float(v) for k, v in stats.items()}
 
 
+class EngineSampler:
+    """Group sampling through the serve LLM engine (SURVEY R7: "LLM
+    policy sampled via serve engine").
+
+    The engine gives GRPO the production decode path — slot KV cache,
+    continuous batching, pipelined host loop — instead of the naive
+    full-forward sampling loop, so one group of G completions costs G
+    cache-decode streams, not G*T full forwards. The trainer pushes the
+    freshly-updated policy params into the engine after every step."""
+
+    def __init__(self, model, params, cfg: GRPOConfig, *,
+                 eos_id: Optional[int] = None, max_seq_len: int = 512,
+                 engine_cfg=None):
+        from ..serve.llm import LLMEngine, LLMEngineConfig  # noqa: PLC0415
+        if engine_cfg is None:
+            engine_cfg = LLMEngineConfig(
+                max_slots=min(16, max(2, cfg.group_size)),
+                max_seq_len=max_seq_len,
+                prefill_buckets=(16, 32, 64, 128, 256),
+                max_new_tokens_default=cfg.max_new_tokens,
+                eos_token_id=eos_id)
+        self.cfg = cfg
+        self.engine = LLMEngine(model, params, engine_cfg)
+
+    def __call__(self, prompt_ids: Sequence[int], group: int) -> np.ndarray:
+        cfg = self.cfg
+        plen = len(prompt_ids)
+        if plen + cfg.max_new_tokens > self.engine.cfg.max_seq_len:
+            # The engine would silently clamp the budget and the trainer
+            # would then score/train phantom pad tokens — fail loud.
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({cfg.max_new_tokens})"
+                f" exceeds engine max_seq_len "
+                f"({self.engine.cfg.max_seq_len}); raise max_seq_len")
+        eos = self.engine.cfg.eos_token_id
+        rids = [self.engine.submit(prompt_ids,
+                                   max_new_tokens=cfg.max_new_tokens,
+                                   temperature=max(cfg.temperature, 1e-4))
+                for _ in range(group)]
+        toks = np.zeros((group, plen + cfg.max_new_tokens), np.int32)
+        toks[:, :plen] = np.asarray(prompt_ids, np.int32)
+        for g, rid in enumerate(rids):
+            comp = list(self.engine.stream(rid))
+            toks[g, plen:plen + len(comp)] = comp
+            if len(comp) < cfg.max_new_tokens and eos is not None:
+                # short (EOS-terminated) completion: pad with EOS so the
+                # trainer's mask ends at the true completion length
+                toks[g, plen + len(comp):] = eos
+        return toks
+
+    def set_params(self, params) -> None:
+        # Engine dispatches read self.params per call; swapping the pytree
+        # between steps is safe (in-flight steps keep the old tree).
+        self.engine.params = params
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+
 class GRPOTrainer:
     """Sample -> score -> group-normalize -> update loop for a causal LM.
 
-    model: flax module with .apply({'params': p}, tokens)->logits, or any
-    apply_fn via the functools path. reward_fn(prompt_ids, completion_ids)
-    -> float. For production serving-side sampling, plug the serve LLM
-    engine in as `sampler`.
+    Pass `model=` (a Llama-family module with the KV-cache apply
+    contract) and sampling defaults to the serve LLM engine
+    (EngineSampler); `apply_fn` is derived from it when omitted. A custom
+    `sampler(prompt_ids, group) -> [G, T] tokens` overrides; with neither
+    model nor sampler, a plain jitted full-forward loop samples.
+    reward_fn(prompt_ids, completion_ids) -> float.
     """
 
-    def __init__(self, apply_fn: Callable, params, reward_fn: Callable,
+    def __init__(self, apply_fn: Optional[Callable] = None, params=None,
+                 reward_fn: Callable = None,
                  cfg: Optional[GRPOConfig] = None, *,
                  eos_id: Optional[int] = None,
-                 sampler: Optional[Callable] = None):
+                 sampler: Optional[Callable] = None,
+                 model=None, max_seq_len: int = 512):
         self.cfg = cfg or GRPOConfig()
+        if apply_fn is None:
+            if model is None:
+                raise ValueError("need apply_fn or model")
+            def apply_fn(p, t, _m=model):  # noqa: E306
+                out = _m.apply({"params": p}, t)
+                return out[0] if isinstance(out, tuple) else out
         self.learner = GRPOLearner(apply_fn, params, self.cfg)
         self.ref_params = jax.device_get(params)   # frozen reference
         self.reward_fn = reward_fn
         self.eos_id = eos_id
+        if sampler is None and model is not None:
+            sampler = EngineSampler(model, params, self.cfg, eos_id=eos_id,
+                                    max_seq_len=max_seq_len)
         self.sampler = sampler
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._apply = self.learner._apply
@@ -193,5 +265,11 @@ class GRPOTrainer:
         stats: Dict[str, float] = {}
         for _ in range(cfg.num_epochs):
             stats = self.learner.update(batch)
+        if self.sampler is not None and hasattr(self.sampler, "set_params"):
+            self.sampler.set_params(self.params)  # next group: new policy
         return {"reward_mean": float(rewards.mean()),
                 "reward_std": float(rewards.std()), **stats}
+
+    def shutdown(self) -> None:
+        if self.sampler is not None and hasattr(self.sampler, "shutdown"):
+            self.sampler.shutdown()

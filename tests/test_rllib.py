@@ -182,6 +182,7 @@ def test_ppo_save_restore(tmp_path):
         np.testing.assert_allclose(x, y)
 
 
+@pytest.mark.slow
 def test_ppo_remote_runners(rt):
     config = (PPOConfig().environment(GridWorld)
               .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
@@ -217,6 +218,7 @@ def test_group_relative_advantages():
     assert np.allclose(adv[2:], 0.0)      # tie group: both zero
 
 
+@pytest.mark.slow
 def test_grpo_increases_rewarded_token():
     """Toy LM: reward completions containing token 3; after a few steps
     the policy should emit token 3 more often."""
@@ -256,3 +258,38 @@ def test_grpo_increases_rewarded_token():
         stats = trainer.step(prompts)
     after = frac_token3()
     assert after > before + 0.2, (before, after, stats)
+
+
+@pytest.mark.slow
+def test_grpo_samples_through_serve_engine_by_default():
+    """SURVEY R7: with `model=` the trainer samples via the serve LLM
+    engine (EngineSampler) and reward still improves with the engine in
+    the loop."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.rllib import EngineSampler
+
+    cfg_m = LlamaConfig(vocab_size=32, d_model=32, n_layers=1, n_heads=2,
+                        n_kv_heads=2, d_ff=64, max_seq_len=64)
+    model = Llama(cfg_m)
+    params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=4)
+
+    def reward(prompt, completion):
+        return float((np.asarray(completion) == 3).mean())
+
+    cfg = GRPOConfig(group_size=4, max_new_tokens=5, lr=5e-2, seed=0,
+                     kl_coeff=0.0, temperature=1.0)
+    trainer = GRPOTrainer(params=params, reward_fn=reward, cfg=cfg,
+                          model=model, max_seq_len=64)
+    try:
+        assert isinstance(trainer.sampler, EngineSampler)
+        first = None
+        stats = {}
+        for _ in range(6):
+            stats = trainer.step([[1, 2], [4, 5]])
+            if first is None:
+                first = stats["reward_mean"]
+        assert stats["reward_mean"] > first + 0.1, (first, stats)
+    finally:
+        trainer.shutdown()
