@@ -191,7 +191,12 @@ class Llama(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
                          dtype=cfg.dtype,
                          embedding_init=nn.initializers.normal(0.02))
-        x = embed(tokens)
+        from ..parallel.sharding import constrain_activations  # noqa: PLC0415
+        # Pin the residual stream to batch/sequence sharding right at the
+        # embed: the (vocab, d) table is (tp, fsdp)-sharded, and without
+        # the pin XLA carries the table's d-sharding into the hiddens and
+        # the backward re-shards them with a full rematerialization.
+        x = constrain_activations(embed(tokens))
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
         new_cache = []

@@ -36,7 +36,16 @@ DEFAULT_RULES: Sequence[Rule] = (
     (r".*experts.*(gate|up).*kernel$", P("ep", "fsdp", "tp")),
     (r".*experts.*down.*kernel$", P("ep", "tp", "fsdp")),
     (r".*router.*kernel$", P("fsdp", None)),
-    (r".*(token_embed|embed_tokens|wte)\b.*embedding$", P("tp", "fsdp")),
+    # Vocab-parallel embedding: vocab over (tp, fsdp), d_model UNSHARDED.
+    # Sharding d here looks free but isn't: the lookup gather propagates
+    # the table's d-sharding into the residual stream, which then fights
+    # the batch-sharded activations and XLA resolves it with an
+    # "involuntary full rematerialization" (replicate + repartition) in
+    # the backward. Vocab-only sharding keeps the gather a masked
+    # local-gather + all-reduce and (for tied embeddings) makes the LM
+    # head a standard megatron vocab-parallel matmul.
+    (r".*(token_embed|embed_tokens|wte)\b.*embedding$",
+     P(("tp", "fsdp"), None)),
     # untied output head: (d_model, vocab) column-parallel over vocab
     (r".*(lm_head|output_proj)\b.*kernel$", P("fsdp", "tp")),
     (r".*(wq|wk|wv|qkv|q_proj|k_proj|v_proj)\b.*kernel$", P("fsdp", "tp")),
@@ -138,6 +147,49 @@ def shard_pytree(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
     """device_put every leaf onto its NamedSharding (host -> mesh)."""
     shardings = sharding_tree(params, mesh, rules)
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+# ---- activation constraints ------------------------------------------------
+# Models can't take a Mesh argument without threading it through every
+# module, so the train step publishes the mesh here (trace-time only) and
+# models pin their residual-stream activations against it. Without the
+# pin, XLA propagates the embed table's fsdp sharding of d_model into the
+# hidden states and the backward pays an involuntary full
+# rematerialization re-sharding them against the batch-sharded residual.
+_ACTIVATION_MESH: "list[Optional[Mesh]]" = [None]
+
+
+class activation_mesh:
+    """Context manager: make `mesh` visible to constrain_activations
+    during tracing of a step function."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = _ACTIVATION_MESH[0]
+        _ACTIVATION_MESH[0] = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _ACTIVATION_MESH[0] = self._prev
+        return False
+
+
+def constrain_activations(x, *, seq_axis: Optional[str] = "sp"):
+    """Pin (B, S, D) activations to batch over (dp, fsdp), sequence over
+    sp, model dim replicated — the convention in this module's header. A
+    no-op outside an activation_mesh context (single-device, serve)."""
+    mesh = _ACTIVATION_MESH[0]
+    if mesh is None or getattr(x, "ndim", 0) < 3:
+        return x
+    data = tuple(a for a in ("dp", "fsdp")
+                 if mesh.shape.get(a, 1) > 1 and
+                 x.shape[0] % mesh.shape[a] == 0)
+    seq = (seq_axis if seq_axis and mesh.shape.get(seq_axis, 1) > 1
+           and x.shape[1] % mesh.shape[seq_axis] == 0 else None)
+    spec = P(data if data else None, seq)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def batch_sharding(mesh: Mesh, *, seq_axis: Optional[str] = "sp") -> NamedSharding:

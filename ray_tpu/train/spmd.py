@@ -84,8 +84,10 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     loss_fn = loss_fn or partial(next_token_loss, model.apply)
 
     def raw_step(state: TrainState, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch), has_aux=True)(state.params)
+        from ..parallel.sharding import activation_mesh  # noqa: PLC0415
+        with activation_mesh(mesh):
+            (loss, metrics), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch), has_aux=True)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
