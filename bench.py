@@ -217,7 +217,9 @@ def phase_serve() -> dict:
     params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=8)
     ecfg = LLMEngineConfig(max_slots=8, max_seq_len=512,
                            prefill_buckets=(64, 128, 256),
-                           max_new_tokens_default=32)
+                           max_new_tokens_default=32,
+                           pipeline_depth=int(os.environ.get(
+                               "RAY_TPU_BENCH_ENGINE_DEPTH", "10")))
     engine = LLMEngine(model, params, ecfg)
     rng = np.random.RandomState(0)
 

@@ -117,6 +117,7 @@ def test_pallas_lowering_failure_falls_back_to_xla(monkeypatch):
     assert attn_mod._PALLAS_LOWER_CACHE[key] is False
 
 
+@pytest.mark.slow
 def test_llama_pallas_impl_runs():
     from ray_tpu.models import Llama, LlamaConfig
     cfg = LlamaConfig.debug(attn_impl="pallas", dtype=jnp.float32)

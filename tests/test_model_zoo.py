@@ -10,6 +10,7 @@ from ray_tpu.models import (Mixtral, MixtralConfig, ViT, ViTConfig, CLIP,
 
 
 class TestMixtral:
+    @pytest.mark.slow
     def test_forward_shapes_and_aux(self):
         cfg = MixtralConfig.debug()
         model = Mixtral(cfg)
@@ -56,6 +57,7 @@ class TestMixtral:
 
 
 class TestViT:
+    @pytest.mark.slow
     def test_forward(self):
         cfg = ViTConfig.debug()
         model = ViT(cfg)
@@ -74,6 +76,7 @@ class TestViT:
 
 
 class TestCLIP:
+    @pytest.mark.slow
     def test_dual_encoder(self):
         cfg = CLIPConfig.debug()
         model = CLIP(cfg)
@@ -97,6 +100,7 @@ class TestSmallNets:
         out = model.apply({"params": params}, jnp.zeros((5, 4)))
         assert out.shape == (5, 3)
 
+    @pytest.mark.slow
     def test_resnet_lite(self):
         model = ResNetLite(num_classes=10, width=8, n_blocks=2)
         params = model.init_params(jax.random.PRNGKey(0))

@@ -24,6 +24,7 @@ def test_llama_forward_shapes(llama):
     assert cache is None
 
 
+@pytest.mark.slow
 def test_llama_decode_matches_full_forward(llama):
     cfg, model, params = llama
     rng = np.random.RandomState(0)

@@ -34,7 +34,11 @@ def test_partition_rules():
 
 
 @pytest.mark.parametrize("spec", [
-    MeshSpec(dp=8), MeshSpec(fsdp=8), MeshSpec(tp=8),
+    pytest.param(MeshSpec(dp=8), marks=pytest.mark.slow),
+    pytest.param(MeshSpec(fsdp=8), marks=pytest.mark.slow),
+    pytest.param(MeshSpec(tp=8), marks=pytest.mark.slow),
+    # the composite spec exercises every axis kind; it alone runs by
+    # default, the single-axis variants run in the full (-m "") suite
     MeshSpec(dp=2, fsdp=2, tp=2),
 ])
 def test_sharded_training_matches_single_device(spec):

@@ -325,6 +325,7 @@ def test_llm_engine_continuous_batching(tiny_llm):
     eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_engine_greedy_matches_uncached_forward():
     """Continuous-batching decode must equal a dense forward argmax.
 
@@ -354,6 +355,7 @@ def test_llm_engine_greedy_matches_uncached_forward():
     eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_serve_deployment(tiny_llm):
     from ray_tpu.serve.llm import build_llm_deployment
     model, params = tiny_llm

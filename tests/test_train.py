@@ -100,6 +100,7 @@ def test_trainer_failure_recovery(rt, tmp_path):
     assert result.checkpoint is not None
 
 
+@pytest.mark.slow
 def test_spmd_trainer_smoke(tmp_path):
     import jax.numpy as jnp
     from ray_tpu.train import SpmdTrainer, SpmdTrainerConfig

@@ -176,6 +176,7 @@ def test_tuner_stop_and_loggers(rt, tmp_path):
 
 # ---------- TPE search ----------
 
+@pytest.mark.slow
 def test_tpe_moves_toward_optimum(rt, tmp_path):
     """Quadratic bowl: after warmup, TPE suggestions should concentrate
     near the optimum x=0.7 better than uniform random."""
