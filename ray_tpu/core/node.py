@@ -40,9 +40,12 @@ FETCH_CHUNK = int(os.environ.get("RAY_TPU_FETCH_CHUNK", str(64 << 20)))
 
 class NodeAgent:
     def __init__(self, driver_address: str, *, num_cpus=None, num_tpus=None,
-                 resources=None, store_bytes: Optional[int] = None):
+                 resources=None, store_bytes: Optional[int] = None,
+                 node_id: Optional[str] = None):
         self.driver_address = driver_address
-        self.node_id = new_node_id()
+        # A pre-chosen id lets a launcher (core/autoscaler.py providers)
+        # correlate "the process I started" with "the node that joined".
+        self.node_id = node_id or new_node_id()
         # This host's store is its own arena: drop any inherited owner env
         # (tests run agents on the driver's host) and stamp our node id so
         # every ObjectLocation written here names this node.
@@ -58,6 +61,8 @@ class NodeAgent:
         self.resources = node_res
         self.labels = res_mod.detect_tpu_topology(
             int(node_res.get("TPU", 0)))
+        if os.environ.get("RAY_TPU_NODE_TYPE"):
+            self.labels["node-type"] = os.environ["RAY_TPU_NODE_TYPE"]
 
         self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_node_")
         self.log_dir = os.path.join(self._tmpdir, "logs")
@@ -205,12 +210,13 @@ def main() -> None:
     ap.add_argument("--resources", type=str, default=None,
                     help='extra custom resources as JSON, e.g. '
                          '\'{"my_res": 2}\'')
+    ap.add_argument("--node-id", type=str, default=None)
     args = ap.parse_args()
     import json
     extra = json.loads(args.resources) if args.resources else None
     agent = NodeAgent(args.driver_address, num_cpus=args.num_cpus,
                       num_tpus=args.num_tpus, resources=extra,
-                      store_bytes=args.store_bytes)
+                      store_bytes=args.store_bytes, node_id=args.node_id)
     print(f"ray_tpu node {agent.node_id} joined {args.driver_address}",
           flush=True)
     agent.run()
