@@ -219,7 +219,9 @@ def phase_serve() -> dict:
                            prefill_buckets=(64, 128, 256),
                            max_new_tokens_default=32,
                            pipeline_depth=int(os.environ.get(
-                               "RAY_TPU_BENCH_ENGINE_DEPTH", "10")))
+                               "RAY_TPU_BENCH_ENGINE_DEPTH", "10")),
+                           decode_block=int(os.environ.get(
+                               "RAY_TPU_BENCH_DECODE_BLOCK", "1")))
     engine = LLMEngine(model, params, ecfg)
     rng = np.random.RandomState(0)
 

@@ -49,10 +49,15 @@ class LLMEngineConfig:
     # Decode steps dispatched ahead of the host-side token fetch. The
     # steady-state step period is roughly fetch_latency/(depth+1) (each
     # iteration drains the entry dispatched `depth` steps ago), so depth
-    # trades termination lag (≤ depth discarded tokens per finished
-    # request) against hiding device->host latency — 66 ms over this
-    # image's TPU tunnel.
+    # trades termination lag (≤ depth*decode_block discarded tokens per
+    # finished request) against hiding device->host latency — 66 ms over
+    # this image's TPU tunnel.
     pipeline_depth: int = 10
+    # Decode steps fused into ONE dispatch via lax.scan: each dispatch
+    # emits decode_block tokens per slot, dividing per-token host work
+    # (dispatch + mask/rng prep + fetch) by the block size. 1 = the
+    # classic one-token step.
+    decode_block: int = 1
 
 
 @dataclass
@@ -115,6 +120,9 @@ class LLMEngine:
             self._prefill_impl, static_argnames=("pad_len",),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_block_jit = (
+            jax.jit(self._decode_block_impl, donate_argnums=(1,))
+            if cfg.decode_block > 1 else None)
         self._loop_thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
         self._loop_thread.start()
@@ -179,6 +187,25 @@ class LLMEngine:
         nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
         nxt = jnp.where(active_mask, nxt, last_tokens)
         return nxt, fixed
+
+    def _decode_block_impl(self, params, cache, last_tokens, active_mask,
+                           temps, rng_key):
+        """decode_block fused steps under one dispatch (lax.scan).
+        Returns (tokens (K, S), cache', last_tokens'). Host-side
+        termination decisions lag up to K-1 extra tokens; drain guards
+        discard them."""
+        jax = self._jax
+        keys = jax.random.split(rng_key, self.cfg.decode_block)
+
+        def body(carry, key):
+            cache, last = carry
+            nxt, cache = self._decode_impl(params, cache, last,
+                                           active_mask, temps, key)
+            return (cache, nxt), nxt
+
+        (cache, last), toks = jax.lax.scan(body, (cache, last_tokens),
+                                           keys)
+        return toks, cache, last
 
     # ---- public API -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
@@ -343,15 +370,17 @@ class LLMEngine:
                     >= self.cfg.max_seq_len):
                 self._release(req)
             return
-        self.stats["decode_steps"] += 1
-        for slot, req in payload:
-            if req.slot != slot or req.generated >= req.max_new_tokens:
-                continue  # finished/reused slot: lagged token, discard
-            self._emit(req, int(host[slot]))
-            full = (req.prompt.size + req.generated
-                    >= self.cfg.max_seq_len)
-            if req.generated >= req.max_new_tokens or full:
-                self._release(req)
+        rows = host if host.ndim == 2 else host[None, :]  # (K, S)
+        self.stats["decode_steps"] += rows.shape[0]
+        for row in rows:
+            for slot, req in payload:
+                if req.slot != slot or req.generated >= req.max_new_tokens:
+                    continue  # finished/reused slot: lagged, discard
+                self._emit(req, int(row[slot]))
+                full = (req.prompt.size + req.generated
+                        >= self.cfg.max_seq_len)
+                if req.generated >= req.max_new_tokens or full:
+                    self._release(req)
 
     def _engine_loop(self):
         inflight = collections.deque()
@@ -363,12 +392,18 @@ class LLMEngine:
                     self._rng_key, sub = self._jax.random.split(
                         self._rng_key)
                     snapshot = list(self._active.items())
-                    nxt, self._cache = self._decode_jit(
-                        self.params, self._cache, self._last_tokens,
-                        mask, temps, sub)
-                    self._last_tokens = nxt
-                    self._start_fetch(nxt)
-                    inflight.append(("decode", snapshot, nxt))
+                    if self._decode_block_jit is not None:
+                        toks, self._cache, last = self._decode_block_jit(
+                            self.params, self._cache, self._last_tokens,
+                            mask, temps, sub)
+                    else:
+                        toks, self._cache = self._decode_jit(
+                            self.params, self._cache, self._last_tokens,
+                            mask, temps, sub)
+                        last = toks
+                    self._last_tokens = last
+                    self._start_fetch(toks)
+                    inflight.append(("decode", snapshot, toks))
                 if not inflight:
                     time.sleep(0.002)
                     continue

@@ -77,10 +77,13 @@ def list_nodes(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
     rt = get_runtime()
     rows = []
     for ne in list(rt.gcs.nodes.values()):
+        ns = getattr(rt, "cluster_nodes", {}).get(ne.node_id)
         rows.append({
             "node_id": ne.node_id, "hostname": ne.hostname,
             "alive": ne.alive, "resources": dict(ne.resources),
+            "resources_available": dict(ns.avail) if ns else {},
             "labels": dict(ne.labels),
+            "is_driver": ne.node_id == rt.node_id,
         })
     return [r for r in rows if _match(r, filters)][:limit]
 
