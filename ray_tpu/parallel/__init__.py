@@ -9,10 +9,13 @@ from .mesh import MeshSpec, build_mesh, local_mesh_spec
 from .sharding import (ShardingRules, DEFAULT_RULES, partition_spec_for,
                        shard_pytree, batch_sharding)
 from .precision import Precision
+from .pipeline_train import (PipelinedLM, PipelinedLMState,
+                             make_pipeline_train_step)
 from .pipeline import (pipeline_apply, pipeline_reference,
                        stack_stage_params)
 
 __all__ = ["MeshSpec", "build_mesh", "local_mesh_spec", "ShardingRules",
            "DEFAULT_RULES", "partition_spec_for", "shard_pytree",
            "batch_sharding", "Precision", "pipeline_apply",
+           "PipelinedLM", "PipelinedLMState", "make_pipeline_train_step",
            "pipeline_reference", "stack_stage_params"]

@@ -1,0 +1,176 @@
+"""Pipeline-parallel TRAINING: a full train step over the `pp` mesh axis.
+
+Reference counterpart: the reference trains pipeline stages as separate
+torch processes with RPC send/recv and a hand-written 1F1B scheduler.
+TPU-first inversion: `pipeline_apply` (parallel/pipeline.py) is a pure,
+differentiable XLA program — `jax.grad` THROUGH the GPipe schedule IS
+the backward pipeline (the reverse-mode scan runs the ticks backwards,
+ppermute transposes to the reverse hop), so a pipelined train step is
+just loss(pipeline(x)) under value_and_grad inside one jit. No
+scheduler code exists for the backward at all.
+
+Layout: token embedding and the (tied) LM head live OUTSIDE the
+pipelined region (replicated); the decoder blocks carry params of shape
+(pp, layers_per_stage, ...) with the leading stage axis sharded over
+`pp`. dp/fsdp shard the microbatch rows inside the pipeline, so pp
+composes with data parallelism on one mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .pipeline import pipeline_apply, stack_stage_params
+from ..models.llama import LlamaBlock, LlamaConfig
+from ..ops.norms import rms_norm
+from ..ops.rotary import rope_frequencies
+
+
+@dataclasses.dataclass
+class PipelinedLMState:
+    step: jax.Array
+    params: Dict[str, Any]
+    opt_state: Any
+
+
+class PipelinedLM:
+    """Llama-family decoder whose block stack is pipelined over `pp`."""
+
+    def __init__(self, cfg: LlamaConfig, mesh: Mesh, *,
+                 n_microbatches: int):
+        pp = mesh.shape.get("pp", 1)
+        if cfg.n_layers % max(pp, 1):
+            raise ValueError(
+                f"n_layers ({cfg.n_layers}) must be divisible by the "
+                f"mesh's pp axis ({pp})")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = pp
+        self.layers_per_stage = cfg.n_layers // max(pp, 1)
+        self.n_microbatches = n_microbatches
+        self.block = LlamaBlock(cfg)
+        import flax.linen as nn  # noqa: PLC0415
+        self._embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                               dtype=cfg.dtype,
+                               embedding_init=nn.initializers.normal(0.02))
+
+    # ---- params -------------------------------------------------------
+    def init_params(self, rng, seq: int = 8) -> Dict[str, Any]:
+        cfg = self.cfg
+        dummy = jnp.zeros((1, seq, cfg.d_model), cfg.dtype)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        embed_params = self._embed.init(
+            jax.random.fold_in(rng, 0), jnp.zeros((1, seq), jnp.int32))
+        per_stage = []
+        for s in range(max(self.pp, 1)):
+            layer_params = [
+                self.block.init(jax.random.fold_in(rng, 1 + s * 1000 + l),
+                                dummy, cos, sin)["params"]
+                for l in range(self.layers_per_stage)]
+            per_stage.append(stack_stage_params(layer_params))
+        return {
+            "embed": embed_params["params"],
+            "stages": stack_stage_params(per_stage),
+            "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        }
+
+    def shardings(self, params) -> Dict[str, Any]:
+        """Stage stack sharded over pp on its leading axis; embed/head
+        replicated (they run outside the pipelined region)."""
+        out = jax.tree_util.tree_map(
+            lambda _: NamedSharding(self.mesh, P()), params)
+        out["stages"] = jax.tree_util.tree_map(
+            lambda _: NamedSharding(self.mesh, P("pp")), params["stages"])
+        return out
+
+    # ---- forward ------------------------------------------------------
+    def apply(self, params, tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        seq = tokens.shape[1]
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+
+        def stage_fn(stage_params, h):
+            def layer(h, lp):
+                h, _ = self.block.apply({"params": lp}, h, cos, sin)
+                return h, None
+            h, _ = jax.lax.scan(layer, h, stage_params)
+            return h
+
+        h = self._embed.apply({"params": params["embed"]}, tokens)
+        h = pipeline_apply(stage_fn, params["stages"], h, mesh=self.mesh,
+                           n_microbatches=self.n_microbatches)
+        h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+        table = params["embed"]["embedding"]
+        return jnp.einsum("bsd,vd->bsv", h, table.astype(h.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def make_pipeline_train_step(model: PipelinedLM,
+                             tx: optax.GradientTransformation,
+                             *, loss_fn: Optional[Callable] = None):
+    """init_fn(rng, example_batch) -> (state, step) like
+    train.spmd.make_train_step, but the forward/backward run the GPipe
+    schedule over the mesh's pp axis."""
+    mesh = model.mesh
+
+    def default_loss(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply(params, inputs)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -ll.mean()
+        return loss, {"loss": loss,
+                      "ppl": jnp.exp(jnp.minimum(loss, 20.0))}
+
+    loss_fn = loss_fn or default_loss
+
+    def raw_step(state: PipelinedLMState, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, batch)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return PipelinedLMState(step=state.step + 1, params=new_params,
+                                opt_state=new_opt), dict(metrics)
+
+    def init_fn(rng, example_batch):
+        params = model.init_params(rng)
+        psh = model.shardings(params)
+        params = jax.tree_util.tree_map(jax.device_put, params, psh)
+        opt_state = tx.init(params)
+
+        def opt_leaf_sharding(leaf):
+            shape = getattr(leaf, "shape", ())
+            # adam moments mirror their param's stage sharding
+            if shape and shape[:1] == (model.pp,) and model.pp > 1:
+                return NamedSharding(mesh, P("pp"))
+            return NamedSharding(mesh, P())
+
+        osh = jax.tree_util.tree_map(opt_leaf_sharding, opt_state)
+        state_sh = PipelinedLMState(
+            step=NamedSharding(mesh, P()), params=psh, opt_state=osh)
+        state = PipelinedLMState(step=jnp.zeros((), jnp.int32),
+                                 params=params, opt_state=opt_state)
+        bsh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), example_batch)
+        step = jax.jit(raw_step,
+                       in_shardings=(state_sh, bsh),
+                       out_shardings=(state_sh, None),
+                       donate_argnums=(0,))
+        return state, step
+
+    return init_fn
+
+
+jax.tree_util.register_pytree_node(
+    PipelinedLMState,
+    lambda s: ((s.step, s.params, s.opt_state), None),
+    lambda _, xs: PipelinedLMState(*xs))

@@ -1,0 +1,70 @@
+"""Pipeline-parallel training: grad-through-GPipe on the pp mesh axis."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import LlamaConfig
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.parallel.pipeline_train import (PipelinedLM,
+                                             make_pipeline_train_step)
+from ray_tpu.train import make_optimizer
+
+
+def _cfg(n_layers=4):
+    return LlamaConfig(vocab_size=128, d_model=32, n_layers=n_layers,
+                       n_heads=2, n_kv_heads=2, d_ff=64, max_seq_len=32,
+                       dtype=jnp.float32)
+
+
+def _batch(b=8, s=16):
+    rng = np.random.RandomState(0)
+    return {"tokens": jnp.asarray(rng.randint(0, 128, (b, s)), jnp.int32)}
+
+
+def test_pp4_matches_sequential_reference():
+    """GPipe is exact: the pp=4 pipelined forward equals running the
+    same stacked stages sequentially on one device."""
+    cfg = _cfg()
+    mesh4 = build_mesh(MeshSpec(pp=4), devices=jax.devices()[:4])
+    mesh1 = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    model4 = PipelinedLM(cfg, mesh4, n_microbatches=4)
+    model1 = PipelinedLM(cfg, mesh1, n_microbatches=4)
+    params = model4.init_params(jax.random.PRNGKey(0))
+    batch = _batch()
+    out4 = jax.jit(model4.apply)(params, batch["tokens"])
+    # pp=1 path uses pipeline_reference (plain sequential stages)
+    params1 = jax.tree_util.tree_map(
+        lambda x: x, params)  # same values, no pp sharding
+    out1 = jax.jit(model1.apply)(params1, batch["tokens"])
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(out1),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pp_train_step_learns_with_dp():
+    """Full pipelined train step on a pp=4 x dp=2 mesh: loss decreases
+    and params stay finite."""
+    cfg = _cfg()
+    mesh = build_mesh(MeshSpec(pp=4, dp=2), devices=jax.devices()[:8])
+    model = PipelinedLM(cfg, mesh, n_microbatches=4)
+    tx = make_optimizer("adamw", learning_rate=1e-2)
+    init_fn = make_pipeline_train_step(model, tx)
+    batch = _batch()
+    state, step = init_fn(jax.random.PRNGKey(0), batch)
+    state, m0 = step(state, batch)
+    first = float(m0["loss"])
+    for _ in range(10):
+        state, m = step(state, batch)
+    last = float(m["loss"])
+    assert np.isfinite(last)
+    assert last < first - 0.2, (first, last)
+    # stage params really live on the pp axis
+    leaf = jax.tree_util.tree_leaves(state.params["stages"])[0]
+    assert leaf.sharding.spec[0] == "pp"
+
+
+def test_pp_requires_divisible_layers():
+    cfg = _cfg(n_layers=3)
+    mesh = build_mesh(MeshSpec(pp=4), devices=jax.devices()[:4])
+    with pytest.raises(ValueError):
+        PipelinedLM(cfg, mesh, n_microbatches=2)
