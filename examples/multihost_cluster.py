@@ -31,6 +31,9 @@ def main():
         [sys.executable, "-m", "ray_tpu.core.node", rt.tcp_address,
          "--num-cpus", "2"], env=env)
     while len(rt.cluster_nodes) < 2:
+        if agent.poll() is not None:
+            raise RuntimeError(
+                f"node agent exited rc={agent.returncode} before joining")
         time.sleep(0.05)
     print("cluster resources:", json.dumps(ray_tpu.cluster_resources()))
 

@@ -182,6 +182,11 @@ class DriverRuntime:
             host = host or "127.0.0.1"
             self._tcp_listener = tcp_listener(host, int(port or 0))
             lh, lp = self._tcp_listener.getsockname()[:2]
+            if lh in ("0.0.0.0", "::"):
+                # Wildcard binds accept on every interface but the
+                # advertised address must be routable from other hosts.
+                from ..util.netutil import routable_ip  # noqa: PLC0415
+                lh = routable_ip()
             self.tcp_address = f"tcp://{lh}:{lp}"
         self.log_dir = os.path.join(self._tmpdir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)

@@ -27,7 +27,10 @@ from .utils import prepare_module, prepare_loader
 
 from . import adapters  # noqa: F401  (lazy torch/transformers inside)
 
+from .multihost import MultiHostSpmd
+
 __all__ = [
+    "MultiHostSpmd",
     "JaxBackendConfig", "setup_worker", "form_mesh", "detect_rank",
     "detect_world_size", "prepare_module", "prepare_loader", "adapters",
     "TrainState", "make_train_step", "next_token_loss", "SpmdStep",

@@ -1,0 +1,129 @@
+"""Multi-host SPMD: one JAX process per host, one global device mesh.
+
+Reference counterpart: ray.train.torch's NCCL world
+(python/ray/train/torch/config.py:_setup_torch_process_group — each
+worker joins a process group keyed by master address / world size /
+rank). TPU-first inversion: the world is `jax.distributed` — every host
+process sees its local chips, `jax.devices()` is the GLOBAL device
+list, and jitted programs span the whole mesh with XLA emitting the
+cross-host collectives (ICI within a slice, DCN across slices). No
+NCCL, no per-step communication code.
+
+The runtime provides the process fabric: one `_SpmdHost` actor per host
+(gang-placed via STRICT_SPREAD when `spread=True`); rank 0 picks the
+coordinator endpoint on its own host, every rank joins the world, then
+the gang runs the user's SPMD function. On this image the same
+machinery is exercised with multiple CPU processes (Gloo collectives) —
+the TPU pod deployment only changes the per-host device count.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class _SpmdHost:
+    """Actor hosting one rank of the jax.distributed world."""
+
+    def __init__(self, rank: int, world: int):
+        self.rank = rank
+        self.world = world
+
+    def pick_coordinator(self) -> str:
+        """Rank 0 chooses the coordinator endpoint ON ITS OWN HOST —
+        the jax.distributed coordinator service runs inside rank 0's
+        process, which with gang placement is NOT the driver's host."""
+        from ..util.netutil import free_port, routable_ip
+        return f"{routable_ip()}:{free_port()}"
+
+    def join(self, coordinator: str) -> Dict[str, int]:
+        """Blocks until every rank has joined the world. Called on all
+        ranks concurrently (each actor has its own process)."""
+        import jax
+        jax.distributed.initialize(coordinator, num_processes=self.world,
+                                   process_id=self.rank)
+        return {"rank": self.rank, "world": self.world,
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count()}
+
+    def run(self, fn: Callable, *args, **kwargs) -> Any:
+        return fn(self.rank, self.world, *args, **kwargs)
+
+
+class MultiHostSpmd:
+    """A gang of per-host JAX processes forming one distributed world.
+
+    num_hosts: processes (= hosts on a pod; may share a host in tests).
+    resources_per_host: what each rank's actor reserves (e.g.
+        {"TPU": 4} so each rank owns its host's chips).
+    env_per_host: env applied before the rank's first jax import —
+        platform selection, XLA flags (CPU tests pass JAX_PLATFORMS=cpu
+        + --xla_force_host_platform_device_count=N).
+    spread: gang the ranks one-per-node via a STRICT_SPREAD placement
+        group (requires that many alive nodes).
+    """
+
+    def __init__(self, num_hosts: int, *,
+                 resources_per_host: Optional[Dict[str, float]] = None,
+                 env_per_host: Optional[Dict[str, str]] = None,
+                 spread: bool = False):
+        import ray_tpu
+        from ..api import remote
+        self._ray = ray_tpu
+        self.num_hosts = num_hosts
+        self._pg = None
+        if spread:
+            from ..util.placement_group import placement_group
+            self._pg = placement_group(
+                [dict(resources_per_host or {"CPU": 1})] * num_hosts,
+                strategy="STRICT_SPREAD")
+            if not self._pg.wait(60):
+                raise RuntimeError(
+                    f"could not gang {num_hosts} hosts (placement group "
+                    "not ready)")
+        opts: Dict[str, Any] = {}
+        res = dict(resources_per_host or {})
+        opts["num_cpus"] = res.pop("CPU", 1)
+        tpus = res.pop("TPU", 0)
+        if tpus:
+            opts["num_tpus"] = tpus
+        if res:
+            opts["resources"] = res
+        if env_per_host:
+            opts["runtime_env"] = {"env_vars": dict(env_per_host)}
+        actor_cls = remote(**opts)(_SpmdHost)
+        self.hosts: List[Any] = []
+        for rank in range(num_hosts):
+            a = actor_cls
+            if self._pg is not None:
+                a = actor_cls.options(placement_group=self._pg,
+                                      bundle_index=rank)
+            self.hosts.append(a.remote(rank, num_hosts))
+        # Rank 0 picks the coordinator endpoint on its own host, then
+        # every rank joins concurrently (the join barrier resolves once
+        # all are in). Failures surface through these gets.
+        self.coordinator = ray_tpu.get(
+            self.hosts[0].pick_coordinator.remote(), timeout=120)
+        descs = ray_tpu.get(
+            [h.join.remote(self.coordinator) for h in self.hosts],
+            timeout=180)
+        self.world_devices = descs[0]["global_devices"]
+
+    def run(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Execute fn(rank, world, *args) on every rank; returns results
+        ordered by rank."""
+        return self._ray.get(
+            [h.run.remote(fn, *args, **kwargs) for h in self.hosts],
+            timeout=600)
+
+    def shutdown(self) -> None:
+        for h in self.hosts:
+            try:
+                self._ray.kill(h)
+            except Exception:
+                pass
+        if self._pg is not None:
+            from ..util.placement_group import remove_placement_group
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass

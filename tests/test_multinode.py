@@ -21,7 +21,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(scope="module")
 def cluster():
+    ray_tpu.shutdown()   # a leaked runtime would lack our TCP listener
     rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+    assert rt.tcp_address is not None
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO, os.path.dirname(os.path.abspath(__file__)),

@@ -17,6 +17,9 @@ def _serve_instance():
     ray_tpu.init()
     yield
     serve.shutdown()
+    # release the runtime too: a leaked runtime makes a later module's
+    # init() silently reuse it (wrong store size / no TCP listener)
+    ray_tpu.shutdown()
 
 
 @pytest.fixture(autouse=True)
@@ -381,3 +384,31 @@ def test_llm_serve_deployment(tiny_llm):
     assert len(toks) == 4
     stats = h.stats.remote().result()
     assert stats["prefills"] >= 2
+
+
+@pytest.mark.parametrize("block", [3])
+def test_decode_block_matches_single_step(block):
+    """Fused K-step decode (lax.scan) must be token-identical to the
+    one-step path for greedy decoding, across ragged budgets, slot
+    reuse, and the max_seq_len boundary."""
+    import jax
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+
+    cfg = LlamaConfig(vocab_size=96, d_model=32, n_layers=2, n_heads=2,
+                      n_kv_heads=2, d_ff=64, max_seq_len=32)
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=4)
+    prompts = [[1, 2, 3], [5] * 28, [9, 8], [4, 4, 4, 4]]  # one near cap
+    budgets = [7, 10, 1, 5]
+    outs = {}
+    for blk in (1, block):
+        eng = LLMEngine(model, params, LLMEngineConfig(
+            max_slots=2, max_seq_len=32, prefill_buckets=(8, 16, 32),
+            max_new_tokens_default=8, decode_block=blk, pipeline_depth=2))
+        outs[blk] = [eng.generate_sync(p, max_new_tokens=b)
+                     for p, b in zip(prompts, budgets)]
+        eng.shutdown()
+    assert outs[1] == outs[block], (outs[1], outs[block])
+    # near-cap prompt: budget clamped to max_seq_len - len(prompt)
+    assert len(outs[block][1]) == 32 - 28
