@@ -5,7 +5,8 @@ core runtime, and a device loader that prefetches batches into TPU HBM.
 """
 from .block import Block
 from .dataset import (Dataset, from_items, from_blocks, from_numpy, range_,
-                      read_text, read_jsonl, read_csv, read_npy, AggregateFn)
+                      read_text, read_jsonl, read_csv, read_npy,
+                      read_parquet, AggregateFn)
 from .device_loader import device_put_iterator
 from . import preprocessors
 
@@ -14,5 +15,5 @@ range = range_  # noqa: A001
 
 __all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
            "range", "range_", "read_text", "read_jsonl", "read_csv",
-           "read_npy", "AggregateFn", "device_put_iterator",
+           "read_npy", "read_parquet", "AggregateFn", "device_put_iterator",
            "preprocessors"]

@@ -1,0 +1,111 @@
+"""Node-death handling: dead agents fail their work, free their
+resources, and put placement groups back in line.
+
+Reference parity: gcs_node_manager.cc node-death propagation +
+gcs_placement_group_scheduler.cc rescheduling.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _start_agent(rt, extra_res):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, os.path.dirname(os.path.abspath(__file__)),
+         *env.get("PYTHONPATH", "").split(os.pathsep)])
+    from ray_tpu.util.jaxenv import subprocess_env_cpu
+    subprocess_env_cpu(env)
+    before = set(rt.cluster_nodes)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node", rt.tcp_address,
+         "--num-cpus", "2", "--resources", json.dumps(extra_res)],
+        env=env, cwd=REPO)
+    deadline = time.time() + 30
+    while time.time() < deadline and len(rt.cluster_nodes) == len(before):
+        time.sleep(0.05)
+    new = set(rt.cluster_nodes) - before
+    assert new, "agent failed to register"
+    return proc, new.pop()
+
+
+@pytest.fixture()
+def failover_cluster():
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(max_retries=0)
+def _stall(sec):
+    time.sleep(sec)
+    return "done"
+
+
+def test_node_death_fails_running_task_and_frees_capacity(failover_cluster):
+    rt = failover_cluster
+    proc, nid = _start_agent(rt, {"doomed": 1.0})
+    ref = _stall.options(resources={"doomed": 1}).remote(60)
+    # wait until it is actually running on the doomed node
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        te = next((t for t in rt.gcs.tasks.values()), None)
+        if te is not None and te.state == "RUNNING":
+            break
+        time.sleep(0.05)
+    proc.kill()
+    proc.wait(timeout=10)
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=60)
+    assert "died" in str(ei.value) or "crashed" in str(
+        ei.value).lower() or "WorkerCrashed" in type(ei.value).__name__
+    # the dead node's capacity is gone from cluster totals
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            ray_tpu.cluster_resources().get("doomed"):
+        time.sleep(0.1)
+    assert "doomed" not in ray_tpu.cluster_resources()
+    assert not rt.cluster_nodes[nid].alive
+
+
+def test_pg_reschedules_onto_replacement_node(failover_cluster):
+    rt = failover_cluster
+    from ray_tpu.util.placement_group import placement_group
+    proc1, nid1 = _start_agent(rt, {"gang": 1.0})
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    state = rt.placement_groups[pg.pg_id]
+    assert nid1 in state.bundle_nodes
+    proc1.kill()
+    proc1.wait(timeout=10)
+    # pg drops back to PENDING once the node is declared dead
+    deadline = time.time() + 15
+    while time.time() < deadline and state.state == "CREATED":
+        time.sleep(0.1)
+    assert state.state == "PENDING"
+    # a replacement host arrives; the pg re-reserves and is usable again
+    proc2, nid2 = _start_agent(rt, {"gang": 1.0})
+    deadline = time.time() + 30
+    while time.time() < deadline and state.state != "CREATED":
+        time.sleep(0.1)
+    assert state.state == "CREATED"
+    assert nid2 in state.bundle_nodes and nid1 not in state.bundle_nodes
+
+    @ray_tpu.remote
+    def where():
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    nodes = ray_tpu.get(
+        [where.options(placement_group=pg, bundle_index=i).remote()
+         for i in range(2)], timeout=60)
+    assert set(nodes) == {rt.node_id, nid2}
+    proc2.terminate()
