@@ -45,6 +45,12 @@ KERNELS_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_KERNELS_TIMEOUT",
 TRAIN_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TRAIN_TIMEOUT", 1500))
 SERVE_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_SERVE_TIMEOUT", 900))
 ATTEMPTS = int(os.environ.get("RAY_TPU_BENCH_ATTEMPTS", 2))
+# Hard ceiling across ALL phases: when the TPU tunnel is wedged, every
+# phase would otherwise burn its full per-attempt timeout (observed: the
+# tunnel can hang jax init for hours). Remaining phases are skipped and
+# the final JSON still reports whatever completed.
+TOTAL_BUDGET_S = float(os.environ.get("RAY_TPU_BENCH_TOTAL_BUDGET", 3600))
+_T0 = time.time()
 
 
 def _progress(msg: str) -> None:
@@ -337,6 +343,14 @@ def _run_phase(phase: str, timeout_s: float) -> "tuple[dict | None, str]":
     (result dict or None, error string)."""
     err = ""
     for attempt in range(1, ATTEMPTS + 1):
+        remaining = TOTAL_BUDGET_S - (time.time() - _T0)
+        if remaining < 60:
+            note = (f"{phase} stopped: total bench budget "
+                    f"({TOTAL_BUDGET_S:.0f}s) exhausted")
+            # keep evidence from attempts that DID run (e.g. a timeout
+            # pointing at a wedged tunnel) instead of overwriting it
+            return None, f"{err}; {note}" if err else note
+        timeout_s = min(timeout_s, remaining)
         if attempt > 1:
             time.sleep(10)  # TPU tunnel is single-holder; let it settle
         _progress(f"phase {phase}: attempt {attempt}/{ATTEMPTS} "

@@ -157,6 +157,21 @@ def main(argv=None):
     jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
     jsp.set_defaults(fn=cmd_job)
 
+    npp = sub.add_parser(
+        "node", help="join this host to a driver as a node agent "
+                     "(alias of python -m ray_tpu.core.node)",
+        add_help=False)
+    del npp  # listed in top-level help; dispatch happens below
+
+    # `node` forwards EVERYTHING (flags in any order, --help included)
+    # to the agent's own parser; parse_known_args would eat its flags.
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "node":
+        from .core import node as node_mod
+        sys.argv = ["ray_tpu node", *argv[1:]]
+        node_mod.main()
+        return
+
     args = p.parse_args(argv)
     args.fn(args)
 
