@@ -163,12 +163,23 @@ def main(argv=None):
         add_help=False)
     del npp  # listed in top-level help; dispatch happens below
 
-    # `node` forwards EVERYTHING (flags in any order, --help included)
-    # to the agent's own parser; parse_known_args would eat its flags.
+    # `node` forwards EVERYTHING after it (flags in any order, --help
+    # included) to the agent's own parser; parse_known_args would eat its
+    # flags. The only global option (--address) may precede it.
     argv = sys.argv[1:] if argv is None else list(argv)
-    if argv and argv[0] == "node":
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok == "--address":
+            i += 2
+            continue
+        if tok.startswith("--address="):
+            i += 1
+            continue
+        break
+    if i < len(argv) and argv[i] == "node":
         from .core import node as node_mod
-        sys.argv = ["ray_tpu node", *argv[1:]]
+        sys.argv = ["ray_tpu node", *argv[i + 1:]]
         node_mod.main()
         return
 

@@ -58,6 +58,11 @@ class LLMEngineConfig:
     # (dispatch + mask/rng prep + fetch) by the block size. 1 = the
     # classic one-token step.
     decode_block: int = 1
+    # Waiting prompts that share a length bucket prefill TOGETHER in one
+    # jitted call of up to this many rows (padded to a power of two via a
+    # scratch cache slot) — one dispatch and one model pass instead of
+    # per-prompt calls. 1 disables batching.
+    max_prefill_batch: int = 4
 
 
 @dataclass
@@ -96,12 +101,20 @@ class LLMEngine:
         if cfg.eos_token_id is None:
             cfg.eos_token_id = getattr(mcfg, "eos_token_id", None)
         S, L = cfg.max_slots, cfg.max_seq_len
+        # +1 scratch slot when prefill batching is on: padding rows of a
+        # batched prefill write their KV there; it is never admitted, so
+        # its garbage never decodes. With batching off there is no
+        # scratch row (decode pays no extra-slot work).
+        self._n_slots = S + 1 if cfg.max_prefill_batch > 1 else S
+        self._scratch_slot = S
         self._cache = [
-            (jnp.zeros((S, L, mcfg.n_kv_heads, mcfg.head_dim), mcfg.dtype),
-             jnp.zeros((S, L, mcfg.n_kv_heads, mcfg.head_dim), mcfg.dtype),
-             jnp.zeros((S,), jnp.int32))
+            (jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
+                        mcfg.head_dim), mcfg.dtype),
+             jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
+                        mcfg.head_dim), mcfg.dtype),
+             jnp.zeros((self._n_slots,), jnp.int32))
             for _ in range(mcfg.n_layers)]
-        self._last_tokens = jnp.zeros((S,), jnp.int32)
+        self._last_tokens = jnp.zeros((self._n_slots,), jnp.int32)
         self._free_slots = list(range(S))
         self._active: Dict[int, _Request] = {}
         self._waiting: "queue_mod.Queue[_Request]" = queue_mod.Queue()
@@ -118,6 +131,9 @@ class LLMEngine:
 
         self._prefill_jit = jax.jit(
             self._prefill_impl, static_argnames=("pad_len",),
+            donate_argnums=(1,))
+        self._prefill_batch_jit = jax.jit(
+            self._prefill_batch_impl, static_argnames=("pad_len",),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._decode_block_jit = (
@@ -160,6 +176,43 @@ class LLMEngine:
             rng_key, last / jnp.maximum(temp, 1e-6))
         tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
         return tok, out_cache
+
+    def _prefill_batch_impl(self, params, cache, tokens, slots, true_lens,
+                            temps, rng_key, pad_len: int):
+        """Prefill G prompts of one length bucket in a single model pass.
+        tokens: (G, pad_len); slots/true_lens/temps: (G,). Padding rows
+        target the scratch slot. Returns (tokens (G,) int32, cache')."""
+        jnp = self._jnp
+        jax = self._jax
+        g = tokens.shape[0]
+        mcfg = self.model.cfg
+        small = [(jnp.zeros((g, pad_len, mcfg.n_kv_heads, mcfg.head_dim),
+                            mcfg.dtype),
+                  jnp.zeros((g, pad_len, mcfg.n_kv_heads, mcfg.head_dim),
+                            mcfg.dtype),
+                  jnp.zeros((g,), jnp.int32))
+                 for _ in range(mcfg.n_layers)]
+        positions = jnp.broadcast_to(jnp.arange(pad_len)[None, :],
+                                     (g, pad_len))
+        logits, new_small = self.model.apply(
+            {"params": params}, tokens, cache=small, positions=positions)
+        out_cache = []
+        for (ck, cv, lens), (k1, v1, _l1) in zip(cache, new_small):
+            # scatter each row's KV into its slot (duplicate scratch
+            # indices from padding rows are harmless: slot is inert)
+            ck = ck.at[slots, :pad_len].set(k1)
+            cv = cv.at[slots, :pad_len].set(v1)
+            lens = lens.at[slots].set(true_lens)
+            out_cache.append((ck, cv, lens))
+        last = logits[jnp.arange(g), true_lens - 1]          # (G, V)
+        if self.cfg.top_k and self.cfg.top_k > 0:
+            kth = jnp.sort(last, axis=-1)[:, -self.cfg.top_k][:, None]
+            last = jnp.where(last < kth, -jnp.inf, last)
+        greedy = jnp.argmax(last, axis=-1)
+        sampled = jax.random.categorical(
+            rng_key, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        return toks, out_cache
 
     def _decode_impl(self, params, cache, last_tokens, active_mask,
                      temps, rng_key):
@@ -268,11 +321,12 @@ class LLMEngine:
                          f"bucket {self.cfg.prefill_buckets[-1]}")
 
     def _admit_all(self, inflight) -> None:
-        """Dispatch a prefill for every waiting request that can get a
-        slot — back to back, NO host syncs. The sampled first tokens
-        drain through the same pipeline as decode steps, preserving
-        per-request emission order."""
-        jnp = self._jnp
+        """Dispatch prefills for every waiting request that can get a
+        slot — back to back, NO host syncs. Requests sharing a length
+        bucket prefill TOGETHER (up to max_prefill_batch per call); the
+        sampled first tokens drain through the same pipeline as decode
+        steps, preserving per-request emission order."""
+        taken: List[tuple] = []
         while self._free_slots:
             try:
                 req = self._waiting.get_nowait()
@@ -280,29 +334,71 @@ class LLMEngine:
                 break
             slot = self._free_slots.pop()
             req.slot = slot
-            try:
-                pad_len = self._bucket(req.prompt.size)
+            taken.append((self._bucket(req.prompt.size), req, slot))
+        if not taken:
+            return
+        groups: Dict[int, List[tuple]] = {}
+        for pad_len, req, slot in taken:
+            groups.setdefault(pad_len, []).append((req, slot))
+        cap = max(1, self.cfg.max_prefill_batch)
+        for pad_len, members in groups.items():
+            for i in range(0, len(members), cap):
+                self._dispatch_prefill(inflight, pad_len,
+                                       members[i:i + cap])
+
+    def _dispatch_prefill(self, inflight, pad_len: int, members) -> None:
+        """One prefill call for `members` = [(req, slot), ...] of a
+        shared bucket; group size pads to a power of two (scratch slot
+        rows) so compile count stays O(buckets * log2(cap))."""
+        jnp = self._jnp
+        g_real = len(members)
+        try:
+            self._rng_key, sub = self._jax.random.split(self._rng_key)
+            if g_real == 1 and self.cfg.max_prefill_batch <= 1:
+                req, slot = members[0]
                 tokens = np.zeros((1, pad_len), np.int32)
                 tokens[0, :req.prompt.size] = req.prompt
-                self._rng_key, sub = self._jax.random.split(self._rng_key)
                 tok_dev, self._cache = self._prefill_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.int32(slot), jnp.int32(req.prompt.size),
                     jnp.float32(req.temperature), sub, pad_len=pad_len)
-            except BaseException as e:  # noqa: BLE001
+                toks_dev = tok_dev[None]
+            else:
+                g = 1
+                while g < g_real:
+                    g *= 2
+                tokens = np.zeros((g, pad_len), np.int32)
+                slots = np.full((g,), self._scratch_slot, np.int32)
+                lens = np.ones((g,), np.int32)
+                temps = np.zeros((g,), np.float32)
+                for i, (req, slot) in enumerate(members):
+                    tokens[i, :req.prompt.size] = req.prompt
+                    slots[i] = slot
+                    lens[i] = req.prompt.size
+                    temps[i] = req.temperature
+                toks_dev, self._cache = self._prefill_batch_jit(
+                    self.params, self._cache, jnp.asarray(tokens),
+                    jnp.asarray(slots), jnp.asarray(lens),
+                    jnp.asarray(temps), sub, pad_len=pad_len)
+                toks_dev = toks_dev[:g_real]
+            real_slots = jnp.asarray(
+                np.asarray([s for _, s in members], np.int32))
+            self._last_tokens = self._last_tokens.at[real_slots].set(
+                toks_dev)
+        except BaseException as e:  # noqa: BLE001
+            for req, slot in members:
                 self._free_slots.append(slot)
                 req.slot = -1
                 req.out_queue.put(("error", e))
                 req.out_queue.put(_END)
-                continue
-            self.stats["prefills"] += 1
+            return
+        self.stats["prefills"] += g_real
+        for req, slot in members:
             self._active[slot] = req
-            self._mask_dirty = True
-            # the new sequence's last token feeds the next decode step —
-            # as a device scalar, so nothing syncs here
-            self._last_tokens = self._last_tokens.at[slot].set(tok_dev)
-            self._start_fetch(tok_dev)
-            inflight.append(("prefill", req, tok_dev))
+        self._mask_dirty = True
+        self._start_fetch(toks_dev)
+        inflight.append(("prefill_batch", [r for r, _ in members],
+                         toks_dev))
 
     @staticmethod
     def _start_fetch(arr):
@@ -333,7 +429,7 @@ class LLMEngine:
         """(active_mask, temps) as device arrays, rebuilt only when the
         active set changed — not every step."""
         if self._mask_dirty or self._mask_dev is None:
-            S = self.cfg.max_slots
+            S = self._n_slots
             mask = np.zeros((S,), bool)
             temps = np.zeros((S,), np.float32)
             for slot, req in self._active.items():
@@ -353,22 +449,24 @@ class LLMEngine:
         try:
             host = np.asarray(arr)
         except BaseException as e:  # noqa: BLE001  device-side failure
-            targets = ([payload] if kind == "prefill"
+            targets = (list(payload) if kind == "prefill_batch"
                        else [r for _, r in payload])
             for req in targets:
                 if req.slot >= 0:
                     req.out_queue.put(("error", e))
                     self._release(req)
             return
-        if kind == "prefill":
-            req = payload
-            if req.slot < 0:
-                return
-            self._emit(req, int(host))
-            if (req.generated >= req.max_new_tokens
-                    or req.prompt.size + req.generated
-                    >= self.cfg.max_seq_len):
-                self._release(req)
+        if kind == "prefill_batch":
+            reqs = payload
+            firsts = host.reshape(-1)
+            for i, req in enumerate(reqs):
+                if req.slot < 0:
+                    continue
+                self._emit(req, int(firsts[i]))
+                if (req.generated >= req.max_new_tokens
+                        or req.prompt.size + req.generated
+                        >= self.cfg.max_seq_len):
+                    self._release(req)
             return
         rows = host if host.ndim == 2 else host[None, :]  # (K, S)
         self.stats["decode_steps"] += rows.shape[0]
