@@ -15,7 +15,8 @@ import importlib
 
 from .api import (init, shutdown, is_initialized, remote, get, put, wait,
                   kill, cancel, get_actor, free, cluster_resources,
-                  available_resources, get_runtime_context)
+                  available_resources, get_runtime_context, method, nodes,
+                  timeline, get_tpu_ids)
 from .core.object_ref import ObjectRef
 from .core.actor import ActorHandle
 from . import exceptions
@@ -38,6 +39,7 @@ def __getattr__(name):
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "free", "cluster_resources",
-    "available_resources", "get_runtime_context", "ObjectRef", "ActorHandle",
+    "available_resources", "get_runtime_context", "method", "nodes",
+    "timeline", "get_tpu_ids", "ObjectRef", "ActorHandle",
     "exceptions", "__version__", *_LAZY_SUBMODULES,
 ]

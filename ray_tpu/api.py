@@ -76,6 +76,20 @@ def _auto_put_large_args(rt, args, kwargs):
     return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
 
 
+def _resolve_pg_strategy(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """A PlacementGroupSchedulingStrategy is sugar for the
+    placement_group/bundle_index options (reference parity: ray accepts
+    either form)."""
+    from .core.scheduling import PlacementGroupSchedulingStrategy
+    strat = opts.get("scheduling_strategy")
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        opts = dict(opts)
+        opts["placement_group"] = strat.placement_group
+        opts["bundle_index"] = strat.placement_group_bundle_index
+        opts["scheduling_strategy"] = None
+    return opts
+
+
 class RemoteFunction:
     def __init__(self, fn, *, num_cpus=None, num_tpus=None, resources=None,
                  num_returns=1, max_retries=0, retry_exceptions=False,
@@ -108,7 +122,7 @@ class RemoteFunction:
             self._func_bytes = serialization.dumps_call(self._fn)
             self._func_id = hashlib.sha1(self._func_bytes).hexdigest()
         args, kwargs = _auto_put_large_args(rt, args, kwargs)
-        o = self._opts
+        o = _resolve_pg_strategy(self._opts)
         pg = o.get("placement_group")
         spec = make_task_spec(
             self._fn, args, kwargs,
@@ -145,7 +159,7 @@ def remote(*args, **kwargs):
             allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
                        "max_concurrency", "name", "namespace", "lifetime",
                        "runtime_env", "placement_group", "bundle_index",
-                       "get_if_exists")
+                       "scheduling_strategy", "get_if_exists")
             return ActorClass(target,
                               **{k: v for k, v in opts.items()
                                  if k in allowed})
@@ -202,8 +216,12 @@ def get_actor(name: str, namespace: Optional[str] = None, *,
     while True:
         if rt.is_driver:
             aid = rt.gcs.lookup_named_actor(ns, name)
-            found = None if aid is None \
-                else (aid, rt.gcs.actors[aid].class_name)
+            if aid is None:
+                found = None
+            else:
+                ae = rt.gcs.actors[aid]
+                found = (aid, ae.class_name,
+                         getattr(ae.create_spec, "method_opts", {}) or {})
         else:
             # Workers resolve names through the driver's GCS. A worker has
             # no namespace attribute: send the explicit namespace or None,
@@ -211,7 +229,9 @@ def get_actor(name: str, namespace: Optional[str] = None, *,
             found = rt.report_sync("sys.lookup_actor", (namespace, name),
                                    timeout=5.0)
         if found is not None:
-            return ActorHandle(found[0], found[1])
+            return ActorHandle(found[0], found[1],
+                               method_opts=found[2] if len(found) > 2
+                               else {})
         if _time.time() > deadline:
             raise ValueError(f"no actor named {name!r} in namespace {ns!r}")
         _time.sleep(0.01)
@@ -219,6 +239,45 @@ def get_actor(name: str, namespace: Optional[str] = None, *,
 
 def free(refs: Sequence[ObjectRef]):
     runtime_mod.get_runtime().free(list(refs))
+
+
+def method(**opts):
+    """Per-method actor defaults, e.g. `@ray_tpu.method(num_returns=2)`.
+
+    Reference parity: ray.method (python/ray/actor.py) — the declared
+    options become the defaults every time the method is invoked through
+    an ActorHandle (still overridable per call with `.options(...)`).
+    """
+    allowed = {"num_returns"}
+    bad = set(opts) - allowed
+    if bad:
+        raise ValueError(f"unsupported @method option(s): {sorted(bad)}")
+
+    def decorate(fn):
+        fn.__ray_tpu_method_opts__ = dict(opts)
+        return fn
+
+    return decorate
+
+
+def nodes():
+    """Cluster node table. Reference parity: ray.nodes()."""
+    from .util.state import list_nodes  # noqa: PLC0415
+    return list_nodes(limit=10_000)
+
+
+def timeline(filename: Optional[str] = None):
+    """Export task/actor spans as chrome://tracing JSON.
+    Reference parity: ray.timeline()."""
+    from .observability.timeline import timeline as _timeline  # noqa: PLC0415
+    return _timeline(filename)
+
+
+def get_tpu_ids():
+    """TPU chip indices reserved for the current task/actor (analog of
+    ray.get_gpu_ids; chips are indices into the host's jax TPU devices)."""
+    rt = runtime_mod.get_runtime()
+    return list(getattr(rt, "current_tpu_ids", []) or [])
 
 
 def cluster_resources() -> Dict[str, float]:

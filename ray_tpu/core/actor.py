@@ -38,9 +38,13 @@ class ActorMethod:
 class ActorHandle:
     """Serializable handle to a running actor (pass freely between tasks)."""
 
-    def __init__(self, actor_id: str, class_name: str = ""):
+    def __init__(self, actor_id: str, class_name: str = "",
+                 method_opts: Optional[Dict[str, Dict[str, Any]]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
+        # per-method defaults declared with @ray_tpu.method(...) on the
+        # class (reference: python/ray/actor.py ray.method decorator)
+        self._method_opts = method_opts or {}
 
     @property
     def actor_id(self) -> str:
@@ -49,7 +53,8 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        opts = self.__dict__.get("_method_opts", {}).get(name, {})
+        return ActorMethod(self, name, **opts)
 
     def _invoke(self, method_name: str, args, kwargs,
                 num_returns: int = 1) -> Any:
@@ -71,10 +76,23 @@ class ActorHandle:
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._class_name))
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_opts))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id})"
+
+
+def _collect_method_opts(cls) -> Dict[str, Dict[str, Any]]:
+    opts: Dict[str, Dict[str, Any]] = {}
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        fn = getattr(cls, name, None)
+        mo = getattr(fn, "__ray_tpu_method_opts__", None)
+        if mo:
+            opts[name] = dict(mo)
+    return opts
 
 
 class ActorClass:
@@ -82,7 +100,7 @@ class ActorClass:
                  max_restarts=0, max_concurrency=1, name=None,
                  namespace=None, lifetime=None, runtime_env=None,
                  placement_group=None, bundle_index=-1,
-                 get_if_exists=False):
+                 scheduling_strategy=None, get_if_exists=False):
         from . import runtime_env as renv_mod
         runtime_env = renv_mod.validate(runtime_env) or None
         self._cls = cls
@@ -91,7 +109,9 @@ class ActorClass:
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             name=name, namespace=namespace, lifetime=lifetime,
             runtime_env=runtime_env, placement_group=placement_group,
-            bundle_index=bundle_index, get_if_exists=get_if_exists)
+            bundle_index=bundle_index,
+            scheduling_strategy=scheduling_strategy,
+            get_if_exists=get_if_exists)
         self._class_bytes: Optional[bytes] = None
 
     def options(self, **opts) -> "ActorClass":
@@ -122,8 +142,9 @@ class ActorClass:
 
     def _create(self, args, kwargs) -> ActorHandle:
         from . import resources as res_mod  # noqa: PLC0415
+        from ..api import _resolve_pg_strategy  # noqa: PLC0415
         rt = runtime_mod.get_runtime()
-        opts = self._default_opts
+        opts = _resolve_pg_strategy(self._default_opts)
         if self._class_bytes is None:
             self._class_bytes = serialization.dumps_call(self._cls)
         actor_id = new_actor_id()
@@ -131,10 +152,12 @@ class ActorClass:
         req = res_mod.normalize_task_resources(
             num_cpus=opts["num_cpus"], num_tpus=opts["num_tpus"],
             resources=opts["resources"], default_cpus=1.0)
+        method_opts = _collect_method_opts(self._cls)
         acspec = ActorCreationSpec(
             actor_id=actor_id,
             class_bytes=self._class_bytes,
             class_name=self._cls.__name__,
+            method_opts=method_opts,
             args=tuple(args),
             kwargs=dict(kwargs),
             resources={} if pg is not None else req,
@@ -144,11 +167,13 @@ class ActorClass:
             namespace=opts["namespace"] or getattr(rt, "namespace", "default"),
             placement_group_id=getattr(pg, "pg_id", None),
             bundle_index=opts.get("bundle_index", -1),
+            scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=opts["runtime_env"],
             dep_object_ids=extract_arg_deps(args, kwargs),
         )
         rt.create_actor(acspec)
-        return ActorHandle(actor_id, self._cls.__name__)
+        return ActorHandle(actor_id, self._cls.__name__,
+                           method_opts=method_opts)
 
     def bind(self, *args, **kwargs):
         """Record a lazy actor-construction DAG node (ray.dag ClassNode)."""

@@ -28,6 +28,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import resources as res_mod
+from . import scheduling as sched_mod
 from . import serialization
 from .gcs import GCS, ActorEntry, TaskEntry, NodeEntry
 from .ids import new_node_id, new_object_id
@@ -60,10 +61,18 @@ def runtime_initialized() -> bool:
     return _runtime is not None
 
 
+def _cpu_only(held: Dict[str, float]) -> Dict[str, float]:
+    return {k: v for k, v in held.items() if k == "CPU"}
+
+
+def _non_cpu(held: Dict[str, float]) -> Dict[str, float]:
+    return {k: v for k, v in held.items() if k != "CPU"}
+
+
 class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
-                 "actor_id", "held_resources", "blocked", "started_at",
-                 "purpose", "tpu_capable", "node_id")
+                 "actor_id", "held_resources", "held_tpu_ids", "blocked",
+                 "started_at", "purpose", "tpu_capable", "node_id")
 
     def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
                  purpose=None, tpu_capable: bool = False,
@@ -76,6 +85,7 @@ class WorkerState:
         self.current_task: Optional[str] = None
         self.actor_id: Optional[str] = None
         self.held_resources: Dict[str, float] = {}
+        self.held_tpu_ids: List[int] = []
         self.blocked = False
         self.started_at = time.time()
         self.purpose = purpose         # None (general) | actor_id
@@ -90,7 +100,7 @@ class NodeState:
     conn=None (reference parity: per-node resource views in
     gcs_node_manager.cc / node_manager.cc)."""
     __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
-                 "alive")
+                 "alive", "free_tpu_ids")
 
     def __init__(self, node_id: str, hostname: str,
                  resources: Dict[str, float],
@@ -103,6 +113,9 @@ class NodeState:
         self.labels = dict(labels or {})
         self.conn = conn
         self.alive = True
+        # Specific chip indices handed to tasks/actors (get_tpu_ids):
+        # concurrent TPU workloads on one host must see disjoint chips.
+        self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
 
 
 class Waiter:
@@ -198,6 +211,7 @@ class DriverRuntime:
         self.inbox: "queue.Queue" = queue.Queue()
         self.workers: Dict[str, WorkerState] = {}
         self.pending_tasks: collections.deque = collections.deque()
+        self._spread_rr = 0   # rotating node index for SPREAD scheduling
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
         self.actor_queues: Dict[str, collections.deque] = {}
@@ -794,7 +808,22 @@ class DriverRuntime:
                 continue
             need = {} if getattr(acspec, "placement_group_id", None) \
                 else acspec.resources
-            node = self._pick_node(need, allowed)
+            strat = getattr(acspec, "scheduling_strategy", None)
+            hard = sched_mod.hard_affinity_node(strat)
+            if hard is not None and not allowed:
+                hn = self.cluster_nodes.get(hard)
+                if hn is None or not hn.alive:
+                    ae = self.gcs.actors[acspec.actor_id]
+                    ae.state = "DEAD"
+                    ae.death_cause = (f"NodeAffinity target node {hard!r} "
+                                      "is dead or unknown")
+                    continue
+            tries, spread = sched_mod.strategy_plan(strat, allowed)
+            node = None
+            for att in tries:
+                node = self._pick_node(need, att, spread=spread)
+                if node is not None:
+                    break
             if node is None:
                 still.append(acspec)
                 continue
@@ -804,6 +833,7 @@ class DriverRuntime:
                                      node_id=node.node_id)
             w = self.workers[wid]
             w.held_resources = dict(need)
+            acspec.tpu_ids = self._take_tpu_ids(node, need, w)
             w.actor_id = acspec.actor_id
         self.pending_actors = still
 
@@ -824,7 +854,23 @@ class DriverRuntime:
                 continue
             need = {} if getattr(acspec, "placement_group_id", None) \
                 else acspec.resources
-            node = self._pick_node(need, allowed)
+            strat = getattr(acspec, "scheduling_strategy", None)
+            hard = sched_mod.hard_affinity_node(strat)
+            if hard is not None and not allowed:
+                hn = self.cluster_nodes.get(hard)
+                if hn is None or not hn.alive:
+                    ae.state = "DEAD"
+                    ae.death_cause = (f"NodeAffinity target node {hard!r} "
+                                      "died; cannot restart pinned actor")
+                    # queued method calls fail via the DEAD branch of the
+                    # actor-task scheduling section below
+                    continue
+            tries, spread = sched_mod.strategy_plan(strat, allowed)
+            node = None
+            for att in tries:
+                node = self._pick_node(need, att, spread=spread)
+                if node is not None:
+                    break
             if node is None:
                 still.append(aid)
                 continue
@@ -833,6 +879,7 @@ class DriverRuntime:
             new_wid = self._spawn_worker(purpose=aid, node_id=node.node_id)
             nw = self.workers[new_wid]
             nw.held_resources = dict(need)
+            acspec.tpu_ids = self._take_tpu_ids(node, need, nw)
             nw.actor_id = aid
         self.pending_restarts = still
 
@@ -866,28 +913,79 @@ class DriverRuntime:
                 continue
             need = spec.resources if spec.placement_group_id is None else {}
             task_needs_tpu = spec.resources.get("TPU", 0) > 0
-            w = self._find_idle_worker(
-                needs_tpu=task_needs_tpu,
-                allow_tpu_fallback=not tpu_demand,
-                allowed_nodes=allowed, need=need)
+            hard = sched_mod.hard_affinity_node(spec.scheduling_strategy)
+            if hard is not None and not allowed:
+                hn = self.cluster_nodes.get(hard)
+                if hn is None or not hn.alive:
+                    te.state = "FAILED"
+                    self._respawnable_specs.pop(spec.task_id, None)
+                    err = TaskError(
+                        f"NodeAffinity target node {hard!r} is dead or "
+                        "unknown", "", spec.name)
+                    for oid in spec.return_ids:
+                        self._fail_object(oid, err)
+                    continue
+            tries, spread = sched_mod.strategy_plan(
+                spec.scheduling_strategy, allowed)
+            w = None
+            if spread:
+                # SPREAD is node-first round-robin: assign the task a
+                # target node once (sticky across scheduling passes —
+                # re-rolling every pass would collapse onto whichever
+                # node has warm workers) and insist on a worker THERE,
+                # spawning one if allowed.
+                target = getattr(spec, "_spread_target", None)
+                tn = self.cluster_nodes.get(target) if target else None
+                if tn is None or not tn.alive:
+                    tn = self._pick_node(need, [], spread=True)
+                    if tn is not None:
+                        spec._spread_target = tn.node_id
+                if tn is not None:
+                    w = self._find_idle_worker(
+                        needs_tpu=task_needs_tpu,
+                        allow_tpu_fallback=not tpu_demand,
+                        allowed_nodes=[tn.node_id], need=need)
+                    if w is None:
+                        if self._can_spawn(tn, needs_tpu=task_needs_tpu):
+                            self._spawn_worker(purpose=None,
+                                               tpu_capable=task_needs_tpu,
+                                               node_id=tn.node_id)
+                            still.append(spec)
+                            continue
+                        # target saturated and can't grow: best-effort
+                        # spread — fall through and run anywhere rather
+                        # than starve behind the pinned node
             if w is None:
-                node = self._pick_node(need, allowed)
-                if node is not None and self._can_spawn(
-                        node, needs_tpu=task_needs_tpu):
-                    self._spawn_worker(purpose=None,
-                                       tpu_capable=task_needs_tpu,
-                                       node_id=node.node_id)
+                for att in tries:
+                    w = self._find_idle_worker(
+                        needs_tpu=task_needs_tpu,
+                        allow_tpu_fallback=not tpu_demand,
+                        allowed_nodes=att, need=need)
+                    if w is not None:
+                        break
+            if w is None:
+                for att in tries:
+                    node = self._pick_node(need, att, spread=spread)
+                    if node is not None and self._can_spawn(
+                            node, needs_tpu=task_needs_tpu):
+                        self._spawn_worker(purpose=None,
+                                           tpu_capable=task_needs_tpu,
+                                           node_id=node.node_id)
+                        break
                 still.append(spec)
                 continue
+            node = self.cluster_nodes[w.node_id]
+            spec.tpu_ids = self._take_tpu_ids(node, need, w)
             try:
                 w.conn.send(("exec_task", spec))
             except ConnectionClosed:
                 # Worker socket just broke: its death event will arrive via
                 # the reader thread; requeue the spec and keep scheduling.
+                self._return_tpu_ids(w)
                 w.state = "dying"
                 still.append(spec)
                 continue
-            res_mod.acquire(self.cluster_nodes[w.node_id].avail, need)
+            res_mod.acquire(node.avail, need)
             w.state, w.current_task = "busy", spec.task_id
             w.held_resources = dict(need)
             te.state, te.worker_id, te.started_at = ("RUNNING", w.worker_id,
@@ -938,6 +1036,27 @@ class DriverRuntime:
                                                          w.worker_id,
                                                          time.time())
 
+    def _take_tpu_ids(self, node: NodeState, need: Dict[str, float],
+                      w: WorkerState) -> List[int]:
+        """Reserve specific chip indices for `need`'s TPU count on the
+        worker; returned via _return_tpu_ids when the resources release."""
+        k = int(need.get("TPU", 0))
+        if k <= 0:
+            return []
+        ids = node.free_tpu_ids[:k]
+        del node.free_tpu_ids[:k]
+        w.held_tpu_ids = ids
+        return ids
+
+    def _return_tpu_ids(self, w: WorkerState) -> None:
+        if not w.held_tpu_ids:
+            return
+        node = self.cluster_nodes.get(w.node_id or self.node_id)
+        if node is not None and node.alive:
+            node.free_tpu_ids = sorted(
+                set(node.free_tpu_ids) | set(w.held_tpu_ids))
+        w.held_tpu_ids = []
+
     def _wnode_avail(self, w: WorkerState) -> Dict[str, float]:
         """The avail dict of the worker's node (a throwaway dict if the
         node is gone — releases to dead nodes must not corrupt others)."""
@@ -946,16 +1065,24 @@ class DriverRuntime:
             return {}
         return node.avail
 
-    def _pick_node(self, need: Dict[str, float],
-                   allowed: List[str]) -> Optional[NodeState]:
+    def _pick_node(self, need: Dict[str, float], allowed: List[str],
+                   spread: bool = False) -> Optional[NodeState]:
         """First alive node (driver-first) where `need` fits; `allowed`
-        non-empty restricts to those node ids (placement groups)."""
-        for n in self._alive_nodes():
-            if allowed and n.node_id not in allowed:
-                continue
-            if res_mod.fits(n.avail, need):
-                return n
-        return None
+        non-empty restricts to those node ids (placement groups /
+        affinity). spread=True round-robins across the fitting nodes
+        instead of driver-first."""
+        candidates = [n for n in self._alive_nodes()
+                      if (not allowed or n.node_id in allowed)
+                      and res_mod.fits(n.avail, need)]
+        if not candidates:
+            return None
+        if spread:
+            # Round-robin across fitting nodes (reference SPREAD
+            # semantics): load-based choice degenerates for sub-second
+            # tasks, which always observe every node idle.
+            self._spread_rr += 1
+            return candidates[self._spread_rr % len(candidates)]
+        return candidates[0]
 
     def _find_idle_worker(self, needs_tpu: bool = False,
                           allow_tpu_fallback: bool = True,
@@ -1094,6 +1221,7 @@ class DriverRuntime:
                 0, self.actor_inflight.get(aid, 0) - 1)
         elif w is not None:
             res_mod.release(self._wnode_avail(w), w.held_resources)
+            self._return_tpu_ids(w)
             w.held_resources = {}
             w.state, w.current_task, w.blocked = "idle", None, False
 
@@ -1112,6 +1240,7 @@ class DriverRuntime:
             w = self.workers.get(wid)
             if w is not None:
                 res_mod.release(self._wnode_avail(w), w.held_resources)
+                self._return_tpu_ids(w)
                 w.held_resources = {}
                 self._terminate_worker(w)
             # propagate the constructor error to queued method calls
@@ -1126,10 +1255,14 @@ class DriverRuntime:
         if w is None or w.state == "dead":
             return
         w.state = "dead"
-        if not w.blocked:
-            # Blocked workers already returned their resources when they
-            # entered get() — releasing again would inflate capacity.
+        if w.blocked:
+            # Blocked workers already returned their CPU when they entered
+            # get() — release only the non-CPU remainder they still hold.
+            res_mod.release(self._wnode_avail(w),
+                            _non_cpu(w.held_resources))
+        else:
             res_mod.release(self._wnode_avail(w), w.held_resources)
+        self._return_tpu_ids(w)
         w.held_resources = {}
         w.blocked = False
         self._conn_by_wid.pop(wid, None)
@@ -1245,14 +1378,18 @@ class DriverRuntime:
                 finish()
             if w is not None and w.blocked:
                 w.blocked = False
-                res_mod.acquire(self._wnode_avail(w), w.held_resources)
+                res_mod.acquire(self._wnode_avail(w),
+                                _cpu_only(w.held_resources))
         waiter = Waiter(oids, None, cb)
         if w is not None and w.state == "busy" and not w.blocked:
-            # Worker blocks in user get(): release its resources so other
-            # tasks can run (reference: raylet "blocked worker" CPU release,
-            # src/ray/raylet/node_manager.cc HandleTaskBlocked).
+            # Worker blocks in user get(): release its CPU so other tasks
+            # can run (reference: raylet "blocked worker" CPU release,
+            # src/ray/raylet/node_manager.cc HandleTaskBlocked). TPU chips
+            # stay held — the blocked process still owns the device and
+            # its HBM; lending the chip out would double-book it.
             w.blocked = True
-            res_mod.release(self._wnode_avail(w), w.held_resources)
+            res_mod.release(self._wnode_avail(w),
+                            _cpu_only(w.held_resources))
         self._add_waiter(waiter, timeout=timeout)
 
     def _worker_wait(self, w, rid, oids, num_returns, timeout):
@@ -1458,7 +1595,9 @@ class DriverRuntime:
         aid = self.gcs.lookup_named_actor(ns, name)
         if aid is None:
             return None
-        return aid, self.gcs.actors[aid].class_name
+        ae = self.gcs.actors[aid]
+        return (aid, ae.class_name,
+                getattr(ae.create_spec, "method_opts", {}) or {})
 
     def placement_group(self, bundles, strategy="PACK", name="") -> "PlacementGroupState":
         from .ids import new_placement_group_id  # noqa: PLC0415

@@ -1,0 +1,55 @@
+"""Scheduling strategies for tasks/actors.
+
+Reference parity: python/ray/util/scheduling_strategies.py —
+NodeAffinitySchedulingStrategy (pin to / prefer a node) and
+PlacementGroupSchedulingStrategy (schedule into a bundle), plus the
+"DEFAULT" / "SPREAD" string strategies. The dispatcher honors these in
+`runtime._schedule` (hard affinity fails fast when the target node is
+dead; soft affinity degrades to any node; SPREAD round-robins tasks
+across nodes, best-effort, instead of driver-first packing).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: Any
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+def strategy_plan(strategy, pg_allowed: List[str]):
+    """Turn a scheduling_strategy into an ordered list of allowed-node
+    constraints to try (each a list of node ids; [] = unconstrained) plus
+    a spread flag. Returns (tries, spread). A placement-group constraint
+    (pg_allowed non-empty) wins outright — mirrors the reference, where a
+    bundle pin overrides other strategies."""
+    if pg_allowed:
+        return [pg_allowed], False
+    if strategy is None or strategy == "DEFAULT":
+        return [[]], False
+    if strategy == "SPREAD":
+        return [[]], True
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        if strategy.soft:
+            return [[strategy.node_id], []], False
+        return [[strategy.node_id]], False
+    # Unknown strategy objects degrade to DEFAULT rather than wedging the
+    # dispatcher loop.
+    return [[]], False
+
+
+def hard_affinity_node(strategy) -> Optional[str]:
+    if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+            and not strategy.soft):
+        return strategy.node_id
+    return None

@@ -30,8 +30,11 @@ class TaskSpec:
     # placement
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
-    scheduling_strategy: Optional[str] = None
+    scheduling_strategy: Optional[Any] = None
     runtime_env: Optional[dict] = None
+    # chip indices assigned by the dispatcher at dispatch time
+    # (ray_tpu.get_tpu_ids inside the task reads these)
+    tpu_ids: List[int] = dataclasses.field(default_factory=list)
     # bookkeeping
     func_id: str = ""                  # cache key for deserialized functions
     dep_object_ids: List[str] = dataclasses.field(default_factory=list)
@@ -51,7 +54,13 @@ class ActorCreationSpec:
     namespace: str = "default"
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
     runtime_env: Optional[dict] = None
+    tpu_ids: List[int] = dataclasses.field(default_factory=list)
+    # @ray_tpu.method defaults per method name, carried so handles from
+    # get_actor() behave identically to the creation-time handle
+    method_opts: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
     dep_object_ids: List[str] = dataclasses.field(default_factory=list)
 
 

@@ -49,6 +49,7 @@ class WorkerRuntime:
         self._func_cache: Dict[str, Any] = {}
         self.current_task_id: Optional[str] = None
         self.current_actor_id: Optional[str] = None
+        self.current_tpu_ids: list = []
         self.job_id = os.environ.get("RAY_TPU_JOB_ID", "job-default")
 
     # ---- request/reply over the driver connection -------------------------
@@ -295,6 +296,12 @@ class WorkerLoop:
             self.conn.send(("task_done", spec.task_id, [], "cancelled"))
             return
         self.rt.current_task_id = spec.task_id
+        # Dispatcher-assigned chip indices; tasks scheduled through a
+        # placement group carry none (the PG holds the chips), so fall
+        # back to the requested count.
+        self.rt.current_tpu_ids = (
+            list(getattr(spec, "tpu_ids", []) or [])
+            or list(range(int((spec.resources or {}).get("TPU", 0)))))
         try:
             from . import runtime_env as renv_mod  # noqa: PLC0415
             fn = self.rt.load_func(spec)
@@ -319,6 +326,10 @@ class WorkerLoop:
             self._actor_instance = cls(*args, **kwargs)
             self._actor_spec = acspec
             self.rt.current_actor_id = acspec.actor_id
+            self.rt.current_tpu_ids = (
+                list(getattr(acspec, "tpu_ids", []) or [])
+                or list(range(int(
+                    (acspec.resources or {}).get("TPU", 0)))))
             if acspec.max_concurrency > 1:
                 self._actor_pool = ThreadPoolExecutor(
                     max_workers=acspec.max_concurrency,

@@ -7,7 +7,9 @@ from .queue import Queue
 
 from . import metrics  # noqa: F401
 from . import state    # noqa: F401
+from . import scheduling_strategies  # noqa: F401
 
 __all__ = ["ActorPool", "Queue", "metrics", "state", "PlacementGroup",
            "placement_group", "remove_placement_group",
-           "get_placement_group", "placement_group_table"]
+           "get_placement_group", "placement_group_table",
+           "scheduling_strategies"]

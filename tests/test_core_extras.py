@@ -325,3 +325,93 @@ def test_workflow_identical_siblings_run_separately(rt, tmp_path):
     a, b = workflow.run(dag, workflow_id="wfsib", args=(0,))
     assert a != b                       # two separate executions
     assert len(open(marker).read()) == 2
+
+
+# ---------- top-level API parity: method/nodes/timeline/get_tpu_ids ----------
+
+def test_method_decorator_num_returns(rt):
+    @ray_tpu.remote
+    class Pair:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+        def one(self):
+            return 3
+
+    p = Pair.remote()
+    a, b = p.two.remote()
+    assert ray_tpu.get([a, b], timeout=30) == [1, 2]
+    assert ray_tpu.get(p.one.remote(), timeout=30) == 3
+    # survives handle serialization through a task
+    @ray_tpu.remote
+    def use(handle):
+        x, y = handle.two.remote()
+        return ray_tpu.get([x, y])
+    assert ray_tpu.get(use.remote(p), timeout=30) == [1, 2]
+
+
+def test_method_decorator_rejects_unknown_option():
+    with pytest.raises(ValueError):
+        ray_tpu.method(bogus=1)
+
+
+def test_nodes_and_timeline(rt, tmp_path):
+    ray_tpu.get(_add.remote(1, 2), timeout=30)
+    nodes = ray_tpu.nodes()
+    assert len(nodes) >= 1
+    out = tmp_path / "trace.json"
+    ray_tpu.timeline(str(out))
+    import json as _json
+    events = _json.loads(out.read_text())
+    assert any(e.get("ph") == "X" for e in events)
+
+
+def test_get_tpu_ids_inside_task(rt):
+    @ray_tpu.remote(num_tpus=0)
+    def no_tpu():
+        return ray_tpu.get_tpu_ids()
+
+    assert ray_tpu.get(no_tpu.remote(), timeout=30) == []
+
+
+def test_method_opts_survive_get_actor():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class NamedPair:
+            @ray_tpu.method(num_returns=2)
+            def two(self):
+                return 7, 8
+
+        NamedPair.options(name="np1").remote()
+        h = ray_tpu.get_actor("np1")
+        a, b = h.two.remote()
+        assert ray_tpu.get([a, b], timeout=30) == [7, 8]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_concurrent_tpu_tasks_get_disjoint_chip_ids():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=4)
+    try:
+        @ray_tpu.remote(num_tpus=2)
+        class Holder:
+            def ids(self):
+                return ray_tpu.get_tpu_ids()
+
+        h1, h2 = Holder.remote(), Holder.remote()
+        ids1, ids2 = ray_tpu.get([h1.ids.remote(), h2.ids.remote()],
+                                 timeout=60)
+        assert len(ids1) == 2 and len(ids2) == 2
+        assert set(ids1).isdisjoint(ids2), (ids1, ids2)
+        # release and re-acquire: killing one actor frees its chips
+        ray_tpu.kill(h1)
+        time.sleep(0.3)
+        h3 = Holder.remote()
+        ids3 = ray_tpu.get(h3.ids.remote(), timeout=60)
+        assert set(ids3).isdisjoint(ids2)
+    finally:
+        ray_tpu.shutdown()

@@ -184,3 +184,57 @@ def test_tpu_gang_resource_lands_on_slice_head(cluster):
     rt, remote_nid = cluster
     ref = _where.options(resources={"TPU-v5e-8-head": 1}).remote()
     assert ray_tpu.get(ref, timeout=60) == remote_nid
+
+
+def test_node_affinity_hard_pins_to_node(cluster):
+    rt, remote_nid = cluster
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    refs = [_where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=remote_nid)).remote() for _ in range(3)]
+    assert ray_tpu.get(refs, timeout=60) == [remote_nid] * 3
+    # and pinning to the driver node works symmetrically
+    ref = _where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=rt.node_id)).remote()
+    assert ray_tpu.get(ref, timeout=60) == rt.node_id
+
+
+def test_node_affinity_hard_dead_node_fails(cluster):
+    from ray_tpu.exceptions import TaskError
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    ref = _where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id="node-nonexistent")).remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_node_affinity_soft_falls_back(cluster):
+    # soft affinity to a dead node schedules anyway (reference semantics)
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    ref = _where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id="node-nonexistent", soft=True)).remote()
+    assert ray_tpu.get(ref, timeout=60) is not None
+
+
+def test_spread_strategy_uses_both_nodes(cluster):
+    rt, remote_nid = cluster
+    seen = set()
+    for _ in range(3):
+        refs = [_where.options(scheduling_strategy="SPREAD").remote()
+                for _ in range(8)]
+        seen.update(ray_tpu.get(refs, timeout=60))
+        if len(seen) == 2:
+            break
+    assert seen == {rt.node_id, remote_nid}
+
+
+def test_actor_node_affinity(cluster):
+    rt, remote_nid = cluster
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    a = _Counter.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=remote_nid)).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == remote_nid
+    ray_tpu.kill(a)
