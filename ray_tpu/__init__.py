@@ -17,7 +17,7 @@ from .api import (init, shutdown, is_initialized, remote, get, put, wait,
                   kill, cancel, get_actor, free, cluster_resources,
                   available_resources, get_runtime_context, method, nodes,
                   timeline, get_tpu_ids)
-from .core.object_ref import ObjectRef
+from .core.object_ref import ObjectRef, ObjectRefGenerator
 from .core.actor import ActorHandle
 from . import exceptions
 
@@ -40,6 +40,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "free", "cluster_resources",
     "available_resources", "get_runtime_context", "method", "nodes",
-    "timeline", "get_tpu_ids", "ObjectRef", "ActorHandle",
+    "timeline", "get_tpu_ids", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle",
     "exceptions", "__version__", *_LAZY_SUBMODULES,
 ]

@@ -124,10 +124,11 @@ class RemoteFunction:
         args, kwargs = _auto_put_large_args(rt, args, kwargs)
         o = _resolve_pg_strategy(self._opts)
         pg = o.get("placement_group")
+        streaming = o["num_returns"] in ("streaming", "dynamic")
         spec = make_task_spec(
             self._fn, args, kwargs,
             name=getattr(self._fn, "__qualname__", "task"),
-            num_returns=o["num_returns"],
+            num_returns=1 if streaming else o["num_returns"],
             resources=res_mod.normalize_task_resources(
                 num_cpus=o["num_cpus"], num_tpus=o["num_tpus"],
                 resources=o["resources"]),
@@ -138,6 +139,13 @@ class RemoteFunction:
             bundle_index=o.get("bundle_index", -1),
             scheduling_strategy=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"))
+        if streaming:
+            # generator task: items become refs as the remote yields
+            spec.streaming = True
+            spec.return_ids = []
+            rt.submit(spec)
+            from .core.object_ref import ObjectRefGenerator  # noqa: PLC0415
+            return ObjectRefGenerator(spec.task_id)
         refs = rt.submit(spec)
         return refs[0] if o["num_returns"] == 1 else refs
 
@@ -201,8 +209,13 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
                                          no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    runtime_mod.get_runtime().cancel(ref, force=force)
+def cancel(ref, *, force: bool = False, recursive: bool = True):
+    from .core.object_ref import ObjectRefGenerator  # noqa: PLC0415
+    rt = runtime_mod.get_runtime()
+    if isinstance(ref, ObjectRefGenerator):
+        rt.cancel_task(ref.task_id, force=force)
+    else:
+        rt.cancel(ref, force=force)
 
 
 def get_actor(name: str, namespace: Optional[str] = None, *,

@@ -57,22 +57,29 @@ class ActorHandle:
         return ActorMethod(self, name, **opts)
 
     def _invoke(self, method_name: str, args, kwargs,
-                num_returns: int = 1) -> Any:
+                num_returns=1) -> Any:
         rt = runtime_mod.get_runtime()
+        streaming = num_returns in ("streaming", "dynamic")
+        n = 1 if streaming else num_returns
         spec = TaskSpec(
             task_id=new_task_id(),
             name=f"{self._class_name}.{method_name}",
             func_bytes=b"",
             args=tuple(args),
             kwargs=dict(kwargs),
-            num_returns=num_returns,
-            return_ids=[new_object_id() for _ in range(max(num_returns, 1))],
+            num_returns=n,
+            return_ids=[] if streaming
+            else [new_object_id() for _ in range(max(n, 1))],
             resources={},
             actor_id=self._actor_id,
             method_name=method_name,
+            streaming=streaming,
             dep_object_ids=extract_arg_deps(args, kwargs),
         )
         refs = rt.submit_actor_task(spec)
+        if streaming:
+            from .object_ref import ObjectRefGenerator  # noqa: PLC0415
+            return ObjectRefGenerator(spec.task_id)
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):

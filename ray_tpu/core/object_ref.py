@@ -61,3 +61,50 @@ class ObjectRef:
 
     def future(self) -> "asyncio.Future":
         return self.as_future()
+
+
+class ObjectRefGenerator:
+    """Handle to a streaming-generator task (num_returns="streaming").
+
+    Iterating yields ObjectRefs in the order the remote generator yields
+    values; each ref resolves via ray_tpu.get. Works from the driver and
+    from inside workers, and survives serialization (it carries only the
+    task id). Reference parity: ObjectRefGenerator in _raylet.pyx.
+    """
+    __slots__ = ("_task_id",)
+
+    def __init__(self, task_id: str):
+        self._task_id = task_id
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from . import runtime  # noqa: PLC0415
+        ref = runtime.get_runtime().gen_next(self._task_id, timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def __aiter__(self) -> "ObjectRefGenerator":
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio  # noqa: PLC0415
+        from . import runtime  # noqa: PLC0415
+        rt = runtime.get_runtime()
+        ref = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: rt.gen_next(self._task_id, timeout=None))
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id,))
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id})"

@@ -118,6 +118,27 @@ class NodeState:
         self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
 
 
+class GenStream:
+    """Driver-side state of one streaming-generator task
+    (num_returns="streaming"): item refs arrive as the remote generator
+    yields; consumers pop them in order via gen_next (reference parity:
+    ObjectRefGenerator / streaming generator tasks, _raylet.pyx)."""
+    __slots__ = ("task_id", "items", "done", "error", "waiters",
+                 "terminal_sent")
+
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.items: collections.deque = collections.deque()   # sealed oids
+        self.done = False
+        self.error: Optional[BaseException] = None
+        # each waiter: (cb, abandoned_flag_list); cb((kind, payload))
+        self.waiters: collections.deque = collections.deque()
+        # the done/error reply reached a consumer (GC precondition: the
+        # real error object must be delivered before the stream drops to
+        # the generic task-table fallback)
+        self.terminal_sent = False
+
+
 class Waiter:
     """A pending get/wait. Satisfied (and its callback fired) exactly once,
     from the dispatcher thread."""
@@ -143,6 +164,9 @@ class PlacementGroupState:
         self.ready_ref: Optional[str] = None
         # node_id per bundle, filled at admission by the strategy solver
         self.bundle_nodes: List[str] = []
+        # chip indices reserved per bundle at admission (tasks scheduled
+        # into a bundle report these from get_tpu_ids)
+        self.bundle_tpu_ids: List[List[int]] = []
         self.created_at = time.time()
 
 
@@ -212,6 +236,10 @@ class DriverRuntime:
         self.workers: Dict[str, WorkerState] = {}
         self.pending_tasks: collections.deque = collections.deque()
         self._spread_rr = 0   # rotating node index for SPREAD scheduling
+        self._gen_streams: Dict[str, GenStream] = {}
+        # rid -> (abandoned_flag, worker, blocked_here) for parked
+        # worker-side generator waiters
+        self._gen_worker_waiters: Dict[str, tuple] = {}
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
         self.actor_queues: Dict[str, collections.deque] = {}
@@ -369,6 +397,8 @@ class DriverRuntime:
             self._seal(oid, loc)
         elif kind == "api_waiter":
             self._add_waiter(item[1])
+        elif kind == "api_gen_next":
+            self._gen_request(item[1], item[2], item[3])
         elif kind == "waiter_timeout":
             self._fire_waiter(item[1], timed_out=True)
         elif kind == "api_cancel":
@@ -399,6 +429,13 @@ class DriverRuntime:
             return
         if mtype == "task_done":
             self._on_task_done(wid, m[1], m[2], m[3])
+        elif mtype == "gen_item":
+            self._on_gen_item(m[1], m[2], m[3])
+        elif mtype == "gen_next_request":
+            _, rid, task_id = m
+            self._gen_next_for_worker(w, rid, task_id)
+        elif mtype == "gen_abandon":
+            self._gen_abandon_worker(m[1])
         elif mtype == "actor_created":
             self._on_actor_created(wid, m[1], m[2], m[3])
         elif mtype == "put":
@@ -416,7 +453,15 @@ class DriverRuntime:
         elif mtype == "kill_actor":
             self._kill_actor(m[1], m[2])
         elif mtype == "cancel":
-            self._cancel(m[1], m[2])
+            # Workers cancel by OBJECT id (mirroring ray.cancel(ref));
+            # resolve to the producing task like the driver's
+            # api_cancel_obj path. A task id (generator cancel) is also
+            # accepted directly.
+            e = self.gcs.objects.get(m[1])
+            if e is not None and e.owner_task:
+                self._cancel(e.owner_task, m[2])
+            else:
+                self._cancel(m[1], m[2])
         elif mtype == "report":
             h = self.report_handlers.get(m[1])
             if h:
@@ -505,10 +550,16 @@ class DriverRuntime:
         # infeasibility grace declares it impossible).
         for pg in self.placement_groups.values():
             if pg.state == "CREATED" and nid in pg.bundle_nodes:
-                for b, bn in zip(pg.bundles, pg.bundle_nodes):
+                for i, (b, bn) in enumerate(zip(pg.bundles,
+                                                pg.bundle_nodes)):
                     node = self.cluster_nodes.get(bn)
                     if bn != nid and node is not None and node.alive:
                         res_mod.release(node.avail, b)
+                        ids = (pg.bundle_tpu_ids[i]
+                               if i < len(pg.bundle_tpu_ids) else [])
+                        if ids:
+                            node.free_tpu_ids = sorted(
+                                set(node.free_tpu_ids) | set(ids))
                 pg.bundle_nodes = []
                 pg.state = "PENDING"
                 pg.created_at = time.time()
@@ -556,6 +607,149 @@ class DriverRuntime:
         e = self.gcs.seal_object(oid, loc)
         self._spill.on_seal(oid, e.loc)
         self._notify_object(oid)
+
+    # ---------------- streaming generators ----------------
+    def _on_gen_item(self, task_id: str, oid: str, loc) -> None:
+        self._seal(oid, loc)
+        s = self._gen_streams.get(task_id)
+        if s is None:
+            return
+        s.items.append(oid)
+        self._gen_fire(s)
+
+    def _gen_settle(self, task_id: str, error=None) -> None:
+        s = self._gen_streams.get(task_id)
+        if s is None:
+            return
+        if error is None:
+            s.done = True
+        else:
+            s.error = error
+        self._gen_fire(s)
+
+    def _gen_reply(self, s: GenStream):
+        """(kind, payload) if the stream can answer now, else None."""
+        if s.items:
+            return ("item", s.items.popleft())
+        if s.error is not None:
+            s.terminal_sent = True
+            return ("error", s.error)
+        if s.done:
+            s.terminal_sent = True
+            return ("done", None)
+        return None
+
+    def _gen_fire(self, s: GenStream) -> None:
+        while s.waiters:
+            head_cb, abandoned = s.waiters[0]
+            if abandoned[0]:
+                s.waiters.popleft()
+                continue
+            r = self._gen_reply(s)
+            if r is None:
+                break
+            s.waiters.popleft()
+            try:
+                head_cb(r)
+            except Exception:
+                traceback.print_exc()
+        self._gen_gc(s)
+
+    def _gen_lookup(self, task_id: str):
+        """(stream, None) for a live stream, else (None, terminal_reply).
+        Finished streams are GC'd from _gen_streams; the task table keeps
+        answering late/repeat consumers."""
+        s = self._gen_streams.get(task_id)
+        if s is not None:
+            return s, None
+        te = self.gcs.tasks.get(task_id)
+        if te is None:
+            return None, ("error", ValueError(
+                f"no streaming generator for task {task_id}"))
+        if te.state == "FINISHED":
+            return None, ("done", None)
+        if te.state == "CANCELLED":
+            return None, ("error",
+                          TaskCancelledError(f"task {task_id} cancelled"))
+        return None, ("error", TaskError(
+            f"streaming task {task_id} failed", "", te.name))
+
+    def _gen_gc(self, s: GenStream) -> None:
+        """Drop fully-drained settled streams (long-lived drivers submit
+        unbounded numbers of generator tasks; _gen_lookup keeps answering
+        from the task table afterwards)."""
+        if s.terminal_sent and not s.items and not s.waiters:
+            self._gen_streams.pop(s.task_id, None)
+
+    def _gen_request(self, task_id: str, cb, abandoned) -> None:
+        """Answer immediately if possible, else park the waiter."""
+        s, terminal = self._gen_lookup(task_id)
+        if s is None:
+            cb(terminal)
+            return
+        r = self._gen_reply(s)
+        if r is not None:
+            cb(r)
+            self._gen_gc(s)
+            return
+        s.waiters.append((cb, abandoned))
+
+    def _gen_next_for_worker(self, w, rid: str, task_id: str) -> None:
+        def send(result, w=w, rid=rid):
+            if w is not None and w.conn is not None:
+                try:
+                    w.conn.send(("get_reply", rid, result))
+                except ConnectionClosed:
+                    pass
+
+        s, terminal = self._gen_lookup(task_id)
+        if s is None:
+            send(terminal)
+            return
+        r = self._gen_reply(s)
+        if r is not None:
+            send(r)
+            self._gen_gc(s)
+            return
+        # Must park: same blocked-worker protocol as _worker_get — while
+        # a worker waits on the stream it lends its CPU back, else a
+        # consumer task on a 1-CPU node deadlocks the generator feeding
+        # it.
+        blocked_here = (w is not None and w.state == "busy"
+                        and not w.blocked)
+        if blocked_here:
+            w.blocked = True
+            res_mod.release(self._wnode_avail(w),
+                            _cpu_only(w.held_resources))
+
+        def cb(result, w=w, rid=rid, blocked_here=blocked_here):
+            self._gen_worker_waiters.pop(rid, None)
+            if blocked_here and w is not None and w.blocked:
+                w.blocked = False
+                res_mod.acquire(self._wnode_avail(w),
+                                _cpu_only(w.held_resources))
+            send(result)
+
+        abandoned = [False]
+        self._gen_worker_waiters[rid] = (abandoned, w, blocked_here)
+        s.waiters.append((cb, abandoned))
+
+    def _gen_abandon_worker(self, rid: str) -> None:
+        """A worker's gen_next timed out: mark its parked waiter so a
+        later item is not popped into a reply nobody is waiting for, and
+        restore the CPU the waiter had lent back. (If the reply already
+        fired, the item was delivered to the timed-out rid and is lost —
+        gen_next timeouts are inherently racy.)"""
+        entry = self._gen_worker_waiters.pop(rid, None)
+        if entry is None:
+            return
+        flag, w, blocked_here = entry
+        flag[0] = True
+        if blocked_here and w is not None and w.blocked \
+                and w.state != "dead":
+            w.blocked = False
+            res_mod.acquire(self._wnode_avail(w),
+                            _cpu_only(w.held_resources))
 
     def _fail_object(self, oid: str, err) -> None:
         self.gcs.fail_object(oid, err)
@@ -621,6 +815,8 @@ class DriverRuntime:
         self.gcs.tasks[spec.task_id] = te
         for oid in spec.return_ids:
             self.gcs.add_pending_object(oid, owner_task=spec.task_id)
+        if getattr(spec, "streaming", False):
+            self._gen_streams[spec.task_id] = GenStream(spec.task_id)
         if spec.actor_id is not None:
             aentry = self.gcs.actors.get(spec.actor_id)
             if aentry is None or aentry.state == "DEAD":
@@ -630,6 +826,7 @@ class DriverRuntime:
                 te.state = "FAILED"
                 for oid in spec.return_ids:
                     self._fail_object(oid, err)
+                self._gen_settle(spec.task_id, err)
                 return
             self.actor_queues.setdefault(spec.actor_id,
                                          collections.deque()).append(spec)
@@ -781,8 +978,13 @@ class DriverRuntime:
                     continue
                 if assignment is None:
                     continue
+                pg.bundle_tpu_ids = []
                 for b, nid in zip(pg.bundles, assignment):
-                    res_mod.acquire(self.cluster_nodes[nid].avail, b)
+                    node = self.cluster_nodes[nid]
+                    res_mod.acquire(node.avail, b)
+                    k = int(b.get("TPU", 0))
+                    pg.bundle_tpu_ids.append(node.free_tpu_ids[:k])
+                    del node.free_tpu_ids[:k]
                 pg.bundle_nodes = assignment
                 pg.state = "CREATED"
                 self._seal(pg.ready_ref,
@@ -833,7 +1035,12 @@ class DriverRuntime:
                                      node_id=node.node_id)
             w = self.workers[wid]
             w.held_resources = dict(need)
-            acspec.tpu_ids = self._take_tpu_ids(node, need, w)
+            if getattr(acspec, "placement_group_id", None) is not None:
+                acspec.tpu_ids = self._pg_tpu_ids(
+                    acspec.placement_group_id, acspec.bundle_index,
+                    node.node_id)
+            else:
+                acspec.tpu_ids = self._take_tpu_ids(node, need, w)
             w.actor_id = acspec.actor_id
         self.pending_actors = still
 
@@ -879,7 +1086,12 @@ class DriverRuntime:
             new_wid = self._spawn_worker(purpose=aid, node_id=node.node_id)
             nw = self.workers[new_wid]
             nw.held_resources = dict(need)
-            acspec.tpu_ids = self._take_tpu_ids(node, need, nw)
+            if getattr(acspec, "placement_group_id", None) is not None:
+                acspec.tpu_ids = self._pg_tpu_ids(
+                    acspec.placement_group_id, acspec.bundle_index,
+                    node.node_id)
+            else:
+                acspec.tpu_ids = self._take_tpu_ids(node, need, nw)
             nw.actor_id = aid
         self.pending_restarts = still
 
@@ -902,6 +1114,7 @@ class DriverRuntime:
                 err = TaskError("upstream dependency failed", "", spec.name)
                 for oid in spec.return_ids:
                     self._fail_object(oid, err)
+                self._gen_settle(spec.task_id, err)
                 continue
             if dr is False:
                 still.append(spec)
@@ -975,7 +1188,11 @@ class DriverRuntime:
                 still.append(spec)
                 continue
             node = self.cluster_nodes[w.node_id]
-            spec.tpu_ids = self._take_tpu_ids(node, need, w)
+            if spec.placement_group_id is not None:
+                spec.tpu_ids = self._pg_tpu_ids(
+                    spec.placement_group_id, spec.bundle_index, w.node_id)
+            else:
+                spec.tpu_ids = self._take_tpu_ids(node, need, w)
             try:
                 w.conn.send(("exec_task", spec))
             except ConnectionClosed:
@@ -1004,6 +1221,7 @@ class DriverRuntime:
                     self.gcs.tasks[spec.task_id].state = "FAILED"
                     for oid in spec.return_ids:
                         self._fail_object(oid, err)
+                    self._gen_settle(spec.task_id, err)
                 continue
             if ae.state != "ALIVE":
                 continue
@@ -1022,6 +1240,7 @@ class DriverRuntime:
                     self.gcs.tasks[spec.task_id].state = "FAILED"
                     for oid in spec.return_ids:
                         self._fail_object(oid, err)
+                    self._gen_settle(spec.task_id, err)
                     continue
                 te = self.gcs.tasks[spec.task_id]
                 if te.state == "CANCELLED":
@@ -1035,6 +1254,22 @@ class DriverRuntime:
                 te.state, te.worker_id, te.started_at = ("RUNNING",
                                                          w.worker_id,
                                                          time.time())
+
+    def _pg_tpu_ids(self, pg_id: Optional[str], bundle_index: int,
+                    node_id: str) -> List[int]:
+        """Chip indices a placement-group task may use: its bundle's
+        reserved ids (bundle pinned), else every id the group reserved on
+        the task's node. These release with the GROUP, not the task."""
+        pg = self.placement_groups.get(pg_id) if pg_id else None
+        if pg is None or pg.state != "CREATED":
+            return []
+        if 0 <= bundle_index < len(pg.bundle_tpu_ids):
+            return list(pg.bundle_tpu_ids[bundle_index])
+        out: List[int] = []
+        for nid, ids in zip(pg.bundle_nodes, pg.bundle_tpu_ids):
+            if nid == node_id:
+                out.extend(ids)
+        return sorted(set(out))
 
     def _take_tpu_ids(self, node: NodeState, need: Dict[str, float],
                       w: WorkerState) -> List[int]:
@@ -1119,8 +1354,12 @@ class DriverRuntime:
         # per scheduling pass.
         on_node = [w for w in self.workers.values()
                    if w.node_id == node.node_id]
+        # Blocked workers lent their CPU back (parked in get()/gen_next)
+        # — they don't count against the cap, or a consumer task holding
+        # the node's only CPU slot could never get a producer spawned.
         general_alive = len([w for w in on_node
-                             if w.state != "dead" and w.purpose is None])
+                             if w.state != "dead" and w.purpose is None
+                             and not w.blocked])
         cpu_cap = int(node.total.get("CPU", 1)) or 1
         under_cap = general_alive < min(self.max_workers, cpu_cap)
         ready = sum(1 for w in on_node
@@ -1204,15 +1443,18 @@ class DriverRuntime:
             for oid, loc in sealed:
                 self._seal(oid, loc)
                 spec_returns.append(oid)
+            self._gen_settle(task_id)
         elif error == "cancelled":
             te.state = "CANCELLED"
             err = TaskCancelledError(f"task {task_id} cancelled")
             for oid in self._return_ids_of(task_id):
                 self._fail_object(oid, err)
+            self._gen_settle(task_id, err)
         else:
             te.state = "FAILED"
             for oid in self._return_ids_of(task_id):
                 self._fail_object(oid, error)
+            self._gen_settle(task_id, error)
         te.finished_at = time.time()
         self._respawnable_specs.pop(task_id, None)
         if te.actor_id is not None:
@@ -1248,6 +1490,7 @@ class DriverRuntime:
                 self.gcs.tasks[spec.task_id].state = "FAILED"
                 for oid in spec.return_ids:
                     self._fail_object(oid, err)
+                self._gen_settle(spec.task_id, err)
             self.actor_queues.pop(actor_id, None)
 
     def _on_worker_dead(self, wid: str):
@@ -1271,7 +1514,10 @@ class DriverRuntime:
             te = self.gcs.tasks.get(w.current_task)
             if te is not None and te.state == "RUNNING":
                 spec = self._respawnable_specs.get(w.current_task)
-                if te.retries_left > 0 and spec is not None:
+                # Streaming tasks never retry: already-consumed items
+                # would replay and duplicate the stream.
+                if (te.retries_left > 0 and spec is not None
+                        and not getattr(spec, "streaming", False)):
                     te.retries_left -= 1
                     te.state = "PENDING"
                     self.pending_tasks.append(spec)
@@ -1281,6 +1527,7 @@ class DriverRuntime:
                         f"worker {wid} died while running {te.name}")
                     for oid in self._return_ids_of(w.current_task):
                         self._fail_object(oid, err)
+                    self._gen_settle(w.current_task, err)
         # actor hosted here -> restart or mark dead
         if w.actor_id:
             self._on_actor_worker_dead(w.actor_id, wid)
@@ -1296,6 +1543,7 @@ class DriverRuntime:
                 err = ActorDiedError(f"actor {aid} worker died")
                 for oid in self._return_ids_of(task_id):
                     self._fail_object(oid, err)
+                self._gen_settle(task_id, err)
         self.actor_inflight[aid] = 0
         if ae.num_restarts < ae.max_restarts:
             ae.num_restarts += 1
@@ -1314,6 +1562,7 @@ class DriverRuntime:
                 err = ActorDiedError(f"actor {aid} died")
                 for oid in spec.return_ids:
                     self._fail_object(oid, err)
+                self._gen_settle(spec.task_id, err)
             self.actor_queues.pop(aid, None)
 
     # ---------------- worker-side blocking verbs ----------------
@@ -1413,6 +1662,7 @@ class DriverRuntime:
             err = TaskCancelledError(f"task {task_id} cancelled")
             for oid in self._return_ids_of(task_id):
                 self._fail_object(oid, err)
+            self._gen_settle(task_id, err)
         elif te.state == "RUNNING":
             w = self.workers.get(te.worker_id or "")
             if w and w.conn:
@@ -1495,10 +1745,15 @@ class DriverRuntime:
     def _remove_pg(self, pg_id: str):
         pg = self.placement_groups.pop(pg_id, None)
         if pg is not None and pg.state == "CREATED":
-            for b, nid in zip(pg.bundles, pg.bundle_nodes):
+            for i, (b, nid) in enumerate(zip(pg.bundles, pg.bundle_nodes)):
                 node = self.cluster_nodes.get(nid)
                 if node is not None and node.alive:
                     res_mod.release(node.avail, b)
+                    ids = (pg.bundle_tpu_ids[i]
+                           if i < len(pg.bundle_tpu_ids) else [])
+                    if ids:
+                        node.free_tpu_ids = sorted(
+                            set(node.free_tpu_ids) | set(ids))
 
     # ================= public API (called from any thread) =================
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -1508,6 +1763,32 @@ class DriverRuntime:
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         return self.submit(spec)
+
+    def gen_next(self, task_id: str,
+                 timeout: Optional[float] = None) -> Optional[ObjectRef]:
+        """Next item ref of a streaming-generator task; None when the
+        stream is exhausted; raises the task's error if it failed."""
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+        abandoned = [False]
+
+        def cb(result):
+            box["r"] = result
+            ev.set()
+
+        self.inbox.put(("api_gen_next", task_id, cb, abandoned))
+        if not ev.wait(timeout):
+            abandoned[0] = True
+            raise GetTimeoutError(
+                f"generator next() timed out after {timeout}s")
+        kind, payload = box["r"]
+        if kind == "item":
+            return ObjectRef(payload)
+        if kind == "error":
+            if isinstance(payload, BaseException):
+                raise payload
+            raise TaskError(str(payload))
+        return None
 
     def create_actor(self, acspec: ActorCreationSpec) -> None:
         self.inbox.put(("api_submit_actor", acspec))
@@ -1575,6 +1856,10 @@ class DriverRuntime:
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         self.inbox.put(("api_cancel_obj", ref.id, force))
+
+    def cancel_task(self, task_id: str, force: bool = False) -> None:
+        """Cancel by task id (streaming-generator handles)."""
+        self.inbox.put(("api_cancel", task_id, force))
 
     def free(self, refs: List[ObjectRef]) -> None:
         self.inbox.put(("api_free", [r.id for r in refs]))

@@ -24,6 +24,9 @@ class TaskSpec:
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
     max_retries: int = 0
     retry_exceptions: bool = False
+    # streaming-generator task: yielded items become individually sealed
+    # objects announced via "gen_item"; return_ids stays empty
+    streaming: bool = False
     # actor fields
     actor_id: Optional[str] = None
     method_name: Optional[str] = None

@@ -164,6 +164,9 @@ class WorkerRuntime:
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         self.conn.send(("cancel", ref.id, force))
 
+    def cancel_task(self, task_id: str, force: bool = False) -> None:
+        self.conn.send(("cancel", task_id, force))
+
     def report(self, channel: str, payload: Any) -> None:
         """Out-of-band message to the driver (train session, metrics...)."""
         self.conn.send(("report", channel, payload))
@@ -172,6 +175,29 @@ class WorkerRuntime:
         rid = self._new_req()
         self.conn.send(("report_sync", rid, channel, payload))
         return self._take_reply(rid, timeout)
+
+    def gen_next(self, task_id: str, timeout=None):
+        """Worker-side consumption of a streaming generator: ask the
+        driver for the next item ref (blocks until one streams in)."""
+        from .object_ref import ObjectRef  # noqa: PLC0415
+        from ..exceptions import TaskError  # noqa: PLC0415
+        rid = self._new_req()
+        self.conn.send(("gen_next_request", rid, task_id))
+        try:
+            kind, payload = self._take_reply(rid, timeout)
+        except GetTimeoutError:
+            # Tell the driver to drop the parked waiter (and restore the
+            # CPU it lent back) so a later item isn't popped into a
+            # reply nobody consumes.
+            self.conn.send(("gen_abandon", rid))
+            raise
+        if kind == "item":
+            return ObjectRef(payload)
+        if kind == "error":
+            if isinstance(payload, BaseException):
+                raise payload
+            raise TaskError(str(payload))
+        return None
 
     def get_resources(self) -> Dict[str, float]:
         return {}
@@ -296,18 +322,20 @@ class WorkerLoop:
             self.conn.send(("task_done", spec.task_id, [], "cancelled"))
             return
         self.rt.current_task_id = spec.task_id
-        # Dispatcher-assigned chip indices; tasks scheduled through a
-        # placement group carry none (the PG holds the chips), so fall
-        # back to the requested count.
-        self.rt.current_tpu_ids = (
-            list(getattr(spec, "tpu_ids", []) or [])
-            or list(range(int((spec.resources or {}).get("TPU", 0)))))
+        # Dispatcher-assigned chip indices (disjoint across concurrent
+        # workloads; placement-group tasks get their bundle's ids)
+        self.rt.current_tpu_ids = list(getattr(spec, "tpu_ids", []) or [])
         try:
             from . import runtime_env as renv_mod  # noqa: PLC0415
             fn = self.rt.load_func(spec)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
             with renv_mod.applied(spec.runtime_env):
                 result = fn(*args, **kwargs)
+                if getattr(spec, "streaming", False):
+                    cancelled = self._stream_items(spec, result)
+                    self.conn.send(("task_done", spec.task_id, [],
+                                    "cancelled" if cancelled else None))
+                    return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
         except BaseException as e:  # noqa: BLE001
@@ -326,10 +354,8 @@ class WorkerLoop:
             self._actor_instance = cls(*args, **kwargs)
             self._actor_spec = acspec
             self.rt.current_actor_id = acspec.actor_id
-            self.rt.current_tpu_ids = (
-                list(getattr(acspec, "tpu_ids", []) or [])
-                or list(range(int(
-                    (acspec.resources or {}).get("TPU", 0)))))
+            self.rt.current_tpu_ids = list(
+                getattr(acspec, "tpu_ids", []) or [])
             if acspec.max_concurrency > 1:
                 self._actor_pool = ThreadPoolExecutor(
                     max_workers=acspec.max_concurrency,
@@ -354,11 +380,34 @@ class WorkerLoop:
         else:
             self._run_actor_task(spec)
 
+    def _stream_items(self, spec: TaskSpec, iterable) -> bool:
+        """Put each yielded item and announce it to the driver in order
+        (streaming-generator tasks, num_returns="streaming"). Returns
+        True if the task was cancelled mid-stream (the generator is
+        closed and no further items are emitted)."""
+        from .ids import new_object_id  # noqa: PLC0415
+        from .spilling import put_value_or_spill  # noqa: PLC0415
+        for item in iterable:
+            if spec.task_id in self._cancelled:
+                close = getattr(iterable, "close", None)
+                if close:
+                    close()
+                return True
+            oid = new_object_id()
+            loc = put_value_or_spill(self.store, oid, item)
+            self.conn.send(("gen_item", spec.task_id, oid, loc))
+        return False
+
     def _run_actor_task(self, spec: TaskSpec) -> None:
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
             result = method(*args, **kwargs)
+            if getattr(spec, "streaming", False):
+                cancelled = self._stream_items(spec, result)
+                self.conn.send(("task_done", spec.task_id, [],
+                                "cancelled" if cancelled else None))
+                return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
         except BaseException as e:  # noqa: BLE001

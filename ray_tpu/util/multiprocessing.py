@@ -1,0 +1,155 @@
+"""multiprocessing.Pool-compatible API over ray_tpu tasks.
+
+Reference parity: python/ray/util/multiprocessing (Pool running on ray
+tasks) — drop-in for the stdlib Pool shapes people actually use: map /
+starmap / imap / imap_unordered / apply / apply_async, close/terminate/
+join, context manager. Work is chunked into remote tasks; `processes`
+bounds how many chunks are in flight at once (the runtime's scheduler
+does the real placement).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+from .. import api as _api
+from ..core.object_ref import ObjectRef
+
+
+class AsyncResult:
+    """Matches multiprocessing.pool.AsyncResult."""
+
+    def __init__(self, ref: ObjectRef):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = None):
+        return _api.get(self._ref, timeout=timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        _api.wait([self._ref], num_returns=1, timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = _api.wait([self._ref], num_returns=1, timeout=0)
+        return bool(ready)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            _api.get(self._ref, timeout=0.1)
+            return True
+        except BaseException:  # noqa: BLE001
+            return False
+
+
+def _run_chunk(fn: Callable, chunk: List, star: bool) -> List:
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(x) for x in chunk]
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 ray_remote_args: Optional[dict] = None):
+        if not _api.is_initialized():
+            _api.init()
+        self._processes = processes or int(
+            _api.cluster_resources().get("CPU", 4))
+        self._remote_args = ray_remote_args or {}
+        self._task = _api.remote(**self._remote_args)(_run_chunk) \
+            if self._remote_args else _api.remote(_run_chunk)
+        self._closed = False
+
+    # -- internals ----------------------------------------------------------
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running (closed)")
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            # stdlib heuristic: ~4 chunks per process
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i:i + chunksize]
+
+    def _map_refs(self, fn, iterable, chunksize, star) -> List[ObjectRef]:
+        self._check()
+        refs = []
+        inflight: List[ObjectRef] = []
+        for chunk in self._chunks(iterable, chunksize):
+            if len(inflight) >= self._processes:
+                ready, inflight = _api.wait(inflight, num_returns=1,
+                                            timeout=None)
+            ref = self._task.remote(fn, chunk, star)
+            refs.append(ref)
+            inflight.append(ref)
+        return refs
+
+    # -- public API ---------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List:
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        return list(itertools.chain.from_iterable(_api.get(refs)))
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List:
+        refs = self._map_refs(fn, iterable, chunksize, star=True)
+        return list(itertools.chain.from_iterable(_api.get(refs)))
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+
+        @_api.remote
+        def gather(*parts):
+            return list(itertools.chain.from_iterable(parts))
+
+        return AsyncResult(gather.remote(*refs))
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Ordered lazy iteration (results stream as chunks finish)."""
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        for ref in refs:
+            yield from _api.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        pending = list(refs)
+        while pending:
+            ready, pending = _api.wait(pending, num_returns=1, timeout=None)
+            for ref in ready:
+                yield from _api.get(ref)
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        self._check()
+
+        @_api.remote
+        def call(a, k):
+            return fn(*a, **(k or {}))
+
+        return AsyncResult(call.remote(args, kwds))
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+
+__all__ = ["Pool", "AsyncResult"]

@@ -415,3 +415,33 @@ def test_concurrent_tpu_tasks_get_disjoint_chip_ids():
         assert set(ids3).isdisjoint(ids2)
     finally:
         ray_tpu.shutdown()
+
+
+def test_pg_tasks_get_bundle_chip_ids():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=4)
+    try:
+        pg = placement_group([{"CPU": 1, "TPU": 2}], strategy="PACK")
+        assert pg.wait(30)
+
+        @ray_tpu.remote
+        def my_ids():
+            return ray_tpu.get_tpu_ids()
+
+        @ray_tpu.remote(num_tpus=2)
+        def outside_ids():
+            return ray_tpu.get_tpu_ids()
+
+        pg_ids, out_ids = ray_tpu.get(
+            [my_ids.options(placement_group=pg, bundle_index=0).remote(),
+             outside_ids.remote()], timeout=60)
+        assert len(pg_ids) == 2 and len(out_ids) == 2
+        # bundle reservation and dispatcher assignment never overlap
+        assert set(pg_ids).isdisjoint(out_ids), (pg_ids, out_ids)
+        remove_placement_group(pg)
+        time.sleep(0.3)
+        # removal returns the bundle's chips to the pool
+        back = ray_tpu.get(outside_ids.remote(), timeout=60)
+        assert len(back) == 2
+    finally:
+        ray_tpu.shutdown()

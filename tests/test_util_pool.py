@@ -1,0 +1,81 @@
+"""ray_tpu.util.multiprocessing.Pool + check_serialize parity tests
+(reference: python/ray/util/multiprocessing, util/check_serialize)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a + b, a * b
+
+
+def test_pool_map(rt):
+    with Pool(processes=4) as p:
+        assert p.map(_sq, range(20)) == [i * i for i in range(20)]
+
+
+def test_pool_starmap_and_chunksize(rt):
+    with Pool(processes=2) as p:
+        out = p.starmap(_addmul, [(1, 2), (3, 4)], chunksize=1)
+    assert out == [(3, 2), (7, 12)]
+
+
+def test_pool_imap_and_unordered(rt):
+    with Pool(processes=4) as p:
+        assert list(p.imap(_sq, range(10), chunksize=2)) == \
+            [i * i for i in range(10)]
+        assert sorted(p.imap_unordered(_sq, range(10), chunksize=3)) == \
+            sorted(i * i for i in range(10))
+
+
+def test_pool_apply_and_async(rt):
+    with Pool(processes=2) as p:
+        assert p.apply(_addmul, (2, 5)) == (7, 10)
+        ar = p.apply_async(_sq, (9,))
+        ar.wait(timeout=30)
+        assert ar.ready() and ar.get(timeout=30) == 81
+        assert ar.successful()
+
+
+def test_pool_closed_rejects_work(rt):
+    p = Pool(processes=2)
+    p.close()
+    p.join()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1, 2])
+
+
+def test_inspect_serializability(rt):
+    from ray_tpu.util.check_serialize import inspect_serializability
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    import threading
+    lock = threading.Lock()
+
+    def uses_lock():
+        return lock
+
+    ok, failures = inspect_serializability(uses_lock)
+    assert not ok
+    assert any("lock" in f.name for f in failures)
+
+
+def test_inspect_serializability_cycle(rt):
+    from ray_tpu.util.check_serialize import inspect_serializability
+    import threading
+
+    class A:
+        pass
+
+    a, b = A(), A()
+    a.other, b.other = b, a
+    a.lock = threading.Lock()
+    b.lock = threading.Lock()
+    ok, failures = inspect_serializability(a, name="a")
+    assert not ok and failures
