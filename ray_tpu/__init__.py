@@ -25,7 +25,7 @@ __version__ = "0.1.0"
 
 _LAZY_SUBMODULES = ("data", "train", "tune", "serve", "rllib", "util",
                     "models", "ops", "parallel", "observability", "dag",
-                    "workflow", "job_submission")
+                    "workflow", "job_submission", "experimental")
 
 
 def __getattr__(name):

@@ -240,6 +240,9 @@ class DriverRuntime:
         # rid -> (abandoned_flag, worker, blocked_here) for parked
         # worker-side generator waiters
         self._gen_worker_waiters: Dict[str, tuple] = {}
+        # settled-but-unconsumed streams, oldest first (bounded retention)
+        self._gen_settled: collections.deque = collections.deque()
+        self._kv_lock = threading.Lock()
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
         self.actor_queues: Dict[str, collections.deque] = {}
@@ -261,6 +264,8 @@ class DriverRuntime:
         self._fetch_events: Dict[int, Tuple[threading.Event, dict]] = {}
 
         self.report_handlers["sys.lookup_actor"] = self._sys_lookup_actor
+        self.report_handlers["sys.kv"] = \
+            lambda _wid, payload: self._kv_op(*payload)
 
         # Backstop for drivers that exit without calling shutdown() (e.g.
         # a pytest process): workers self-exit on socket close, but the shm
@@ -617,6 +622,12 @@ class DriverRuntime:
         s.items.append(oid)
         self._gen_fire(s)
 
+    # Settled streams a consumer never drained are kept for this many
+    # entries, then evicted oldest-first (their item refs stay valid in
+    # the store; _gen_lookup answers done/error from the task table).
+    # Bounds driver memory for fire-and-forget generator workloads.
+    _GEN_SETTLED_RETAIN = 1024
+
     def _gen_settle(self, task_id: str, error=None) -> None:
         s = self._gen_streams.get(task_id)
         if s is None:
@@ -626,6 +637,11 @@ class DriverRuntime:
         else:
             s.error = error
         self._gen_fire(s)
+        if task_id in self._gen_streams:     # not yet drained+GC'd
+            self._gen_settled.append(task_id)
+            while len(self._gen_settled) > self._GEN_SETTLED_RETAIN:
+                old = self._gen_settled.popleft()
+                self._gen_streams.pop(old, None)
 
     def _gen_reply(self, s: GenStream):
         """(kind, payload) if the stream can answer now, else None."""
@@ -1678,6 +1694,7 @@ class DriverRuntime:
                 err = TaskCancelledError(f"task {task_id} cancelled (force)")
                 for oid in self._return_ids_of(task_id):
                     self._fail_object(oid, err)
+                self._gen_settle(task_id, err)
                 w.current_task = None
                 self._terminate_worker(w)
 
@@ -1871,6 +1888,38 @@ class DriverRuntime:
 
     def register_report_handler(self, channel: str, fn: Callable) -> None:
         self.report_handlers[channel] = fn
+
+    def _kv_op(self, op: str, *args):
+        """Internal KV (ray_tpu.experimental.internal_kv). Locked: driver
+        API threads call this directly while the dispatcher serves worker
+        sys.kv requests, and iteration (list/del-by-prefix) plus put's
+        check-then-set are not atomic under the GIL."""
+        with self._kv_lock:
+            kv = self.gcs.kv
+            if op == "put":
+                key, value, overwrite = args
+                existed = key in kv
+                if overwrite or not existed:
+                    kv[key] = value
+                return existed
+            if op == "get":
+                return kv.get(args[0])
+            if op == "exists":
+                return args[0] in kv
+            if op == "del":
+                key, by_prefix = args
+                if by_prefix:
+                    doomed = [k for k in kv if k.startswith(key)]
+                    for k in doomed:
+                        del kv[k]
+                    return len(doomed)
+                return 1 if kv.pop(key, None) is not None else 0
+            if op == "list":
+                # args[0] is the namespaced prefix "ns\x00p"; return the
+                # un-namespaced key names, reference-style (bytes)
+                return [k.split("\x00", 1)[1].encode() for k in kv
+                        if k.startswith(args[0])]
+            raise ValueError(f"unknown kv op {op!r}")
 
     def _sys_lookup_actor(self, _wid, payload) -> Optional[tuple]:
         """Built-in report_sync channel backing get_actor() from workers."""

@@ -1,0 +1,7 @@
+"""Accelerator helpers (reference: python/ray/util/accelerators)."""
+from . import tpu  # noqa: F401
+from .tpu import (get_current_pod_name, get_current_pod_worker_count,
+                  get_num_tpu_chips_on_node)
+
+__all__ = ["tpu", "get_current_pod_name", "get_current_pod_worker_count",
+           "get_num_tpu_chips_on_node"]

@@ -1,0 +1,38 @@
+"""TPU pod/slice helpers for tasks and actors.
+
+Reference parity: python/ray/util/accelerators/tpu.py
+(get_current_pod_name / get_current_pod_worker_count) and the chip-count
+helper from python/ray/_private/accelerators/tpu.py. Values come from
+the node's topology labels (core/resources.py detect_tpu_topology),
+which on a real TPU VM mirror the runtime's metadata env.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.resources import detect_tpu_topology, _detect_tpu_chips
+
+
+def get_current_pod_name() -> Optional[str]:
+    """Name of the TPU pod/slice this host belongs to (None off-pod)."""
+    return detect_tpu_topology().get("tpu-slice") or None
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    """Number of hosts in this pod slice, derived from the pod type
+    (e.g. "v5e-16" with 4 chips/host -> 4 workers)."""
+    topo = detect_tpu_topology()
+    pod_type = topo.get("tpu-pod-type")
+    if not pod_type:
+        return None
+    try:
+        total_chips = int(pod_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return None
+    per_host = int(topo.get("tpu-chips-per-host", "0") or 0) \
+        or _detect_tpu_chips() or 4
+    return max(1, total_chips // per_host)
+
+
+def get_num_tpu_chips_on_node() -> int:
+    return _detect_tpu_chips()

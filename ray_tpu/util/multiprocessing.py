@@ -10,7 +10,7 @@ does the real placement).
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable, List, Optional
+from typing import Callable, Iterable, List, Optional, Union
 
 from .. import api as _api
 from ..core.object_ref import ObjectRef
@@ -19,24 +19,42 @@ from ..core.object_ref import ObjectRef
 class AsyncResult:
     """Matches multiprocessing.pool.AsyncResult."""
 
-    def __init__(self, ref: ObjectRef):
-        self._ref = ref
+    def __init__(self, refs: Union[ObjectRef, List[ObjectRef]],
+                 flatten: bool = False):
+        self._refs = refs if isinstance(refs, list) else [refs]
+        self._flatten = flatten
 
     def get(self, timeout: Optional[float] = None):
-        return _api.get(self._ref, timeout=timeout)
+        out = _api.get(self._refs, timeout=timeout)
+        if self._flatten:
+            return list(itertools.chain.from_iterable(out))
+        return out[0] if len(self._refs) == 1 else out
 
     def wait(self, timeout: Optional[float] = None) -> None:
-        _api.wait([self._ref], num_returns=1, timeout=timeout)
+        _api.wait(self._refs, num_returns=len(self._refs),
+                  timeout=timeout)
 
     def ready(self) -> bool:
-        ready, _ = _api.wait([self._ref], num_returns=1, timeout=0)
-        return bool(ready)
+        ready, _ = _api.wait(self._refs, num_returns=len(self._refs),
+                             timeout=0)
+        return len(ready) == len(self._refs)
 
     def successful(self) -> bool:
+        """True iff every task finished without error. Reads the sealed
+        object state — no value fetch, so big/cross-node results can't
+        fake a failure via a fetch timeout."""
         if not self.ready():
             raise ValueError("result is not ready")
+        from ..core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        if rt.is_driver:
+            for r in self._refs:
+                e = rt.gcs.objects.get(r.id)
+                if e is None or e.state != "ready":
+                    return False
+            return True
         try:
-            _api.get(self._ref, timeout=0.1)
+            _api.get(self._refs, timeout=30)
             return True
         except BaseException:  # noqa: BLE001
             return False
@@ -48,16 +66,22 @@ def _run_chunk(fn: Callable, chunk: List, star: bool) -> List:
     return [fn(x) for x in chunk]
 
 
+def _apply_fn(fn: Callable, args: tuple, kwds: Optional[dict]):
+    return fn(*args, **(kwds or {}))
+
+
 class Pool:
     def __init__(self, processes: Optional[int] = None,
                  ray_remote_args: Optional[dict] = None):
         if not _api.is_initialized():
             _api.init()
-        self._processes = processes or int(
-            _api.cluster_resources().get("CPU", 4))
+        self._processes = max(1, int(
+            processes or _api.cluster_resources().get("CPU", 4) or 1))
         self._remote_args = ray_remote_args or {}
         self._task = _api.remote(**self._remote_args)(_run_chunk) \
             if self._remote_args else _api.remote(_run_chunk)
+        self._apply = _api.remote(**self._remote_args)(_apply_fn) \
+            if self._remote_args else _api.remote(_apply_fn)
         self._closed = False
 
     # -- internals ----------------------------------------------------------
@@ -98,13 +122,10 @@ class Pool:
         return list(itertools.chain.from_iterable(_api.get(refs)))
 
     def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        # no gather hop: the AsyncResult concatenates chunk results
+        # driver-side, avoiding one extra serialization of every value
         refs = self._map_refs(fn, iterable, chunksize, star=False)
-
-        @_api.remote
-        def gather(*parts):
-            return list(itertools.chain.from_iterable(parts))
-
-        return AsyncResult(gather.remote(*refs))
+        return AsyncResult(refs, flatten=True)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
@@ -128,12 +149,7 @@ class Pool:
     def apply_async(self, fn: Callable, args: tuple = (),
                     kwds: dict = None) -> AsyncResult:
         self._check()
-
-        @_api.remote
-        def call(a, k):
-            return fn(*a, **(k or {}))
-
-        return AsyncResult(call.remote(args, kwds))
+        return AsyncResult(self._apply.remote(fn, args, kwds))
 
     def close(self):
         self._closed = True

@@ -113,6 +113,26 @@ def test_gen_stream_state_is_garbage_collected(rt):
     assert list(gens[0]) == []
 
 
+def test_generator_force_cancel_settles_stream(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def endless():
+        i = 0
+        while True:
+            time.sleep(0.05)
+            yield i
+            i += 1
+
+    gen = endless.remote()
+    assert ray_tpu.get(next(iter(gen)), timeout=30) == 0
+    ray_tpu.cancel(gen, force=True)
+    with pytest.raises(Exception):
+        deadline = time.time() + 30
+        for ref in gen:
+            ray_tpu.get(ref, timeout=30)
+            assert time.time() < deadline
+        raise ray_tpu.exceptions.TaskCancelledError("ended")
+
+
 # Keep last: re-creates the runtime, which invalidates the module-scoped
 # `rt` fixture for any test that would run after it.
 def test_generator_consumed_in_task_on_one_cpu():

@@ -79,3 +79,47 @@ def test_inspect_serializability_cycle(rt):
     b.lock = threading.Lock()
     ok, failures = inspect_serializability(a, name="a")
     assert not ok and failures
+
+
+def test_internal_kv_driver_and_worker(rt):
+    from ray_tpu.experimental import internal_kv as kv
+    assert kv._internal_kv_initialized()
+    assert kv._internal_kv_put("k1", b"v1") is False      # fresh key
+    assert kv._internal_kv_put("k1", b"v2", overwrite=False) is True
+    assert kv._internal_kv_get("k1") == b"v1"             # not overwritten
+    assert kv._internal_kv_put("k1", b"v3") is True
+    assert kv._internal_kv_get("k1") == b"v3"
+    assert kv._internal_kv_exists("k1")
+    # namespaces isolate
+    kv._internal_kv_put("k1", b"other", namespace="ns2")
+    assert kv._internal_kv_get("k1") == b"v3"
+    assert kv._internal_kv_get("k1", namespace="ns2") == b"other"
+    kv._internal_kv_put("pfx/a", b"1")
+    kv._internal_kv_put("pfx/b", b"2")
+    assert sorted(kv._internal_kv_list("pfx/")) == [b"pfx/a", b"pfx/b"]
+    assert kv._internal_kv_del("pfx/", del_by_prefix=True) == 2
+    assert kv._internal_kv_del("k1") == 1
+    assert not kv._internal_kv_exists("k1")
+
+    # workers reach the same table through the sys.kv channel
+    @ray_tpu.remote
+    def worker_kv():
+        from ray_tpu.experimental import internal_kv as wkv
+        wkv._internal_kv_put("from-worker", b"hello")
+        return wkv._internal_kv_get("from-worker")
+
+    assert ray_tpu.get(worker_kv.remote(), timeout=30) == b"hello"
+    from ray_tpu.experimental.internal_kv import kv_get
+    assert kv_get("from-worker") == b"hello"
+
+
+def test_tpu_accelerator_helpers(rt, monkeypatch):
+    from ray_tpu.util.accelerators import (
+        get_current_pod_name, get_current_pod_worker_count,
+        get_num_tpu_chips_on_node)
+    monkeypatch.setenv("RAY_TPU_POD_TYPE", "v5e-16")
+    monkeypatch.setenv("RAY_TPU_SLICE", "my-slice")
+    monkeypatch.setenv("RAY_TPU_CHIPS", "4")
+    assert get_current_pod_name() == "my-slice"
+    assert get_current_pod_worker_count() == 4
+    assert get_num_tpu_chips_on_node() == 4
