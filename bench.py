@@ -58,12 +58,37 @@ def _progress(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+# Child exits with this code when the TPU backend doesn't come up within
+# RAY_TPU_BENCH_TPU_INIT_TIMEOUT; the parent then retries the phase on the
+# CPU platform so a wedged tunnel (observed: jax.devices() hanging for
+# hours) degrades to labeled platform="cpu" numbers instead of nulls.
+TPU_INIT_TIMEOUT_RC = 47
+TPU_INIT_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TPU_INIT_TIMEOUT",
+                                          300))
+
+
 def _setup_jax_child() -> "tuple":
     """Child-side jax init: compilation cache + timed backend bring-up."""
+    import threading
+
+    if os.environ.get("RAY_TPU_BENCH_FORCE_CPU"):
+        from ray_tpu.util.jaxenv import force_cpu
+        force_cpu()
     import jax
     _progress("initializing jax backend (TPU tunnel init can take minutes)")
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(TPU_INIT_TIMEOUT_S):
+            _progress(f"backend init still hung after "
+                      f"{TPU_INIT_TIMEOUT_S:.0f}s (wedged TPU tunnel); "
+                      f"exiting rc={TPU_INIT_TIMEOUT_RC} for CPU fallback")
+            os._exit(TPU_INIT_TIMEOUT_RC)
+
+    threading.Thread(target=watchdog, daemon=True).start()
     t0 = time.time()
     devs = jax.devices()
+    done.set()
     _progress(f"backend up in {time.time() - t0:.1f}s: "
               f"{len(devs)}x {devs[0].platform}")
     if devs[0].platform == "tpu":
@@ -342,6 +367,7 @@ def _run_phase(phase: str, timeout_s: float) -> "tuple[dict | None, str]":
     """Run `bench.py --phase X` in a child under a hard timeout. Returns
     (result dict or None, error string)."""
     err = ""
+    force_cpu = False
     for attempt in range(1, ATTEMPTS + 1):
         remaining = TOTAL_BUDGET_S - (time.time() - _T0)
         if remaining < 60:
@@ -353,17 +379,26 @@ def _run_phase(phase: str, timeout_s: float) -> "tuple[dict | None, str]":
         timeout_s = min(timeout_s, remaining)
         if attempt > 1:
             time.sleep(10)  # TPU tunnel is single-holder; let it settle
+        env = None
+        if force_cpu:
+            from ray_tpu.util.jaxenv import subprocess_env_cpu
+            env = subprocess_env_cpu(
+                dict(os.environ, RAY_TPU_BENCH_FORCE_CPU="1"))
         _progress(f"phase {phase}: attempt {attempt}/{ATTEMPTS} "
-                  f"(timeout {timeout_s:.0f}s)")
+                  f"(timeout {timeout_s:.0f}s"
+                  f"{', cpu fallback' if force_cpu else ''})")
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--phase", phase],
                 stdout=subprocess.PIPE, stderr=None,  # stderr streams through
-                timeout=timeout_s, cwd=REPO)
+                timeout=timeout_s, cwd=REPO, env=env)
         except subprocess.TimeoutExpired:
             err = f"{phase} attempt {attempt} timed out after {timeout_s}s"
             _progress(err)
+            # a hang that even the child watchdog didn't catch: assume a
+            # wedged tunnel and fall back to CPU for the next attempt
+            force_cpu = True
             continue
         out = proc.stdout.decode(errors="replace").strip()
         if proc.returncode == 0 and out:
@@ -373,6 +408,13 @@ def _run_phase(phase: str, timeout_s: float) -> "tuple[dict | None, str]":
                 err = f"{phase} attempt {attempt}: unparseable output"
                 _progress(err + f": {out[-200:]}")
                 continue
+        if proc.returncode == TPU_INIT_TIMEOUT_RC and not force_cpu:
+            # wedged TPU tunnel: measure on the CPU platform instead of
+            # reporting nothing (results carry platform="cpu")
+            err = f"{phase}: TPU backend init timed out; retrying on CPU"
+            _progress(err)
+            force_cpu = True
+            continue
         err = (f"{phase} attempt {attempt}: rc={proc.returncode} "
                f"out={out[-200:]!r}")
         _progress(err)

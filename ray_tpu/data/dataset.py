@@ -281,6 +281,68 @@ class Dataset:
         for row in self.take(n):
             print(row)
 
+    # ---- writes (reference: Dataset.write_csv/json/numpy/parquet; one
+    # part-<i> file per block into a directory) ----
+    def _write_parts(self, path: str, ext: str, write_block) -> List[str]:
+        import os as osmod
+        osmod.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self.iter_blocks()):
+            fname = osmod.path.join(path, f"part-{i:05d}.{ext}")
+            write_block(fname, block)
+            out.append(fname)
+        return out
+
+    def write_csv(self, path: str) -> List[str]:
+        import csv as csvmod
+
+        def wb(fname, block):
+            cols = list(block.keys())
+            n = len(next(iter(block.values()))) if block else 0
+            # csv.writer quotes/escapes commas, quotes, and newlines —
+            # pairs with read_csv's csv.DictReader
+            with open(fname, "w", newline="") as f:
+                w = csvmod.writer(f)
+                w.writerow(cols)
+                for r in range(n):
+                    w.writerow([block[c][r] for c in cols])
+        return self._write_parts(path, "csv", wb)
+
+    def write_jsonl(self, path: str) -> List[str]:
+        import json as jsonmod
+
+        def wb(fname, block):
+            cols = list(block.keys())
+            n = len(next(iter(block.values()))) if block else 0
+            with open(fname, "w") as f:
+                for r in range(n):
+                    row = {c: block[c][r].item()
+                           if hasattr(block[c][r], "item")
+                           else block[c][r] for c in cols}
+                    f.write(jsonmod.dumps(row) + "\n")
+        return self._write_parts(path, "jsonl", wb)
+
+    def write_json(self, path: str) -> List[str]:
+        return self.write_jsonl(path)
+
+    def write_npy(self, path: str, column: str) -> List[str]:
+        def wb(fname, block):
+            # write through the handle: np.save(path) would append a
+            # second .npy to the part name
+            with open(fname, "wb") as f:
+                np.save(f, np.asarray(block[column]))
+        return self._write_parts(path, "npy", wb)
+
+    def write_parquet(self, path: str) -> List[str]:
+        import pyarrow as pa  # noqa: PLC0415
+        import pyarrow.parquet as pq  # noqa: PLC0415
+
+        def wb(fname, block):
+            table = pa.table({k: pa.array(np.asarray(v))
+                              for k, v in block.items()})
+            pq.write_table(table, fname)
+        return self._write_parts(path, "parquet", wb)
+
     def __repr__(self):
         stages = " -> ".join(s.name for s in self._stages) or "identity"
         return f"Dataset(source={self._source.name}, plan={stages})"

@@ -43,11 +43,15 @@ class LLMServer:
 
     def __call__(self, body: Dict[str, Any]):
         """Unary or streaming generate. body: {"prompt": [ids] | str,
-        "max_tokens": int, "temperature": float, "stream": bool}."""
+        "max_tokens": int, "temperature": float, "top_p": float,
+        "stop_token_ids": [ids], "stream": bool}."""
         prompt = self._encode(body["prompt"])
         max_tokens = body.get("max_tokens")
         temperature = float(body.get("temperature", 0.0))
-        rid = self.engine.submit(prompt, max_tokens, temperature)
+        rid = self.engine.submit(
+            prompt, max_tokens, temperature,
+            top_p=float(body.get("top_p", 1.0)),
+            stop_token_ids=body.get("stop_token_ids"))
         if body.get("stream"):
             def gen():
                 for tok in self.engine.stream(rid):

@@ -12,9 +12,10 @@ paged KV, streaming) — re-designed TPU-first:
 * Prefill: prompts are padded to power-of-two buckets -> a handful of
   compiles total; KV is written straight into the request's slot via
   dynamic_update_slice.
-* Sampling (greedy / temperature / top-k) happens on-device inside the
-  jitted step; only the sampled token ids (max_slots int32) cross to host
-  per step.
+* Sampling (greedy / temperature / global top-k / per-request nucleus
+  top-p) happens on-device inside the jitted step; only the sampled
+  token ids (max_slots int32) cross to host per step. Per-request stop
+  token ids terminate a stream like EOS.
 * Pipelined host loop: the loop runs `pipeline_depth` decode steps AHEAD
   of the host-side token fetch, with device->host copies started
   asynchronously (`copy_to_host_async`) at dispatch time. The device
@@ -71,6 +72,8 @@ class _Request:
     prompt: np.ndarray              # (P,) int32
     max_new_tokens: int
     temperature: float
+    top_p: float = 1.0
+    stop_ids: frozenset = frozenset()
     out_queue: queue_mod.Queue = field(
         default_factory=lambda: queue_mod.Queue(maxsize=4096))
     slot: int = -1
@@ -124,6 +127,7 @@ class LLMEngine:
         self._rng_key = jax.random.PRNGKey(0)
         self._mask_dev = None
         self._temps_dev = None
+        self._top_ps_dev = None
         self._mask_dirty = True
         self._shutdown = threading.Event()
         self.stats = {"prefills": 0, "decode_steps": 0,
@@ -144,8 +148,41 @@ class LLMEngine:
         self._loop_thread.start()
 
     # ---- jitted kernels ---------------------------------------------------
+    def _sample_tokens(self, logits, temps, top_ps, rng_key):
+        """Sample per row of logits (N, V): greedy when temp==0, else
+        temperature + optional global top-k + per-row nucleus top-p.
+        All on device; returns (N,) int32."""
+        jnp = self._jnp
+        jax = self._jax
+        if self.cfg.top_k and self.cfg.top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        greedy = jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+
+        def nucleus(scaled):
+            # smallest prefix of the prob-sorted vocab whose mass reaches
+            # top_p (always keeps the argmax)
+            n, _v = scaled.shape
+            sort_idx = jnp.argsort(-scaled, axis=-1)
+            sorted_probs = jax.nn.softmax(
+                jnp.take_along_axis(scaled, sort_idx, axis=-1), axis=-1)
+            cum = jnp.cumsum(sorted_probs, axis=-1)
+            keep_sorted = (cum - sorted_probs) < top_ps[:, None]
+            keep = jnp.zeros_like(keep_sorted).at[
+                jnp.arange(n)[:, None], sort_idx].set(keep_sorted)
+            use_top_p = (top_ps < 1.0)[:, None]
+            return jnp.where(use_top_p & ~keep, -jnp.inf, scaled)
+
+        # the full-vocab sort only runs when some active request asked
+        # for top_p < 1 — the default path stays argmax + categorical
+        scaled = jax.lax.cond(jnp.any(top_ps < 1.0), nucleus,
+                              lambda s: s, scaled)
+        sampled = jax.random.categorical(rng_key, scaled, axis=-1)
+        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
     def _prefill_impl(self, params, cache, tokens, slot, true_len, temp,
-                      rng_key, pad_len: int):
+                      top_p, rng_key, pad_len: int):
         """Run the prompt through the model writing KV into `slot`, and
         sample the first generated token ON DEVICE (no host sync).
         tokens: (1, pad_len); returns (token () int32, cache')."""
@@ -168,17 +205,12 @@ class LLMEngine:
             lens = lens.at[slot].set(true_len)
             out_cache.append((ck, cv, lens))
         last = logits[0, true_len - 1]
-        if self.cfg.top_k and self.cfg.top_k > 0:
-            kth = jnp.sort(last)[-self.cfg.top_k]
-            last = jnp.where(last < kth, -jnp.inf, last)
-        greedy = jnp.argmax(last)
-        sampled = jax.random.categorical(
-            rng_key, last / jnp.maximum(temp, 1e-6))
-        tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+        tok = self._sample_tokens(last[None, :], temp[None], top_p[None],
+                                  rng_key)[0]
         return tok, out_cache
 
     def _prefill_batch_impl(self, params, cache, tokens, slots, true_lens,
-                            temps, rng_key, pad_len: int):
+                            temps, top_ps, rng_key, pad_len: int):
         """Prefill G prompts of one length bucket in a single model pass.
         tokens: (G, pad_len); slots/true_lens/temps: (G,). Padding rows
         target the scratch slot. Returns (tokens (G,) int32, cache')."""
@@ -205,17 +237,11 @@ class LLMEngine:
             lens = lens.at[slots].set(true_lens)
             out_cache.append((ck, cv, lens))
         last = logits[jnp.arange(g), true_lens - 1]          # (G, V)
-        if self.cfg.top_k and self.cfg.top_k > 0:
-            kth = jnp.sort(last, axis=-1)[:, -self.cfg.top_k][:, None]
-            last = jnp.where(last < kth, -jnp.inf, last)
-        greedy = jnp.argmax(last, axis=-1)
-        sampled = jax.random.categorical(
-            rng_key, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
-        toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        toks = self._sample_tokens(last, temps, top_ps, rng_key)
         return toks, out_cache
 
     def _decode_impl(self, params, cache, last_tokens, active_mask,
-                     temps, rng_key):
+                     temps, top_ps, rng_key):
         """One decode step for every slot. Returns (next_tokens (S,),
         cache'). Inactive slots' lengths are restored so their state
         never drifts."""
@@ -231,18 +257,12 @@ class LLMEngine:
         for (ck, cv, lens) in new_cache:
             lens = jnp.where(active_mask, lens, old_lengths)
             fixed.append((ck, cv, lens))
-        if self.cfg.top_k and self.cfg.top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        greedy = jnp.argmax(logits, axis=-1)
-        sampled = jax.random.categorical(
-            rng_key, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
-        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        nxt = self._sample_tokens(logits, temps, top_ps, rng_key)
         nxt = jnp.where(active_mask, nxt, last_tokens)
         return nxt, fixed
 
     def _decode_block_impl(self, params, cache, last_tokens, active_mask,
-                           temps, rng_key):
+                           temps, top_ps, rng_key):
         """decode_block fused steps under one dispatch (lax.scan).
         Returns (tokens (K, S), cache', last_tokens'). Host-side
         termination decisions lag up to K-1 extra tokens; drain guards
@@ -253,7 +273,8 @@ class LLMEngine:
         def body(carry, key):
             cache, last = carry
             nxt, cache = self._decode_impl(params, cache, last,
-                                           active_mask, temps, key)
+                                           active_mask, temps, top_ps,
+                                           key)
             return (cache, nxt), nxt
 
         (cache, last), toks = jax.lax.scan(body, (cache, last_tokens),
@@ -262,10 +283,13 @@ class LLMEngine:
 
     # ---- public API -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               temperature: float = 0.0) -> str:
+               temperature: float = 0.0, top_p: float = 1.0,
+               stop_token_ids=None) -> str:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         self._bucket(prompt.size)  # validate in the caller, not the loop
         budget = max_new_tokens or self.cfg.max_new_tokens_default
         if prompt.size + budget > self.cfg.max_seq_len:
@@ -276,7 +300,8 @@ class LLMEngine:
                     f"{self.cfg.max_seq_len}")
         req = _Request(request_id=f"req-{next(self._req_counter)}",
                        prompt=prompt, max_new_tokens=budget,
-                       temperature=temperature)
+                       temperature=temperature, top_p=float(top_p),
+                       stop_ids=frozenset(stop_token_ids or ()))
         with self._lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -299,8 +324,10 @@ class LLMEngine:
             self._requests.pop(request_id, None)
 
     def generate_sync(self, prompt_ids, max_new_tokens=None,
-                      temperature: float = 0.0) -> List[int]:
-        rid = self.submit(prompt_ids, max_new_tokens, temperature)
+                      temperature: float = 0.0, top_p: float = 1.0,
+                      stop_token_ids=None) -> List[int]:
+        rid = self.submit(prompt_ids, max_new_tokens, temperature,
+                          top_p=top_p, stop_token_ids=stop_token_ids)
         return list(self.stream(rid))
 
     def get_stats(self) -> Dict[str, Any]:
@@ -361,7 +388,8 @@ class LLMEngine:
                 tok_dev, self._cache = self._prefill_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.int32(slot), jnp.int32(req.prompt.size),
-                    jnp.float32(req.temperature), sub, pad_len=pad_len)
+                    jnp.float32(req.temperature),
+                    jnp.float32(req.top_p), sub, pad_len=pad_len)
                 toks_dev = tok_dev[None]
             else:
                 g = 1
@@ -371,15 +399,18 @@ class LLMEngine:
                 slots = np.full((g,), self._scratch_slot, np.int32)
                 lens = np.ones((g,), np.int32)
                 temps = np.zeros((g,), np.float32)
+                top_ps = np.ones((g,), np.float32)
                 for i, (req, slot) in enumerate(members):
                     tokens[i, :req.prompt.size] = req.prompt
                     slots[i] = slot
                     lens[i] = req.prompt.size
                     temps[i] = req.temperature
+                    top_ps[i] = req.top_p
                 toks_dev, self._cache = self._prefill_batch_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.asarray(slots), jnp.asarray(lens),
-                    jnp.asarray(temps), sub, pad_len=pad_len)
+                    jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                    pad_len=pad_len)
                 toks_dev = toks_dev[:g_real]
             real_slots = jnp.asarray(
                 np.asarray([s for _, s in members], np.int32))
@@ -413,9 +444,10 @@ class LLMEngine:
         if req.first_token_ts is None:
             req.first_token_ts = time.time()
         req.out_queue.put(("token", tok))
-        if (self.cfg.eos_token_id is not None
-                and tok == self.cfg.eos_token_id):
-            req.max_new_tokens = req.generated  # finish after EOS
+        if ((self.cfg.eos_token_id is not None
+             and tok == self.cfg.eos_token_id)
+                or tok in req.stop_ids):
+            req.max_new_tokens = req.generated  # finish after EOS/stop
 
     def _release(self, req: _Request):
         req.out_queue.put(_END)
@@ -426,19 +458,22 @@ class LLMEngine:
             req.slot = -1
 
     def _device_mask_temps(self):
-        """(active_mask, temps) as device arrays, rebuilt only when the
-        active set changed — not every step."""
+        """(active_mask, temps, top_ps) as device arrays, rebuilt only
+        when the active set changed — not every step."""
         if self._mask_dirty or self._mask_dev is None:
             S = self._n_slots
             mask = np.zeros((S,), bool)
             temps = np.zeros((S,), np.float32)
+            top_ps = np.ones((S,), np.float32)
             for slot, req in self._active.items():
                 mask[slot] = True
                 temps[slot] = req.temperature
+                top_ps[slot] = req.top_p
             self._mask_dev = self._jnp.asarray(mask)
             self._temps_dev = self._jnp.asarray(temps)
+            self._top_ps_dev = self._jnp.asarray(top_ps)
             self._mask_dirty = False
-        return self._mask_dev, self._temps_dev
+        return self._mask_dev, self._temps_dev, self._top_ps_dev
 
     def _drain_one(self, inflight):
         """Fetch the oldest in-flight result and emit its tokens.
@@ -486,18 +521,18 @@ class LLMEngine:
             try:
                 self._admit_all(inflight)
                 if self._active:
-                    mask, temps = self._device_mask_temps()
+                    mask, temps, top_ps = self._device_mask_temps()
                     self._rng_key, sub = self._jax.random.split(
                         self._rng_key)
                     snapshot = list(self._active.items())
                     if self._decode_block_jit is not None:
                         toks, self._cache, last = self._decode_block_jit(
                             self.params, self._cache, self._last_tokens,
-                            mask, temps, sub)
+                            mask, temps, top_ps, sub)
                     else:
                         toks, self._cache = self._decode_jit(
                             self.params, self._cache, self._last_tokens,
-                            mask, temps, sub)
+                            mask, temps, top_ps, sub)
                         last = toks
                     self._last_tokens = last
                     self._start_fetch(toks)

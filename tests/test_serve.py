@@ -441,3 +441,33 @@ def test_batched_prefill_matches_serial():
         eng.shutdown()
     assert outs[1] == outs[4], (outs[1], outs[4])
     assert all(len(o) == 6 for o in outs[4])
+
+
+def test_llm_engine_top_p_and_stop_ids(tiny_llm):
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=4, max_seq_len=128, prefill_buckets=(16,)))
+    try:
+        prompt = np.arange(1, 6) % 128
+        # top_p=tiny -> nucleus collapses to argmax == greedy output
+        greedy = eng.generate_sync(prompt, max_new_tokens=6,
+                                   temperature=0.0)
+        nucleus = eng.generate_sync(prompt, max_new_tokens=6,
+                                    temperature=0.8, top_p=1e-6)
+        assert nucleus == greedy
+        # sampling with top_p in range stays within the vocab
+        toks = eng.generate_sync(prompt, max_new_tokens=6,
+                                 temperature=1.0, top_p=0.9)
+        assert len(toks) == 6 and all(0 <= t < 128 for t in toks)
+        # a stop id ends the stream the moment it is produced
+        stop = greedy[2]
+        stopped = eng.generate_sync(prompt, max_new_tokens=6,
+                                    temperature=0.0,
+                                    stop_token_ids=[stop])
+        assert stopped == greedy[:3]
+        # invalid top_p rejected at submit
+        with pytest.raises(ValueError):
+            eng.submit(prompt, top_p=0.0)
+    finally:
+        eng.shutdown()
