@@ -28,6 +28,8 @@ from .utils import prepare_module, prepare_loader
 from . import adapters  # noqa: F401  (lazy torch/transformers inside)
 
 from .multihost import MultiHostSpmd
+from .lora import (LoraConfig, init_lora, merge_lora, lora_param_count,
+                   make_lora_train_step)
 
 __all__ = [
     "MultiHostSpmd",
@@ -39,4 +41,6 @@ __all__ = [
     "TrainContext", "Checkpoint", "CheckpointManager", "save_pytree",
     "restore_pytree", "Result", "JaxTrainer", "SpmdTrainer",
     "SpmdTrainerConfig",
+    "LoraConfig", "init_lora", "merge_lora", "lora_param_count",
+    "make_lora_train_step",
 ]

@@ -1,0 +1,153 @@
+"""LoRA adapters, parameter-functional and model-agnostic.
+
+Reference context: the fork's LLM post-training focus (GRPO/RLHF on the
+serve engine). Rather than wrapping module classes (the torch/PEFT
+idiom), adapters here are a separate small pytree over the FROZEN base
+params: for every targeted 2-D Dense kernel `.../<target>/kernel`
+(shape (d_in, d_out)) we hold A:(d_in, r) and B:(r, d_out), and
+`merge_lora` produces `kernel + (alpha/r) * A @ B` as a pure function.
+Under jit the merge fuses into the forward; grads flow only through the
+adapter leaves, so optimizer state is O(adapter), not O(model), and the
+base params can stay sharded exactly as the pretrained checkpoint was.
+
+Typical use:
+    lora = init_lora(params, rng, rank=8)
+    init = make_lora_train_step(model, tx, mesh, params)
+    state, step = init(example_batch, lora)
+    state, metrics = step(state, batch)
+    merged = merge_lora(params, state.params)   # deploy/serve
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                   "gate_proj", "up_proj", "down_proj",
+                   "qkv", "proj", "fc1", "fc2")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Sequence[str] = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _is_target(path: Tuple, leaf, targets: Sequence[str]) -> bool:
+    keys = [getattr(k, "key", str(k)) for k in path]
+    return (len(keys) >= 2 and keys[-1] == "kernel"
+            and keys[-2] in targets and getattr(leaf, "ndim", 0) == 2)
+
+
+def _is_adapter_node(x) -> bool:
+    """Leaf predicate for adapter pytrees: an {"A","B"} pair or an
+    untargeted position (None)."""
+    return x is None or (isinstance(x, dict) and set(x) == {"A", "B"})
+
+
+def init_lora(params, rng, rank: int = 8, alpha: float = 16.0,
+              targets: Sequence[str] = DEFAULT_TARGETS) -> Dict[str, Any]:
+    """Adapter pytree mirroring `params`: an {"A","B"} pair at each
+    targeted kernel, None elsewhere. A ~ N(0, 1/rank) fp32, B = 0, so
+    the merged model starts exactly at the base model. The returned
+    dict also carries the (static) scaling config."""
+    cfg = LoraConfig(rank=rank, alpha=alpha, targets=tuple(targets))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = jax.random.split(rng, max(len(flat), 1))
+
+    def make(path, leaf, key):
+        if not _is_target(path, leaf, cfg.targets):
+            return None
+        d_in, _d_out = leaf.shape
+        a = jax.random.normal(key, (d_in, cfg.rank),
+                              jnp.float32) / cfg.rank
+        b = jnp.zeros((cfg.rank, leaf.shape[1]), jnp.float32)
+        return {"A": a, "B": b}
+
+    leaves = [make(path, leaf, keys[i])
+              for i, (path, leaf) in enumerate(flat)]
+    adapters = jax.tree_util.tree_unflatten(treedef, leaves)
+    if all(x is None for x in leaves):
+        raise ValueError(f"no LoRA targets matched; targets={cfg.targets}")
+    return {"rank": cfg.rank, "alpha": cfg.alpha, "adapters": adapters}
+
+
+def merge_lora(params, lora) -> Any:
+    """params with every adapted kernel replaced by
+    kernel + scaling * A @ B (pure; jit/grad-safe)."""
+    scaling = lora["alpha"] / lora["rank"]
+
+    def merge(ad, p):
+        if ad is None:
+            return p
+        delta = (ad["A"] @ ad["B"]) * scaling
+        return p + delta.astype(p.dtype)
+
+    # walk the ADAPTER tree (its leaves are the {"A","B"}/None markers)
+    # and flatten params up to it — params' kernels sit exactly at those
+    # positions.
+    return jax.tree_util.tree_map(merge, lora["adapters"], params,
+                                  is_leaf=_is_adapter_node)
+
+
+def lora_param_count(lora) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(
+        lora["adapters"]))
+
+
+def make_lora_train_step(model, tx, mesh, base_params, *,
+                         loss_fn: Optional[Callable] = None):
+    """Like train.make_train_step but ONLY the adapter leaves train: the
+    base params ride along frozen (closed over, keeping whatever
+    shardings they already have) and the TrainState/opt-state hold just
+    the adapter pytree.
+
+    Returns init_fn; init_fn(example_batch, lora) ->
+    (TrainState over adapters, step(state, batch))."""
+    from .spmd import TrainState, next_token_loss
+    from ..parallel.sharding import replicated
+
+    loss_fn = loss_fn or partial(next_token_loss, model.apply)
+
+    def init_fn(example_batch, lora):
+        del example_batch  # shapes come from the batch at call time
+        scaling_cfg = {"rank": lora["rank"], "alpha": lora["alpha"]}
+
+        def raw_step(state: TrainState, batch):
+            def lora_loss(adapters):
+                merged = merge_lora(base_params,
+                                    {**scaling_cfg, "adapters": adapters})
+                return loss_fn(merged, batch)
+
+            (_loss, metrics), grads = jax.value_and_grad(
+                lora_loss, has_aux=True)(state.params)
+            updates, new_opt = tx.update(grads, state.opt_state,
+                                         state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return TrainState(step=state.step + 1, params=new_params,
+                              opt_state=new_opt), metrics
+
+        state = TrainState.create(lora["adapters"], tx)
+        # adapters are small: replicate them over the mesh; the frozen
+        # base keeps its own (fsdp/tp) shardings untouched
+        state = jax.device_put(state, replicated(mesh))
+        step_fn = jax.jit(raw_step, donate_argnums=(0,))
+        return state, step_fn
+
+    return init_fn
+
+
+__all__ = ["LoraConfig", "init_lora", "merge_lora", "lora_param_count",
+           "make_lora_train_step", "DEFAULT_TARGETS"]

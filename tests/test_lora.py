@@ -1,0 +1,70 @@
+"""LoRA adapter tests (train/lora.py): adapters train while the base
+stays frozen; merge is exact; zero-init B means merged == base at
+step 0."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import Llama, LlamaConfig
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.train import (init_lora, merge_lora, lora_param_count,
+                           make_lora_train_step, make_optimizer)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=32, remat=False,
+                      dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return cfg, model, params
+
+
+def test_init_targets_and_zero_start(tiny):
+    cfg, model, params = tiny
+    lora = init_lora(params, jax.random.PRNGKey(1), rank=4)
+    n = lora_param_count(lora)
+    assert n > 0
+    total = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    assert n < total                # strictly smaller than the model
+    # B=0 -> merged == base exactly
+    merged = merge_lora(params, lora)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(merged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unmatched_targets_raise(tiny):
+    _, _, params = tiny
+    with pytest.raises(ValueError):
+        init_lora(params, jax.random.PRNGKey(1), targets=("nope",))
+
+
+def test_lora_train_only_moves_adapters(tiny):
+    cfg, model, params = tiny
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    tx = make_optimizer("adamw", learning_rate=1e-2)
+    lora = init_lora(params, jax.random.PRNGKey(1), rank=4,
+                     targets=("q_proj", "v_proj"))
+    rng = np.random.RandomState(0)
+    batch = {"tokens": jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (4, 17)), jnp.int32)}
+    init = make_lora_train_step(model, tx, mesh, params)
+    state, step = init(batch, lora)
+    losses = []
+    for _ in range(8):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.05, losses
+    # adapters moved; merged differs from base now
+    merged = merge_lora(params, {"rank": 4, "alpha": 16.0,
+                                 "adapters": state.params})
+    diffs = [float(jnp.abs(a - b).max())
+             for a, b in zip(jax.tree_util.tree_leaves(params),
+                             jax.tree_util.tree_leaves(merged))]
+    assert max(diffs) > 0
+    # merged model evaluates with the trained adapters (sanity forward)
+    logits, _ = model.apply({"params": merged}, batch["tokens"])
+    assert np.isfinite(np.asarray(logits)).all()
