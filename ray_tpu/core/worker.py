@@ -369,8 +369,14 @@ class WorkerLoop:
     def _dispatch_actor_task(self, spec: TaskSpec) -> None:
         import inspect  # noqa: PLC0415
         method = getattr(self._actor_instance, spec.method_name, None)
-        if method is not None and inspect.iscoroutinefunction(
-                getattr(method, "__func__", method)):
+        fn = getattr(method, "__func__", method)
+        if method is not None and inspect.isasyncgenfunction(fn):
+            # async streaming method: iterate on the actor's event loop
+            self._ensure_async_loop()
+            import asyncio  # noqa: PLC0415
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_asyncgen(spec), self._async_loop)
+        elif method is not None and inspect.iscoroutinefunction(fn):
             self._ensure_async_loop()
             import asyncio  # noqa: PLC0415
             asyncio.run_coroutine_threadsafe(
@@ -380,22 +386,28 @@ class WorkerLoop:
         else:
             self._run_actor_task(spec)
 
+    def _put_gen_item(self, spec: TaskSpec, item) -> None:
+        """Seal one streamed item and announce it to the driver (the
+        single definition of the gen_item protocol — sync and async
+        generator paths both go through here)."""
+        from .ids import new_object_id  # noqa: PLC0415
+        from .spilling import put_value_or_spill  # noqa: PLC0415
+        oid = new_object_id()
+        loc = put_value_or_spill(self.store, oid, item)
+        self.conn.send(("gen_item", spec.task_id, oid, loc))
+
     def _stream_items(self, spec: TaskSpec, iterable) -> bool:
         """Put each yielded item and announce it to the driver in order
         (streaming-generator tasks, num_returns="streaming"). Returns
         True if the task was cancelled mid-stream (the generator is
         closed and no further items are emitted)."""
-        from .ids import new_object_id  # noqa: PLC0415
-        from .spilling import put_value_or_spill  # noqa: PLC0415
         for item in iterable:
             if spec.task_id in self._cancelled:
                 close = getattr(iterable, "close", None)
                 if close:
                     close()
                 return True
-            oid = new_object_id()
-            loc = put_value_or_spill(self.store, oid, item)
-            self.conn.send(("gen_item", spec.task_id, oid, loc))
+            self._put_gen_item(spec, item)
         return False
 
     def _run_actor_task(self, spec: TaskSpec) -> None:
@@ -414,6 +426,32 @@ class WorkerLoop:
             err = TaskError(repr(e), traceback.format_exc(),
                             f"{type(self._actor_instance).__name__}."
                             f"{spec.method_name}")
+            self.conn.send(("task_done", spec.task_id, [], err))
+
+    async def _run_actor_task_asyncgen(self, spec: TaskSpec) -> None:
+        """Streaming from an `async def ... yield` actor method. Requires
+        num_returns=\"streaming\" on the call (enforced below — a plain
+        call would otherwise try to seal an async_generator object)."""
+        try:
+            method = getattr(self._actor_instance, spec.method_name)
+            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
+            agen = method(*args, **kwargs)
+            if not getattr(spec, "streaming", False):
+                raise TypeError(
+                    f"{spec.method_name} is an async generator; call it "
+                    "with num_returns=\"streaming\"")
+            cancelled = False
+            async for item in agen:
+                if spec.task_id in self._cancelled:
+                    cancelled = True
+                    await agen.aclose()
+                    break
+                self._put_gen_item(spec, item)
+            self.conn.send(("task_done", spec.task_id, [],
+                            "cancelled" if cancelled else None))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(),
+                            f"asyncgen.{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
 
     async def _run_actor_task_async(self, spec: TaskSpec) -> None:

@@ -74,18 +74,26 @@ def _flatten_app(app: Application, app_name: str,
     return DeploymentHandle(d.name, app_name)
 
 
+_ROUTE_UNSET = object()
+
+
 def run(target: Application, *, name: str = _DEFAULT_APP,
-        route_prefix: Optional[str] = "/", blocking: bool = False,
+        route_prefix=_ROUTE_UNSET, blocking: bool = False,
         _local_testing_mode: bool = False,
         wait_for_ready_timeout_s: float = 60.0) -> DeploymentHandle:
-    """Deploy an application; returns a handle to its ingress."""
+    """Deploy an application; returns a handle to its ingress.
+
+    route_prefix overrides the ingress deployment's own prefix only when
+    passed explicitly — apps built with a baked-in prefix (e.g.
+    build_openai_deployment's "/v1") keep it by default.
+    """
     import ray_tpu
     if isinstance(target, Deployment):
         target = target.bind()
     if not isinstance(target, Application):
         raise TypeError(f"serve.run expects an Application (from .bind()); "
                         f"got {type(target)}")
-    if route_prefix is not None:
+    if route_prefix is not _ROUTE_UNSET and route_prefix is not None:
         ingress_d = target.deployment
         if ingress_d.route_prefix != route_prefix:
             target = Application(

@@ -82,8 +82,13 @@ class HTTPProxy:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._respond(400, json.dumps({"error": repr(e)}))
                     return
-                wants_stream = "text/event-stream" in (
+                # SSE when the client asks via Accept OR via the
+                # OpenAI-style {"stream": true} body field
+                wants_stream = ("text/event-stream" in (
                     self.headers.get("Accept") or "")
+                    or (isinstance(body, dict) and bool(
+                        body.get("stream"))))
+                headers_sent = False
                 try:
                     if wants_stream:
                         gen = handle.options(stream=True).remote(body)
@@ -93,15 +98,20 @@ class HTTPProxy:
                         self.send_header("Cache-Control", "no-cache")
                         self.send_header("Transfer-Encoding", "chunked")
                         self.end_headers()
-                        for chunk in gen:
-                            payload, _ = self._serialize(chunk)
-                            if isinstance(payload, str):
-                                payload = payload.encode()
+                        headers_sent = True
+
+                        def emit(payload: bytes):
                             event = b"data: " + payload + b"\n\n"
                             self.wfile.write(
                                 f"{len(event):x}\r\n".encode()
                                 + event + b"\r\n")
                             self.wfile.flush()
+
+                        for chunk in gen:
+                            payload, _ = self._serialize(chunk)
+                            if isinstance(payload, str):
+                                payload = payload.encode()
+                            emit(payload)
                         self.wfile.write(b"0\r\n\r\n")
                     else:
                         result = handle.remote(body).result(timeout_s=60)
@@ -109,7 +119,16 @@ class HTTPProxy:
                         self._respond(200, payload, ctype)
                 except Exception as e:  # noqa: BLE001
                     try:
-                        self._respond(500, json.dumps({"error": repr(e)}))
+                        if headers_sent:
+                            # mid-stream failure: a second status line
+                            # would corrupt the chunked body — emit one
+                            # final error event and terminate the stream
+                            emit(json.dumps(
+                                {"error": repr(e)}).encode())
+                            self.wfile.write(b"0\r\n\r\n")
+                        else:
+                            self._respond(500,
+                                          json.dumps({"error": repr(e)}))
                     except Exception:  # noqa: BLE001  client went away
                         pass
 

@@ -76,15 +76,27 @@ class LLMServer:
 def build_llm_deployment(model_factory, *, engine_config=None,
                          tokenizer=None, name: str = "LLMServer",
                          num_replicas: int = 1,
-                         max_ongoing_requests: int = 32) -> Application:
+                         max_ongoing_requests: int = 32,
+                         server_cls=None, server_kwargs=None,
+                         route_prefix: str = "/") -> Application:
     """Build a ready-to-run LLM serving app:
-    `serve.run(build_llm_deployment(factory))`."""
+    `serve.run(build_llm_deployment(factory))`. `server_cls` swaps the
+    deployment class (e.g. openai_api.OpenAIServer)."""
     dep = deployment_decorator(
-        LLMServer, name=name, num_replicas=num_replicas,
-        max_ongoing_requests=max_ongoing_requests)
+        server_cls or LLMServer, name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        route_prefix=route_prefix)
     return dep.bind(model_factory, engine_config=engine_config,
-                    tokenizer=tokenizer)
+                    tokenizer=tokenizer, **(server_kwargs or {}))
+
+
+def __getattr__(name):
+    if name in ("OpenAIServer", "build_openai_deployment"):
+        from . import openai_api
+        return getattr(openai_api, name)
+    raise AttributeError(name)
 
 
 __all__ = ["LLMEngine", "LLMEngineConfig", "LLMServer",
-           "build_llm_deployment"]
+           "build_llm_deployment", "OpenAIServer",
+           "build_openai_deployment"]

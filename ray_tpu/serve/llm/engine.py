@@ -323,6 +323,16 @@ class LLMEngine:
         with self._lock:
             self._requests.pop(request_id, None)
 
+    def abort(self, request_id: str) -> None:
+        """Best-effort early termination: the request's budget collapses
+        to what it has already generated, so the engine releases its slot
+        at the next drain. The consumer should keep draining its stream
+        to the end marker (a few lagged tokens may still arrive)."""
+        req = self._requests.get(request_id)
+        if req is not None:
+            req.max_new_tokens = min(req.max_new_tokens,
+                                     max(req.generated, 1))
+
     def generate_sync(self, prompt_ids, max_new_tokens=None,
                       temperature: float = 0.0, top_p: float = 1.0,
                       stop_token_ids=None) -> List[int]:
@@ -507,8 +517,14 @@ class LLMEngine:
         self.stats["decode_steps"] += rows.shape[0]
         for row in rows:
             for slot, req in payload:
-                if req.slot != slot or req.generated >= req.max_new_tokens:
-                    continue  # finished/reused slot: lagged, discard
+                if req.slot != slot:
+                    continue  # released/reused slot: lagged, discard
+                if req.generated >= req.max_new_tokens:
+                    # budget shrank out-of-band (abort()): no further
+                    # token will cross the threshold inside _emit, so
+                    # release here or the slot decodes forever
+                    self._release(req)
+                    continue
                 self._emit(req, int(row[slot]))
                 full = (req.prompt.size + req.generated
                         >= self.cfg.max_seq_len)

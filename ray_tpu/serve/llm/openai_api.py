@@ -1,0 +1,256 @@
+"""OpenAI-compatible serving surface over the LLM engine.
+
+Reference parity: the fork's serve.llm OpenAI-compatible router (vLLM's
+/v1/completions and /v1/chat/completions). Deploy with
+`build_openai_deployment(...)` at route_prefix="/v1"; the proxy routes
+any /v1/* POST here and the body shape picks the API:
+
+    {"prompt": ...}    -> completions
+    {"messages": ...}  -> chat completions
+
+Streaming follows the OpenAI contract: `"stream": true` returns SSE
+`data:` chunks — for chat a leading {"delta": {"role": "assistant"}}
+chunk, then content deltas, then a final chunk carrying finish_reason,
+then `data: [DONE]`. `stop` accepts a string or a list of strings/ids;
+single-token stop strings also stop generation inside the engine, and
+every stop string is enforced host-side on the decoded text (so
+multi-token sequences work too).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..deployment import Application
+from . import LLMServer, build_llm_deployment
+
+_req_ids = itertools.count()
+
+
+class OpenAIServer(LLMServer):
+    """LLMServer speaking the OpenAI REST schema."""
+
+    def __init__(self, model_factory, engine_config: Optional[dict] = None,
+                 tokenizer: Optional[Any] = None,
+                 model_name: str = "ray-tpu-llm"):
+        super().__init__(model_factory, engine_config, tokenizer)
+        self.model_name = model_name
+
+    # ---- request plumbing -------------------------------------------------
+    def _sampling(self, body: Dict[str, Any], prompt_len: int
+                  ) -> Tuple[Dict[str, Any], List[str], int]:
+        """(engine submit kwargs, host-side stop strings, effective max
+        new tokens after the engine's seq-budget clamp)."""
+        stop = body.get("stop") or []
+        if isinstance(stop, (str, int)):
+            stop = [stop]
+        stop_ids: List[int] = []
+        stop_strings: List[str] = []
+        for s in stop:
+            if isinstance(s, int):
+                stop_ids.append(s)
+                continue
+            stop_strings.append(s)
+            if self.tokenizer is not None:
+                ids = self.tokenizer.encode(s)
+                if len(ids) == 1:
+                    # single-token stops can end generation on-engine;
+                    # longer ones rely on the host-side text match
+                    stop_ids.append(ids[0])
+        requested = body.get("max_tokens")
+        cfg = self.engine.cfg
+        effective = min(requested or cfg.max_new_tokens_default,
+                        max(cfg.max_seq_len - prompt_len, 0))
+        kwargs = dict(
+            max_new_tokens=requested,
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            stop_token_ids=stop_ids or None)
+        return kwargs, stop_strings, effective
+
+    def _chat_prompt(self, messages: List[Dict[str, str]]):
+        tok = self.tokenizer
+        if tok is not None and hasattr(tok, "apply_chat_template"):
+            return tok.apply_chat_template(messages,
+                                           add_generation_prompt=True)
+        if tok is None:
+            raise ValueError("chat API needs a tokenizer "
+                             "(set tokenizer= on the deployment)")
+        text = "".join(f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+                       for m in messages) + "assistant:"
+        return tok.encode(text)
+
+    def _decode_text(self, toks: List[int]) -> str:
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(toks)
+        return " ".join(str(t) for t in toks)
+
+    @staticmethod
+    def _apply_stops(text: str, stops: List[str]) -> Tuple[str, bool]:
+        """Truncate at the earliest stop-string occurrence."""
+        cut = None
+        for s in stops:
+            if not s:
+                continue
+            i = text.find(s)
+            if i >= 0 and (cut is None or i < cut):
+                cut = i
+        return (text[:cut], True) if cut is not None else (text, False)
+
+    def _finish_reason(self, n_out: int, effective: int, last_tok,
+                       stop_ids, stopped_by_string: bool) -> str:
+        if stopped_by_string:
+            return "stop"
+        if last_tok is not None and (
+                last_tok == self.engine.cfg.eos_token_id
+                or (stop_ids and last_tok in stop_ids)):
+            return "stop"
+        return "length" if n_out >= effective else "stop"
+
+    def _collect(self, rid: str, stops: List[str]
+                 ) -> Tuple[List[int], str, bool]:
+        """Drain a request, aborting early when a stop string lands."""
+        toks: List[int] = []
+        text, by_string = "", False
+        for tok in self.engine.stream(rid):
+            if by_string:
+                continue  # draining to the end marker post-abort
+            toks.append(tok)
+            text, by_string = self._apply_stops(
+                self._decode_text(toks), stops)
+            if by_string:
+                self.engine.abort(rid)
+        return toks, text, by_string
+
+    # ---- the two APIs -----------------------------------------------------
+    def __call__(self, body: Dict[str, Any]):
+        try:
+            if isinstance(body, dict) and "messages" in body:
+                return self._chat(body)
+            if isinstance(body, dict) and "prompt" in body:
+                return self._completions(body)
+        except ValueError as e:
+            # invalid request (bad top_p, prompt too long for the
+            # configured buckets, ...) -> OpenAI error object, not a 500
+            err = {"error": {"message": str(e),
+                             "type": "invalid_request_error"}}
+            if isinstance(body, dict) and body.get("stream"):
+                # a real generator: the replica's streaming path detects
+                # generators, not arbitrary iterators
+                def err_stream():
+                    yield err
+                    yield "[DONE]"
+                return err_stream()
+            return err
+        return super().__call__(body)
+
+    def _completions(self, body: Dict[str, Any]):
+        prompt = self._encode(body["prompt"])
+        sp, stops, effective = self._sampling(body, len(prompt))
+        rid = self.engine.submit(prompt, **sp)
+        oid = f"cmpl-{next(_req_ids)}"
+        if body.get("stream"):
+            return self._stream_events(
+                rid, oid, "text_completion", stops, effective,
+                sp["stop_token_ids"],
+                content_chunk=lambda text: {"text": text},
+                final_extra=lambda: {"text": ""})
+        toks, text, by_string = self._collect(rid, stops)
+        return {
+            "id": oid, "object": "text_completion",
+            "created": int(time.time()), "model": self.model_name,
+            "choices": [{
+                "index": 0, "text": text,
+                "finish_reason": self._finish_reason(
+                    len(toks), effective, toks[-1] if toks else None,
+                    sp["stop_token_ids"], by_string),
+                "logprobs": None}],
+            "usage": {"prompt_tokens": len(prompt),
+                      "completion_tokens": len(toks),
+                      "total_tokens": len(prompt) + len(toks)}}
+
+    def _chat(self, body: Dict[str, Any]):
+        prompt = self._chat_prompt(body["messages"])
+        sp, stops, effective = self._sampling(body, len(prompt))
+        rid = self.engine.submit(prompt, **sp)
+        oid = f"chatcmpl-{next(_req_ids)}"
+        if body.get("stream"):
+            return self._stream_events(
+                rid, oid, "chat.completion.chunk", stops, effective,
+                sp["stop_token_ids"],
+                content_chunk=lambda text: {"delta": {"content": text}},
+                final_extra=lambda: {"delta": {}},
+                lead_chunk={"delta": {"role": "assistant"}})
+        toks, text, by_string = self._collect(rid, stops)
+        return {
+            "id": oid, "object": "chat.completion",
+            "created": int(time.time()), "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": self._finish_reason(
+                    len(toks), effective, toks[-1] if toks else None,
+                    sp["stop_token_ids"], by_string)}],
+            "usage": {"prompt_tokens": len(prompt),
+                      "completion_tokens": len(toks),
+                      "total_tokens": len(prompt) + len(toks)}}
+
+    def _stream_events(self, rid: str, oid: str, obj: str,
+                       stops: List[str], effective: int, stop_ids,
+                       *, content_chunk, final_extra, lead_chunk=None):
+        created = int(time.time())
+
+        def wrap(choice: Dict[str, Any],
+                 finish: Optional[str] = None) -> Dict[str, Any]:
+            return {"id": oid, "object": obj, "created": created,
+                    "model": self.model_name,
+                    "choices": [{"index": 0, **choice,
+                                 "finish_reason": finish}]}
+
+        def gen():
+            if lead_chunk is not None:
+                yield wrap(lead_chunk)
+            emitted = ""     # decoded text already sent to the client
+            toks: List[int] = []
+            last_tok = None
+            by_string = False
+            for tok in self.engine.stream(rid):
+                if by_string:
+                    continue  # draining to the end marker post-abort
+                toks.append(tok)
+                last_tok = tok
+                full, by_string = self._apply_stops(
+                    self._decode_text(toks), stops)
+                delta = full[len(emitted):]
+                if delta:
+                    emitted = full
+                    yield wrap(content_chunk(delta))
+                if by_string:
+                    # stop sequence landed: cut the engine request short
+                    # but keep consuming so its stream closes cleanly
+                    self.engine.abort(rid)
+            yield wrap(final_extra(), finish=self._finish_reason(
+                len(toks), effective, last_tok, stop_ids, by_string))
+            yield "[DONE]"
+
+        return gen()
+
+
+def build_openai_deployment(model_factory, *, engine_config=None,
+                            tokenizer=None, model_name="ray-tpu-llm",
+                            name: str = "OpenAIServer",
+                            num_replicas: int = 1,
+                            route_prefix: str = "/v1",
+                            max_ongoing_requests: int = 64) -> Application:
+    """An Application serving /v1/completions + /v1/chat/completions."""
+    return build_llm_deployment(
+        model_factory, engine_config=engine_config, tokenizer=tokenizer,
+        name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        server_cls=OpenAIServer,
+        server_kwargs={"model_name": model_name},
+        route_prefix=route_prefix)
+
+
+__all__ = ["OpenAIServer", "build_openai_deployment"]

@@ -133,6 +133,29 @@ def test_generator_force_cancel_settles_stream(rt):
         raise ray_tpu.exceptions.TaskCancelledError("ended")
 
 
+def test_async_actor_streaming_method(rt):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncStreamer:
+        @ray_tpu.method(num_returns="streaming")
+        async def feed(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 3
+
+        async def ping(self):
+            return "pong"
+
+    a = AsyncStreamer.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    vals = [ray_tpu.get(r, timeout=30) for r in a.feed.remote(4)]
+    assert vals == [0, 3, 6, 9]
+    # calling an async-gen method without streaming surfaces an error
+    with pytest.raises(Exception):
+        ray_tpu.get(a.feed.options(num_returns=1).remote(2), timeout=30)
+
+
 # Keep last: re-creates the runtime, which invalidates the module-scoped
 # `rt` fixture for any test that would run after it.
 def test_generator_consumed_in_task_on_one_cpu():
