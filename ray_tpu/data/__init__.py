@@ -4,7 +4,8 @@ Numpy-columnar blocks, lazy fused plans, a streaming executor over the
 core runtime, and a device loader that prefetches batches into TPU HBM.
 """
 from .block import Block
-from .dataset import (Dataset, from_items, from_blocks, from_numpy, range_,
+from .dataset import (Dataset, from_items, from_blocks, from_numpy,
+                      from_pandas, range_,
                       read_text, read_jsonl, read_csv, read_npy,
                       read_parquet, AggregateFn)
 from .device_loader import device_put_iterator
@@ -14,6 +15,7 @@ from . import preprocessors
 range = range_  # noqa: A001
 
 __all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
+           "from_pandas",
            "range", "range_", "read_text", "read_jsonl", "read_csv",
            "read_npy", "read_parquet", "AggregateFn", "device_put_iterator",
            "preprocessors"]

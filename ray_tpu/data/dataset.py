@@ -333,6 +333,27 @@ class Dataset:
                 np.save(f, np.asarray(block[column]))
         return self._write_parts(path, "npy", wb)
 
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize into one pandas DataFrame (reference:
+        Dataset.to_pandas). `limit` caps rows like the reference's
+        default guard; None = no cap."""
+        import pandas as pd  # noqa: PLC0415
+        frames = []
+        seen = 0
+        for block in self.iter_blocks():
+            n = len(next(iter(block.values()))) if block else 0
+            if limit is not None and seen + n > limit:
+                block = {k: v[:limit - seen] for k, v in block.items()}
+                n = limit - seen
+            frames.append(pd.DataFrame(
+                {k: np.asarray(v) for k, v in block.items()}))
+            seen += n
+            if limit is not None and seen >= limit:
+                break
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
     def write_parquet(self, path: str) -> List[str]:
         import pyarrow as pa  # noqa: PLC0415
         import pyarrow.parquet as pq  # noqa: PLC0415
@@ -473,6 +494,15 @@ def from_numpy(arrays: Dict[str, np.ndarray],
         for i in range(0, n, block_rows):
             yield {k: v[i:min(i + block_rows, n)] for k, v in arrays.items()}
     return Dataset(_Source("from_numpy", make_blocks, num_rows=n))
+
+
+def from_pandas(df, block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    """pandas DataFrame -> numpy-columnar Dataset (reference:
+    ray.data.from_pandas; object-dtype columns stay object arrays)."""
+    arrays = {str(col): df[col].to_numpy() for col in df.columns}
+    if not arrays:
+        return from_items([])
+    return from_numpy(arrays, block_rows)
 
 
 def read_text(path: str, block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:

@@ -71,14 +71,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(state_mod.summarize_objects())
             elif route == "/api/timeline":
                 self._json(timeline_mod.timeline_events())
+            elif route == "/api/serve":
+                self._json(_serve_status())
             elif route == "/metrics":
                 self._send(200, metrics_mod.exposition().encode(),
                            "text/plain; version=0.0.4")
-            elif route in ("", "/", "/api"):
+            elif route in ("", "/"):
+                self._send(200, _INDEX_HTML.encode(),
+                           "text/html; charset=utf-8")
+            elif route == "/api":
                 self._json({"routes": ["/api/cluster", "/api/nodes",
                                        "/api/actors", "/api/tasks",
                                        "/api/objects", "/api/workers",
                                        "/api/placement_groups",
+                                       "/api/serve",
                                        "/api/summary/tasks",
                                        "/api/summary/actors",
                                        "/api/summary/objects",
@@ -87,6 +93,74 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": f"no route {route}"}, 404)
         except Exception as e:  # surface errors as JSON, keep serving
             self._json({"error": repr(e)}, 500)
+
+
+def _serve_status() -> Any:
+    """Serve application/deployment table if a controller is running."""
+    import ray_tpu
+    from ..serve.controller import CONTROLLER_NAME
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME, timeout=0.2)
+    except ValueError:
+        return {"running": False, "applications": {}}
+    try:
+        apps = ray_tpu.get(ctrl.list_applications.remote(), timeout=5.0)
+        detail = {a: ray_tpu.get(ctrl.get_app_status.remote(a),
+                                 timeout=5.0) for a in apps}
+        return {"running": True, "applications": detail}
+    except Exception as e:  # noqa: BLE001
+        return {"running": True, "error": repr(e)}
+
+
+# Single-file status page: fetches the JSON endpoints client-side and
+# renders tables (no build step — the documented JS-frontend scope cut
+# stays; this is the reference dashboard's overview page, not its SPA).
+_INDEX_HTML = """<!doctype html>
+<meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font:13px system-ui,sans-serif;margin:1.2em;background:#fafafa}
+ h1{font-size:18px} h2{font-size:14px;margin:1.2em 0 .3em}
+ table{border-collapse:collapse;background:#fff;min-width:40em}
+ td,th{border:1px solid #ddd;padding:.25em .6em;text-align:left}
+ th{background:#f0f0f0} code{background:#eee;padding:0 .3em}
+ #err{color:#b00}
+</style>
+<h1>ray_tpu dashboard</h1>
+<div id=err></div>
+<h2>Cluster</h2><table id=cluster></table>
+<h2>Nodes</h2><table id=nodes></table>
+<h2>Actors</h2><table id=actors></table>
+<h2>Task summary</h2><table id=tasks></table>
+<h2>Serve</h2><table id=serve></table>
+<script>
+const cell = v => typeof v === 'object' && v !== null
+  ? JSON.stringify(v) : String(v);
+function rows(el, list){
+  const t = document.getElementById(el);
+  if (!Array.isArray(list)) list = Object.entries(list).map(
+    ([k, v]) => ({key: k, value: v}));
+  if (!list.length) { t.innerHTML = '<tr><td>-</td></tr>'; return; }
+  const cols = Object.keys(list[0]);
+  t.innerHTML = '<tr>' + cols.map(c => `<th>${c}</th>`).join('')
+    + '</tr>' + list.map(r => '<tr>' + cols.map(
+      c => `<td>${cell(r[c])}</td>`).join('') + '</tr>').join('');
+}
+async function refresh(){
+  try {
+    const get = p => fetch(p).then(r => r.json());
+    rows('cluster', await get('/api/cluster'));
+    rows('nodes', await get('/api/nodes'));
+    rows('actors', await get('/api/actors?limit=50'));
+    rows('tasks', await get('/api/summary/tasks'));
+    const s = await get('/api/serve');
+    rows('serve', s.running ? s.applications : {running: false});
+    document.getElementById('err').textContent = '';
+  } catch (e) {
+    document.getElementById('err').textContent = 'refresh failed: ' + e;
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script>"""
 
 
 class Dashboard:

@@ -221,3 +221,16 @@ def test_write_csv_quotes_special_chars(tmp_path):
     back = rdata.read_csv(str(files[0])).take_all()
     assert back[0]["s"] == 'hello, "world"'
     assert back[1]["s"] == "line\nbreak"
+
+
+def test_pandas_roundtrip():
+    import pandas as pd
+    import ray_tpu.data as rdata
+    df = pd.DataFrame({"a": [1, 2, 3, 4], "b": ["x", "y", "z", "w"]})
+    ds = rdata.from_pandas(df, block_rows=2)
+    assert ds.count() == 4
+    out = ds.map(lambda r: {"a": r["a"] * 10, "b": r["b"]}).to_pandas()
+    assert list(out["a"]) == [10, 20, 30, 40]
+    assert list(out["b"]) == ["x", "y", "z", "w"]
+    # limit caps rows
+    assert len(ds.to_pandas(limit=3)) == 3

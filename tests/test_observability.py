@@ -304,3 +304,22 @@ def test_cli_against_dashboard(rt, tmp_path):
         assert json.loads(buf.getvalue())["total"] >= 1
     finally:
         stop_dashboard()
+
+
+def test_dashboard_html_and_serve_endpoint(rt):
+    import json as _json
+    import urllib.request
+    from ray_tpu.observability.dashboard import start_dashboard, \
+        stop_dashboard
+    dash = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(dash.url + "/", timeout=10) as r:
+            html = r.read().decode()
+            assert "ray_tpu dashboard" in html
+            assert "text/html" in r.headers.get("Content-Type", "")
+        with urllib.request.urlopen(dash.url + "/api/serve",
+                                    timeout=10) as r:
+            out = _json.loads(r.read())
+        assert out["running"] in (True, False)
+    finally:
+        stop_dashboard()
