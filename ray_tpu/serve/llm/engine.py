@@ -84,6 +84,29 @@ class _Request:
 
 _END = ("__end__", None)
 
+_engine_ids = itertools.count()
+_metrics_singletons = None
+
+
+def _engine_metrics():
+    """Shared registry metrics (created once; per-engine series via the
+    `engine` tag — re-instantiating per engine would clobber the
+    registry entry and drop earlier engines' series)."""
+    global _metrics_singletons
+    if _metrics_singletons is None:
+        from ...util import metrics as metrics_mod  # noqa: PLC0415
+        _metrics_singletons = (
+            metrics_mod.Counter("llm_engine_tokens_generated",
+                                "tokens sampled across all requests",
+                                tag_keys=("engine",)),
+            metrics_mod.Gauge("llm_engine_active_slots",
+                              "requests currently decoding",
+                              tag_keys=("engine",)),
+            metrics_mod.Gauge("llm_engine_waiting_requests",
+                              "requests awaiting a slot",
+                              tag_keys=("engine",)))
+    return _metrics_singletons
+
 
 class LLMEngine:
     """Continuous-batching engine over a ray_tpu Llama-family model.
@@ -132,6 +155,10 @@ class LLMEngine:
         self._shutdown = threading.Event()
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "tokens_generated": 0, "preempted": 0}
+        # surfaced on the shared metrics registry (/metrics, dashboard);
+        # one labeled series per engine instance
+        self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
+        self._m_tokens, self._m_active, self._m_waiting = _engine_metrics()
 
         self._prefill_jit = jax.jit(
             self._prefill_impl, static_argnames=("pad_len",),
@@ -451,6 +478,7 @@ class LLMEngine:
     def _emit(self, req: _Request, tok: int):
         req.generated += 1
         self.stats["tokens_generated"] += 1
+        self._m_tokens.inc(1.0, tags=self._mtags)
         if req.first_token_ts is None:
             req.first_token_ts = time.time()
         req.out_queue.put(("token", tok))
@@ -553,6 +581,10 @@ class LLMEngine:
                     self._last_tokens = last
                     self._start_fetch(toks)
                     inflight.append(("decode", snapshot, toks))
+                self._m_active.set(float(len(self._active)),
+                                   tags=self._mtags)
+                self._m_waiting.set(float(self._waiting.qsize()),
+                                    tags=self._mtags)
                 if not inflight:
                     time.sleep(0.002)
                     continue

@@ -471,3 +471,18 @@ def test_llm_engine_top_p_and_stop_ids(tiny_llm):
             eng.submit(prompt, top_p=0.0)
     finally:
         eng.shutdown()
+
+
+def test_llm_engine_metrics_registered(tiny_llm):
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    from ray_tpu.util import metrics as metrics_mod
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=64, prefill_buckets=(16,)))
+    try:
+        eng.generate_sync(np.arange(1, 5), max_new_tokens=4)
+        text = metrics_mod.exposition()
+        assert "llm_engine_tokens_generated" in text
+        assert 'engine="llm-' in text
+    finally:
+        eng.shutdown()
