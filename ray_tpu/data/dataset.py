@@ -246,6 +246,27 @@ class Dataset:
         return device_put_iterator(host_iter, sharding=sharding,
                                    prefetch=prefetch, dtypes=dtypes)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False, device=None,
+                           dtypes: Optional[Dict[str, Any]] = None):
+        """Batches as torch tensors (reference: iter_torch_batches).
+        Object-dtype columns (strings) pass through as-is."""
+        import torch  # noqa: PLC0415
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    out[k] = v
+                    continue
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     # ---------------- consumption ----------------
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out = []

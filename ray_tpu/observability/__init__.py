@@ -6,7 +6,8 @@ _private/memory_monitor.py (SURVEY.md §2.8 O2/O4/O6).
 from .dashboard import Dashboard, start_dashboard, stop_dashboard
 from .memory_monitor import MemoryMonitor, memory_summary
 from .timeline import timeline, timeline_events
+from . import profiler  # noqa: F401
 
 __all__ = ["Dashboard", "start_dashboard", "stop_dashboard",
            "MemoryMonitor", "memory_summary", "timeline",
-           "timeline_events"]
+           "timeline_events", "profiler"]

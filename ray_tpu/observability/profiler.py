@@ -1,0 +1,91 @@
+"""On-device profiling: jax profiler traces + timing helpers.
+
+Reference parity: ray.timeline covers host-side task spans
+(observability/timeline.py); this module adds the DEVICE side — XLA/TPU
+op-level traces via jax.profiler — so a perf investigation gets both
+views. Traces open in TensorBoard's profile plugin or Perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, Optional
+
+_active_dir: Optional[str] = None
+
+
+def start_trace(log_dir: str) -> str:
+    """Begin capturing a device trace into log_dir (one capture at a
+    time; mirrors jax.profiler.start_trace)."""
+    global _active_dir
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _active_dir = log_dir
+    return log_dir
+
+
+def stop_trace() -> Optional[str]:
+    global _active_dir
+    import jax
+    jax.profiler.stop_trace()
+    out, _active_dir = _active_dir, None
+    return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """with profiler.trace("/tmp/prof"): step(...)"""
+    start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region inside a capture (jax.profiler.TraceAnnotation)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_profile(path: Optional[str] = None) -> bytes:
+    """Snapshot device memory (pprof format; jax.profiler parity)."""
+    import jax
+    data = jax.profiler.device_memory_profile()
+    if path:
+        with open(path, "wb") as f:
+            f.write(data)
+    return data
+
+
+def timed_steps(step_fn, state, batch, *, warmup: int = 2,
+                iters: int = 10, sync=None) -> Dict[str, Any]:
+    """Wall-time a jitted step the way bench.py does: warmup, then time
+    `iters` calls fenced by a host fetch of `sync(result)` (defaults to
+    the first leaf of the metrics pytree)."""
+    import jax
+    import numpy as np
+
+    def fence(out):
+        tgt = sync(out) if sync is not None else \
+            jax.tree_util.tree_leaves(out)[0]
+        return np.asarray(tgt)
+
+    for _ in range(warmup):
+        state, m = step_fn(state, batch)
+    fence(m)
+    t0 = time.time()
+    for _ in range(iters):
+        state, m = step_fn(state, batch)
+    fence(m)
+    dt = time.time() - t0
+    return {"mean_step_s": dt / iters, "steps_per_s": iters / dt,
+            "state": state}
+
+
+__all__ = ["start_trace", "stop_trace", "trace", "annotate",
+           "device_memory_profile", "timed_steps"]

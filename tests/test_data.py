@@ -234,3 +234,17 @@ def test_pandas_roundtrip():
     assert list(out["b"]) == ["x", "y", "z", "w"]
     # limit caps rows
     assert len(ds.to_pandas(limit=3)) == 3
+
+
+def test_iter_torch_batches():
+    import torch
+    import ray_tpu.data as rdata
+    ds = rdata.range(10).map(lambda r: {"id": r["id"],
+                                        "f": float(r["id"]) / 2})
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert len(batches) == 3
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    assert batches[0]["id"].tolist() == [0, 1, 2, 3]
+    typed = next(ds.iter_torch_batches(batch_size=4,
+                                       dtypes={"f": torch.float64}))
+    assert typed["f"].dtype == torch.float64

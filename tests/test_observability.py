@@ -323,3 +323,24 @@ def test_dashboard_html_and_serve_endpoint(rt):
         assert out["running"] in (True, False)
     finally:
         stop_dashboard()
+
+
+def test_profiler_trace_and_timing(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.observability import profiler
+
+    @jax.jit
+    def step(state, batch):
+        s = state + batch.sum()
+        return s, {"loss": s}
+
+    with profiler.trace(str(tmp_path / "prof")):
+        with profiler.annotate("demo-step"):
+            out, _ = step(jnp.float32(0), jnp.ones((8, 8)))
+            out.block_until_ready()
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert produced, "no trace files written"
+    r = profiler.timed_steps(step, jnp.float32(0), jnp.ones((4, 4)),
+                             warmup=1, iters=3)
+    assert r["steps_per_s"] > 0
