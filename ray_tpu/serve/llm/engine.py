@@ -64,6 +64,12 @@ class LLMEngineConfig:
     # scratch cache slot) — one dispatch and one model pass instead of
     # per-prompt calls. 1 disables batching.
     max_prefill_batch: int = 4
+    # Chunked prefill (vLLM-style): prompts longer than this split into
+    # prefill_chunk-token chunks, one chunk dispatched per engine-loop
+    # iteration, so active decodes keep stepping DURING a long prompt's
+    # prefill instead of stalling behind one monolithic call.
+    # 0 disables chunking.
+    prefill_chunk: int = 0
 
 
 @dataclass
@@ -78,6 +84,7 @@ class _Request:
         default_factory=lambda: queue_mod.Queue(maxsize=4096))
     slot: int = -1
     generated: int = 0
+    prefill_pos: int = 0            # next prompt index (chunked prefill)
     submit_ts: float = field(default_factory=time.time)
     first_token_ts: Optional[float] = None
 
@@ -160,9 +167,13 @@ class LLMEngine:
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
         self._m_tokens, self._m_active, self._m_waiting = _engine_metrics()
 
+        self._prefilling: collections.deque = collections.deque()
         self._prefill_jit = jax.jit(
             self._prefill_impl, static_argnames=("pad_len",),
             donate_argnums=(1,))
+        self._prefill_chunk_jit = jax.jit(
+            self._prefill_chunk_impl,
+            static_argnames=("chunk", "sample"), donate_argnums=(1,))
         self._prefill_batch_jit = jax.jit(
             self._prefill_batch_impl, static_argnames=("pad_len",),
             donate_argnums=(1,))
@@ -232,6 +243,44 @@ class LLMEngine:
             lens = lens.at[slot].set(true_len)
             out_cache.append((ck, cv, lens))
         last = logits[0, true_len - 1]
+        tok = self._sample_tokens(last[None, :], temp[None], top_p[None],
+                                  rng_key)[0]
+        return tok, out_cache
+
+    def _prefill_chunk_impl(self, params, cache, tokens, slot, start,
+                            new_len, temp, top_p, rng_key,
+                            chunk: int, sample: bool):
+        """One chunk of a long prompt through the CACHED path: tokens
+        (1, chunk) written at positions [start, start+chunk); the slot's
+        length becomes `new_len` (start + true tokens in this chunk, so
+        tail padding of the final chunk stays invisible — pad queries
+        only ever attend pad keys and their outputs are discarded).
+        sample=True (final chunk) also samples the first generated token
+        from the last true position."""
+        jnp = self._jnp
+        jax = self._jax
+        lax = jax.lax
+        small = []
+        # The slot's true current length IS `start` — a reused slot's
+        # stored length would be stale from the previous occupant and
+        # leak its KV into the chunk's valid-mask.
+        l1 = jnp.reshape(start, (1,)).astype(jnp.int32)
+        for (ck, cv, lens) in cache:
+            k1 = lax.dynamic_slice_in_dim(ck, slot, 1, axis=0)
+            v1 = lax.dynamic_slice_in_dim(cv, slot, 1, axis=0)
+            small.append((k1, v1, l1))
+        positions = start + jnp.arange(chunk)[None, :]
+        logits, new_small = self.model.apply(
+            {"params": params}, tokens, cache=small, positions=positions)
+        out_cache = []
+        for (ck, cv, lens), (k1, v1, _l1) in zip(cache, new_small):
+            ck = lax.dynamic_update_slice_in_dim(ck, k1, slot, axis=0)
+            cv = lax.dynamic_update_slice_in_dim(cv, v1, slot, axis=0)
+            lens = lens.at[slot].set(new_len)
+            out_cache.append((ck, cv, lens))
+        if not sample:
+            return jnp.int32(0), out_cache
+        last = logits[0, new_len - start - 1]
         tok = self._sample_tokens(last[None, :], temp[None], top_p[None],
                                   rng_key)[0]
         return tok, out_cache
@@ -317,7 +366,10 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        self._bucket(prompt.size)  # validate in the caller, not the loop
+        if not (self.cfg.prefill_chunk > 0
+                and prompt.size > self.cfg.prefill_chunk):
+            # chunked prompts bypass the buckets; all others must fit one
+            self._bucket(prompt.size)  # validate in the caller, not loop
         budget = max_new_tokens or self.cfg.max_new_tokens_default
         if prompt.size + budget > self.cfg.max_seq_len:
             budget = self.cfg.max_seq_len - prompt.size
@@ -371,6 +423,7 @@ class LLMEngine:
         with self._lock:
             return {**self.stats, "active": len(self._active),
                     "waiting": self._waiting.qsize(),
+                    "prefilling": len(self._prefilling),
                     "free_slots": len(self._free_slots)}
 
     def shutdown(self):
@@ -398,6 +451,12 @@ class LLMEngine:
                 break
             slot = self._free_slots.pop()
             req.slot = slot
+            if (self.cfg.prefill_chunk > 0
+                    and req.prompt.size > self.cfg.prefill_chunk):
+                # long prompt: prefill in chunks interleaved with decode
+                # steps (one chunk per loop iteration)
+                self._prefilling.append(req)
+                continue
             taken.append((self._bucket(req.prompt.size), req, slot))
         if not taken:
             return
@@ -467,6 +526,42 @@ class LLMEngine:
         self._start_fetch(toks_dev)
         inflight.append(("prefill_batch", [r for r, _ in members],
                          toks_dev))
+
+    def _dispatch_chunk(self, inflight) -> None:
+        """Advance the oldest chunk-prefilling request by ONE chunk. The
+        final chunk samples the first token and activates the slot."""
+        jnp = self._jnp
+        req = self._prefilling[0]
+        C = self.cfg.prefill_chunk
+        start = req.prefill_pos
+        true = min(C, req.prompt.size - start)
+        is_last = start + true >= req.prompt.size
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :true] = req.prompt[start:start + true]
+        try:
+            self._rng_key, sub = self._jax.random.split(self._rng_key)
+            tok_dev, self._cache = self._prefill_chunk_jit(
+                self.params, self._cache, jnp.asarray(tokens),
+                jnp.int32(req.slot), jnp.int32(start),
+                jnp.int32(start + true), jnp.float32(req.temperature),
+                jnp.float32(req.top_p), sub, chunk=C, sample=is_last)
+        except BaseException as e:  # noqa: BLE001
+            self._prefilling.popleft()
+            self._free_slots.append(req.slot)
+            req.slot = -1
+            req.out_queue.put(("error", e))
+            req.out_queue.put(_END)
+            return
+        req.prefill_pos = start + true
+        if is_last:
+            self._prefilling.popleft()
+            self.stats["prefills"] += 1
+            self._last_tokens = self._last_tokens.at[req.slot].set(tok_dev)
+            self._active[req.slot] = req
+            self._mask_dirty = True
+            toks_dev = tok_dev[None]
+            self._start_fetch(toks_dev)
+            inflight.append(("prefill_batch", [req], toks_dev))
 
     @staticmethod
     def _start_fetch(arr):
@@ -564,6 +659,8 @@ class LLMEngine:
         while not self._shutdown.is_set():
             try:
                 self._admit_all(inflight)
+                if self._prefilling:
+                    self._dispatch_chunk(inflight)
                 if self._active:
                     mask, temps, top_ps = self._device_mask_temps()
                     self._rng_key, sub = self._jax.random.split(
