@@ -70,6 +70,10 @@ class LLMEngineConfig:
     # prefill instead of stalling behind one monolithic call.
     # 0 disables chunking.
     prefill_chunk: int = 0
+    # Fetch each sampled token's log-probability (of the raw model
+    # distribution) to the host and expose it via stream_detailed().
+    # Off by default: it adds one small device->host array per step.
+    logprobs: bool = False
 
 
 @dataclass
@@ -189,9 +193,11 @@ class LLMEngine:
     def _sample_tokens(self, logits, temps, top_ps, rng_key):
         """Sample per row of logits (N, V): greedy when temp==0, else
         temperature + optional global top-k + per-row nucleus top-p.
-        All on device; returns (N,) int32."""
+        All on device; returns (tokens (N,) int32, logprobs (N,) f32 of
+        the chosen token under the RAW model distribution)."""
         jnp = self._jnp
         jax = self._jax
+        raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         if self.cfg.top_k and self.cfg.top_k > 0:
             kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -217,7 +223,10 @@ class LLMEngine:
         scaled = jax.lax.cond(jnp.any(top_ps < 1.0), nucleus,
                               lambda s: s, scaled)
         sampled = jax.random.categorical(rng_key, scaled, axis=-1)
-        return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        logps = jnp.take_along_axis(raw_logp, toks[:, None],
+                                    axis=-1)[:, 0]
+        return toks, logps
 
     def _prefill_impl(self, params, cache, tokens, slot, true_len, temp,
                       top_p, rng_key, pad_len: int):
@@ -243,9 +252,9 @@ class LLMEngine:
             lens = lens.at[slot].set(true_len)
             out_cache.append((ck, cv, lens))
         last = logits[0, true_len - 1]
-        tok = self._sample_tokens(last[None, :], temp[None], top_p[None],
-                                  rng_key)[0]
-        return tok, out_cache
+        toks, logps = self._sample_tokens(last[None, :], temp[None],
+                                          top_p[None], rng_key)
+        return toks[0], logps[0], out_cache
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start,
                             new_len, temp, top_p, rng_key,
@@ -279,11 +288,11 @@ class LLMEngine:
             lens = lens.at[slot].set(new_len)
             out_cache.append((ck, cv, lens))
         if not sample:
-            return jnp.int32(0), out_cache
+            return jnp.int32(0), jnp.float32(0), out_cache
         last = logits[0, new_len - start - 1]
-        tok = self._sample_tokens(last[None, :], temp[None], top_p[None],
-                                  rng_key)[0]
-        return tok, out_cache
+        toks, logps = self._sample_tokens(last[None, :], temp[None],
+                                          top_p[None], rng_key)
+        return toks[0], logps[0], out_cache
 
     def _prefill_batch_impl(self, params, cache, tokens, slots, true_lens,
                             temps, top_ps, rng_key, pad_len: int):
@@ -313,8 +322,8 @@ class LLMEngine:
             lens = lens.at[slots].set(true_lens)
             out_cache.append((ck, cv, lens))
         last = logits[jnp.arange(g), true_lens - 1]          # (G, V)
-        toks = self._sample_tokens(last, temps, top_ps, rng_key)
-        return toks, out_cache
+        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key)
+        return toks, logps, out_cache
 
     def _decode_impl(self, params, cache, last_tokens, active_mask,
                      temps, top_ps, rng_key):
@@ -333,9 +342,9 @@ class LLMEngine:
         for (ck, cv, lens) in new_cache:
             lens = jnp.where(active_mask, lens, old_lengths)
             fixed.append((ck, cv, lens))
-        nxt = self._sample_tokens(logits, temps, top_ps, rng_key)
+        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key)
         nxt = jnp.where(active_mask, nxt, last_tokens)
-        return nxt, fixed
+        return nxt, logps, fixed
 
     def _decode_block_impl(self, params, cache, last_tokens, active_mask,
                            temps, top_ps, rng_key):
@@ -348,14 +357,14 @@ class LLMEngine:
 
         def body(carry, key):
             cache, last = carry
-            nxt, cache = self._decode_impl(params, cache, last,
-                                           active_mask, temps, top_ps,
-                                           key)
-            return (cache, nxt), nxt
+            nxt, logps, cache = self._decode_impl(params, cache, last,
+                                                  active_mask, temps,
+                                                  top_ps, key)
+            return (cache, nxt), (nxt, logps)
 
-        (cache, last), toks = jax.lax.scan(body, (cache, last_tokens),
-                                           keys)
-        return toks, cache, last
+        (cache, last), (toks, logps) = jax.lax.scan(
+            body, (cache, last_tokens), keys)
+        return toks, logps, cache, last
 
     # ---- public API -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
@@ -388,6 +397,12 @@ class LLMEngine:
 
     def stream(self, request_id: str):
         """Blocking generator of token ids for one request."""
+        for tok, _lp in self.stream_detailed(request_id):
+            yield tok
+
+    def stream_detailed(self, request_id: str):
+        """Like stream() but yields (token_id, logprob) — logprob is
+        None unless the engine was built with logprobs=True."""
         req = self._requests.get(request_id)
         if req is None:
             raise KeyError(request_id)
@@ -481,12 +496,12 @@ class LLMEngine:
                 req, slot = members[0]
                 tokens = np.zeros((1, pad_len), np.int32)
                 tokens[0, :req.prompt.size] = req.prompt
-                tok_dev, self._cache = self._prefill_jit(
+                tok_dev, lp_dev, self._cache = self._prefill_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.int32(slot), jnp.int32(req.prompt.size),
                     jnp.float32(req.temperature),
                     jnp.float32(req.top_p), sub, pad_len=pad_len)
-                toks_dev = tok_dev[None]
+                toks_dev, lps_dev = tok_dev[None], lp_dev[None]
             else:
                 g = 1
                 while g < g_real:
@@ -502,12 +517,13 @@ class LLMEngine:
                     lens[i] = req.prompt.size
                     temps[i] = req.temperature
                     top_ps[i] = req.top_p
-                toks_dev, self._cache = self._prefill_batch_jit(
+                toks_dev, lps_dev, self._cache = self._prefill_batch_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.asarray(slots), jnp.asarray(lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), sub,
                     pad_len=pad_len)
                 toks_dev = toks_dev[:g_real]
+                lps_dev = lps_dev[:g_real]
             real_slots = jnp.asarray(
                 np.asarray([s for _, s in members], np.int32))
             self._last_tokens = self._last_tokens.at[real_slots].set(
@@ -524,8 +540,10 @@ class LLMEngine:
             self._active[slot] = req
         self._mask_dirty = True
         self._start_fetch(toks_dev)
+        if self.cfg.logprobs:
+            self._start_fetch(lps_dev)
         inflight.append(("prefill_batch", [r for r, _ in members],
-                         toks_dev))
+                         toks_dev, lps_dev if self.cfg.logprobs else None))
 
     def _dispatch_chunk(self, inflight) -> None:
         """Advance the oldest chunk-prefilling request by ONE chunk. The
@@ -540,7 +558,7 @@ class LLMEngine:
         tokens[0, :true] = req.prompt[start:start + true]
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
-            tok_dev, self._cache = self._prefill_chunk_jit(
+            tok_dev, lp_dev, self._cache = self._prefill_chunk_jit(
                 self.params, self._cache, jnp.asarray(tokens),
                 jnp.int32(req.slot), jnp.int32(start),
                 jnp.int32(start + true), jnp.float32(req.temperature),
@@ -559,9 +577,12 @@ class LLMEngine:
             self._last_tokens = self._last_tokens.at[req.slot].set(tok_dev)
             self._active[req.slot] = req
             self._mask_dirty = True
-            toks_dev = tok_dev[None]
+            toks_dev, lps_dev = tok_dev[None], lp_dev[None]
             self._start_fetch(toks_dev)
-            inflight.append(("prefill_batch", [req], toks_dev))
+            if self.cfg.logprobs:
+                self._start_fetch(lps_dev)
+            inflight.append(("prefill_batch", [req], toks_dev,
+                             lps_dev if self.cfg.logprobs else None))
 
     @staticmethod
     def _start_fetch(arr):
@@ -570,13 +591,14 @@ class LLMEngine:
         except (AttributeError, NotImplementedError):
             pass  # fetch happens synchronously at drain time instead
 
-    def _emit(self, req: _Request, tok: int):
+    def _emit(self, req: _Request, tok: int,
+              logp: Optional[float] = None):
         req.generated += 1
         self.stats["tokens_generated"] += 1
         self._m_tokens.inc(1.0, tags=self._mtags)
         if req.first_token_ts is None:
             req.first_token_ts = time.time()
-        req.out_queue.put(("token", tok))
+        req.out_queue.put(("token", (tok, logp)))
         if ((self.cfg.eos_token_id is not None
              and tok == self.cfg.eos_token_id)
                 or tok in req.stop_ids):
@@ -613,9 +635,10 @@ class LLMEngine:
         Termination/EOS checks happen here, `pipeline_depth` steps behind
         dispatch; lagged tokens for finished/reused slots are discarded
         by the (req.slot == slot, generated < budget) guards."""
-        kind, payload, arr = inflight.popleft()
+        kind, payload, arr, lp_arr = inflight.popleft()
         try:
             host = np.asarray(arr)
+            lps = np.asarray(lp_arr) if lp_arr is not None else None
         except BaseException as e:  # noqa: BLE001  device-side failure
             targets = (list(payload) if kind == "prefill_batch"
                        else [r for _, r in payload])
@@ -627,18 +650,24 @@ class LLMEngine:
         if kind == "prefill_batch":
             reqs = payload
             firsts = host.reshape(-1)
+            flat_lps = lps.reshape(-1) if lps is not None else None
             for i, req in enumerate(reqs):
                 if req.slot < 0:
                     continue
-                self._emit(req, int(firsts[i]))
+                self._emit(req, int(firsts[i]),
+                           float(flat_lps[i]) if flat_lps is not None
+                           else None)
                 if (req.generated >= req.max_new_tokens
                         or req.prompt.size + req.generated
                         >= self.cfg.max_seq_len):
                     self._release(req)
             return
         rows = host if host.ndim == 2 else host[None, :]  # (K, S)
+        lp_rows = None
+        if lps is not None:
+            lp_rows = lps if lps.ndim == 2 else lps[None, :]
         self.stats["decode_steps"] += rows.shape[0]
-        for row in rows:
+        for ri, row in enumerate(rows):
             for slot, req in payload:
                 if req.slot != slot:
                     continue  # released/reused slot: lagged, discard
@@ -648,7 +677,9 @@ class LLMEngine:
                     # release here or the slot decodes forever
                     self._release(req)
                     continue
-                self._emit(req, int(row[slot]))
+                self._emit(req, int(row[slot]),
+                           float(lp_rows[ri][slot])
+                           if lp_rows is not None else None)
                 full = (req.prompt.size + req.generated
                         >= self.cfg.max_seq_len)
                 if req.generated >= req.max_new_tokens or full:
@@ -667,17 +698,23 @@ class LLMEngine:
                         self._rng_key)
                     snapshot = list(self._active.items())
                     if self._decode_block_jit is not None:
-                        toks, self._cache, last = self._decode_block_jit(
-                            self.params, self._cache, self._last_tokens,
-                            mask, temps, top_ps, sub)
+                        toks, logps, self._cache, last = \
+                            self._decode_block_jit(
+                                self.params, self._cache,
+                                self._last_tokens, mask, temps, top_ps,
+                                sub)
                     else:
-                        toks, self._cache = self._decode_jit(
+                        toks, logps, self._cache = self._decode_jit(
                             self.params, self._cache, self._last_tokens,
                             mask, temps, top_ps, sub)
                         last = toks
                     self._last_tokens = last
                     self._start_fetch(toks)
-                    inflight.append(("decode", snapshot, toks))
+                    if self.cfg.logprobs:
+                        self._start_fetch(logps)
+                    inflight.append(("decode", snapshot, toks,
+                                     logps if self.cfg.logprobs
+                                     else None))
                 self._m_active.set(float(len(self._active)),
                                    tags=self._mtags)
                 self._m_waiting.set(float(self._waiting.qsize()),

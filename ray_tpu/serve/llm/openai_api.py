@@ -109,19 +109,21 @@ class OpenAIServer(LLMServer):
         return "length" if n_out >= effective else "stop"
 
     def _collect(self, rid: str, stops: List[str]
-                 ) -> Tuple[List[int], str, bool]:
+                 ) -> Tuple[List[int], List, str, bool]:
         """Drain a request, aborting early when a stop string lands."""
         toks: List[int] = []
+        lps: List = []
         text, by_string = "", False
-        for tok in self.engine.stream(rid):
+        for tok, lp in self.engine.stream_detailed(rid):
             if by_string:
                 continue  # draining to the end marker post-abort
             toks.append(tok)
+            lps.append(lp)
             text, by_string = self._apply_stops(
                 self._decode_text(toks), stops)
             if by_string:
                 self.engine.abort(rid)
-        return toks, text, by_string
+        return toks, lps, text, by_string
 
     # ---- the two APIs -----------------------------------------------------
     def __call__(self, body: Dict[str, Any]):
@@ -156,7 +158,13 @@ class OpenAIServer(LLMServer):
                 sp["stop_token_ids"],
                 content_chunk=lambda text: {"text": text},
                 final_extra=lambda: {"text": ""})
-        toks, text, by_string = self._collect(rid, stops)
+        toks, lps, text, by_string = self._collect(rid, stops)
+        logprobs = None
+        if body.get("logprobs") and any(lp is not None for lp in lps):
+            logprobs = {
+                "tokens": [self._decode_text([t]) for t in toks],
+                "token_logprobs": lps,
+                "top_logprobs": None, "text_offset": None}
         return {
             "id": oid, "object": "text_completion",
             "created": int(time.time()), "model": self.model_name,
@@ -165,7 +173,7 @@ class OpenAIServer(LLMServer):
                 "finish_reason": self._finish_reason(
                     len(toks), effective, toks[-1] if toks else None,
                     sp["stop_token_ids"], by_string),
-                "logprobs": None}],
+                "logprobs": logprobs}],
             "usage": {"prompt_tokens": len(prompt),
                       "completion_tokens": len(toks),
                       "total_tokens": len(prompt) + len(toks)}}
@@ -182,7 +190,7 @@ class OpenAIServer(LLMServer):
                 content_chunk=lambda text: {"delta": {"content": text}},
                 final_extra=lambda: {"delta": {}},
                 lead_chunk={"delta": {"role": "assistant"}})
-        toks, text, by_string = self._collect(rid, stops)
+        toks, _lps, text, by_string = self._collect(rid, stops)
         return {
             "id": oid, "object": "chat.completion",
             "created": int(time.time()), "model": self.model_name,
@@ -215,7 +223,7 @@ class OpenAIServer(LLMServer):
             toks: List[int] = []
             last_tok = None
             by_string = False
-            for tok in self.engine.stream(rid):
+            for tok, _lp in self.engine.stream_detailed(rid):
                 if by_string:
                     continue  # draining to the end marker post-abort
                 toks.append(tok)
@@ -244,6 +252,9 @@ def build_openai_deployment(model_factory, *, engine_config=None,
                             route_prefix: str = "/v1",
                             max_ongoing_requests: int = 64) -> Application:
     """An Application serving /v1/completions + /v1/chat/completions."""
+    engine_config = dict(engine_config or {})
+    # the completions `logprobs` field needs the engine to fetch them
+    engine_config.setdefault("logprobs", True)
     return build_llm_deployment(
         model_factory, engine_config=engine_config, tokenizer=tokenizer,
         name=name, num_replicas=num_replicas,

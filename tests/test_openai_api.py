@@ -168,3 +168,20 @@ def test_default_budget_reports_length(openai_app):
         out = json.loads(r.read())
     assert out["usage"]["completion_tokens"] == 8
     assert out["choices"][0]["finish_reason"] == "length"
+
+
+def test_completions_logprobs(openai_app):
+    port = openai_app
+    with _post(port, {"prompt": [2, 4, 6], "max_tokens": 5,
+                      "temperature": 0, "logprobs": 1}) as r:
+        out = json.loads(r.read())
+    lp = out["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["token_logprobs"]) == 5
+    assert all(isinstance(x, float) and x <= 0.0
+               for x in lp["token_logprobs"])
+    assert len(lp["tokens"]) == 5
+    # greedy sampling: the chosen token is the argmax -> its logprob is
+    # the max, so it must be > log(1/vocab)
+    import math
+    assert all(x > math.log(1.0 / 128) for x in lp["token_logprobs"])

@@ -547,3 +547,28 @@ def test_llm_engine_chunked_and_short_interleave():
         assert len(long_out) == 4
     finally:
         eng.shutdown()
+
+
+def test_llm_engine_stream_detailed_logprobs(tiny_llm):
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=64, prefill_buckets=(16,),
+        logprobs=True))
+    try:
+        rid = eng.submit(np.arange(1, 6), max_new_tokens=4,
+                         temperature=0.0)
+        pairs = list(eng.stream_detailed(rid))
+        assert len(pairs) == 4
+        assert all(lp is not None and lp <= 0.0 for _t, lp in pairs)
+        # without logprobs enabled the lp slot is None
+        eng2 = LLMEngine(model, params, LLMEngineConfig(
+            max_slots=2, max_seq_len=64, prefill_buckets=(16,)))
+        try:
+            rid2 = eng2.submit(np.arange(1, 6), max_new_tokens=2)
+            assert all(lp is None
+                       for _t, lp in eng2.stream_detailed(rid2))
+        finally:
+            eng2.shutdown()
+    finally:
+        eng.shutdown()
