@@ -188,6 +188,35 @@ class Dataset:
             out.append(from_blocks([part], name=f"split_{i}"))
         return out
 
+    def split_proportionately(self, fractions: Sequence[float]
+                              ) -> List["Dataset"]:
+        """Split by row fractions; the remainder forms a final dataset
+        (reference: Dataset.split_proportionately — n fractions yield
+        n+1 datasets)."""
+        if not fractions or sum(fractions) >= 1.0 \
+                or any(f <= 0 for f in fractions):
+            raise ValueError("fractions must be positive and sum to <1")
+        total = self.count()
+        sizes = [int(total * f) for f in fractions]
+        out: List["Dataset"] = []
+        start = 0
+        rows = list(self.iter_rows())
+        for sz in sizes + [total - sum(sizes)]:
+            out.append(from_items(rows[start:start + sz]))
+            start += sz
+        return out
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) split (reference: Dataset.train_test_split)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        train, test = ds.split_proportionately([1.0 - test_size])
+        return train, test
+
     def streaming_split(self, n: int) -> List["Dataset"]:
         """Round-robin block split; each shard re-streams the parent."""
         parent = self

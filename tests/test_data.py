@@ -248,3 +248,21 @@ def test_iter_torch_batches():
     typed = next(ds.iter_torch_batches(batch_size=4,
                                        dtypes={"f": torch.float64}))
     assert typed["f"].dtype == torch.float64
+
+
+def test_split_proportionately_and_train_test():
+    import ray_tpu.data as rdata
+    ds = rdata.range(100)
+    a, b, c = ds.split_proportionately([0.6, 0.2])
+    assert (a.count(), b.count(), c.count()) == (60, 20, 20)
+    # rows partition without overlap
+    ids = [set(r["id"] for r in d.take_all()) for d in (a, b, c)]
+    assert ids[0] | ids[1] | ids[2] == set(range(100))
+    assert not (ids[0] & ids[1])
+    train, test = ds.train_test_split(0.25, shuffle=True, seed=7)
+    assert (train.count(), test.count()) == (75, 25)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ds.split_proportionately([0.7, 0.5])
+    with _pytest.raises(ValueError):
+        ds.train_test_split(1.5)
