@@ -4,7 +4,8 @@ from .space import (uniform, loguniform, quniform, randint, choice,
                     grid_search, generate_variants)
 from .schedulers import (FIFOScheduler, ASHAScheduler, HyperBandScheduler,
                          MedianStoppingRule, PopulationBasedTraining)
-from .tuner import Tuner, TuneConfig, ResultGrid, Trial, with_resources
+from .tuner import (Tuner, TuneConfig, ResultGrid, Trial,
+                    with_resources, with_parameters)
 from .session import report, get_trial_id, StopTrial
 from .stoppers import (CombinedStopper, ExperimentPlateauStopper,
                        FunctionStopper, MaximumIterationStopper, Stopper,
@@ -22,4 +23,5 @@ __all__ = ["uniform", "loguniform", "quniform", "randint", "choice",
            "ExperimentPlateauStopper", "TimeoutStopper", "CombinedStopper",
            "FunctionStopper", "Callback", "CSVLoggerCallback",
            "JsonLoggerCallback", "Searcher", "TPESampler",
-           "BasicVariantGenerator", "Trainable", "with_resources"]
+           "BasicVariantGenerator", "Trainable", "with_resources",
+           "with_parameters"]

@@ -48,6 +48,24 @@ def with_resources(trainable: Callable,
         return wrapped
 
 
+def with_parameters(trainable: Callable, **params) -> Callable:
+    """Bind large constant objects to a trainable via the object store
+    (reference: tune.with_parameters): the values are put() ONCE and
+    each trial actor fetches them zero-copy from shm instead of
+    re-pickling them into every trial's closure."""
+    import ray_tpu
+    refs = {k: ray_tpu.put(v) for k, v in params.items()}
+
+    def wrapped(config, *a, **kw):
+        fetched = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, *a, **fetched, **kw)
+
+    wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    if hasattr(trainable, "_tune_resources"):
+        wrapped._tune_resources = trainable._tune_resources
+    return wrapped
+
+
 class TuneConfig:
     def __init__(self, *, metric: str = "score", mode: str = "max",
                  num_samples: int = 1, max_concurrent_trials: int = 4,

@@ -238,3 +238,17 @@ def test_actor_node_affinity(cluster):
         node_id=remote_nid)).remote()
     assert ray_tpu.get(a.node.remote(), timeout=60) == remote_nid
     ray_tpu.kill(a)
+
+
+def test_cluster_utils_helper():
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    with Cluster(head_cpus=2) as c:
+        nid = c.add_node(num_cpus=2, resources={"side": 1.0})
+        assert nid is not None
+
+        @ray_tpu.remote(resources={"side": 1.0})
+        def where():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        assert ray_tpu.get(where.remote(), timeout=60) == nid

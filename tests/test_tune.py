@@ -78,3 +78,21 @@ def test_tuner_with_asha_stops_weak(rt):
     assert statuses[0.01] == "STOPPED"   # killed by ASHA at a rung
     best = grid.get_best_result()
     assert best.metrics["config"]["lr"] == 1.0
+
+
+def test_with_parameters_binds_via_object_store(rt):
+    import numpy as np
+    from ray_tpu import tune
+
+    big = np.arange(5000)
+
+    def train_fn(config, data=None):
+        tune.report({"total": float(data.sum()) + config["x"]})
+
+    tuner = tune.Tuner(tune.with_parameters(train_fn, data=big),
+                       param_space={"x": tune.grid_search([1.0, 2.0])},
+                       tune_config=tune.TuneConfig(metric="total",
+                                                   mode="max"))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["total"] == float(big.sum()) + 2.0
