@@ -172,6 +172,9 @@ class PlacementGroupState:
 
 class DriverRuntime:
     is_driver = True
+    # finished task specs retained for lineage reconstruction; the oldest
+    # drop first once past this many (func_bytes dominate the footprint)
+    _LINEAGE_RETAIN = 4096
 
     def __init__(self, *, num_cpus=None, num_tpus=None, resources=None,
                  object_store_memory=None, max_workers=None, namespace="default",
@@ -255,6 +258,9 @@ class DriverRuntime:
         self._task_events: Dict[str, List[Tuple[float, str]]] = {}
         self._actor_create_specs: Dict[str, ActorCreationSpec] = {}
         self._respawnable_specs: Dict[str, TaskSpec] = {}
+        # finished non-actor task specs for lineage reconstruction
+        # (insertion-ordered; bounded)
+        self._lineage_specs: Dict[str, TaskSpec] = {}
         self._wid_counter = 0
         self._shutdown = threading.Event()
         self._conn_by_wid: Dict[str, Connection] = {}
@@ -568,6 +574,61 @@ class DriverRuntime:
                 pg.bundle_nodes = []
                 pg.state = "PENDING"
                 pg.created_at = time.time()
+        self._reconstruct_lost_objects(nid)
+
+    def _reconstruct_lost_objects(self, nid: str) -> None:
+        """Lineage reconstruction (reference:
+        core_worker/reference_count.cc + task resubmission): when a node
+        dies, every ready object whose payload lived there either fails
+        over to a surviving copy, is re-created by re-running its
+        producing task (kept in the bounded lineage log), or fails with
+        ObjectLostError. Runs in the dispatcher BEFORE readers chase the
+        stale location."""
+        def alive(node_id) -> bool:
+            n = self.cluster_nodes.get(node_id)
+            return n is not None and n.alive
+
+        resubmitted = set()
+        for oid, e in list(self.gcs.objects.items()):
+            if e.state != "ready":
+                continue
+            if getattr(e.loc, "kind", None) == "inline":
+                continue  # payload rides in the location itself
+            loc_node = getattr(e.loc, "node_id", None)
+            if loc_node != nid:
+                continue
+            survivors = [c for c in e.copies
+                         if getattr(c, "node_id", None) != nid
+                         and (getattr(c, "node_id", None) is None
+                              or alive(c.node_id))]
+            if survivors:
+                e.loc = survivors[0]
+                e.copies = [c for c in survivors if c is not e.loc]
+                continue
+            task_id = e.owner_task
+            spec = self._lineage_specs.get(task_id) if task_id else None
+            if (spec is not None and spec.actor_id is None
+                    and not getattr(spec, "streaming", False)):
+                if task_id not in resubmitted:
+                    resubmitted.add(task_id)
+                    te = self.gcs.tasks.get(task_id)
+                    if te is not None:
+                        te.state = "PENDING"
+                        te.finished_at = None
+                    for roid in spec.return_ids:
+                        re_ = self.gcs.objects.get(roid)
+                        if re_ is not None:
+                            re_.state, re_.loc, re_.error = ("pending",
+                                                             None, None)
+                    self._respawnable_specs[task_id] = spec
+                    self.pending_tasks.append(spec)
+                    sys.stderr.write(
+                        f"[ray_tpu] node {nid} died; reconstructing "
+                        f"{spec.name} ({task_id}) for lost objects\n")
+            else:
+                self._fail_object(oid, ObjectLostError(
+                    f"object {oid} lived only on dead node {nid} and "
+                    "its producing task is not re-executable"))
 
     def fetch_bytes(self, loc) -> bytes:
         """Pull a remote object's packed payload through its node agent.
@@ -1472,7 +1533,13 @@ class DriverRuntime:
                 self._fail_object(oid, error)
             self._gen_settle(task_id, error)
         te.finished_at = time.time()
-        self._respawnable_specs.pop(task_id, None)
+        spec = self._respawnable_specs.pop(task_id, None)
+        if spec is not None and error is None and spec.actor_id is None:
+            # retain for lineage reconstruction of this task's outputs
+            # (bounded: oldest lineage drops first)
+            self._lineage_specs[task_id] = spec
+            while len(self._lineage_specs) > self._LINEAGE_RETAIN:
+                self._lineage_specs.pop(next(iter(self._lineage_specs)))
         if te.actor_id is not None:
             aid = te.actor_id
             self.actor_inflight[aid] = max(

@@ -109,3 +109,63 @@ def test_pg_reschedules_onto_replacement_node(failover_cluster):
          for i in range(2)], timeout=60)
     assert set(nodes) == {rt.node_id, nid2}
     proc2.terminate()
+
+
+@ray_tpu.remote
+def _deterministic_blob(n, tag):
+    import numpy as np
+    return {"tag": tag, "data": np.arange(n) * 2}
+
+
+def test_lineage_reconstruction_after_node_death(failover_cluster):
+    rt = failover_cluster
+    proc, nid = _start_agent(rt, {"doomed2": 1.0})
+    # produce on the doomed node; DON'T fetch (payload stays remote)
+    ref = _deterministic_blob.options(
+        resources={"doomed2": 1}).remote(200_000, "v1")  # > INLINE_MAX
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        e = rt.gcs.objects.get(ref.id)
+        if e is not None and e.state == "ready":
+            break
+        time.sleep(0.05)
+    assert rt.gcs.objects[ref.id].state == "ready"
+    proc.kill()
+    proc.wait(timeout=10)
+    # reconstruction re-runs the task (on the surviving driver node,
+    # since "doomed2" died with the node, the spec's resources... the
+    # task required doomed2 -> can't reschedule!) — so use a CPU-only
+    # task for the reconstructable case below and assert THIS one fails
+    # cleanly instead.
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_lineage_reconstruction_reruns_cpu_task(failover_cluster):
+    rt = failover_cluster
+    proc, nid = _start_agent(rt, {"side2": 1.0})
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    # CPU task pinned SOFTLY to the doomed node: after the node dies the
+    # re-run lands on the driver node
+    ref = _deterministic_blob.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            nid, soft=True)).remote(150_000, "v2")  # > INLINE_MAX
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        e = rt.gcs.objects.get(ref.id)
+        if e is not None and e.state == "ready":
+            break
+        time.sleep(0.05)
+    e = rt.gcs.objects[ref.id]
+    assert e.state == "ready"
+    produced_on = getattr(e.loc, "node_id", None)
+    proc.kill()
+    proc.wait(timeout=10)
+    out = ray_tpu.get(ref, timeout=60)
+    assert out["tag"] == "v2" and int(out["data"][250]) == 500
+    assert len(out["data"]) == 150_000
+    if produced_on == nid:
+        # genuinely reconstructed (not just read from the driver copy)
+        e2 = rt.gcs.objects[ref.id]
+        assert getattr(e2.loc, "node_id", None) != nid
