@@ -609,17 +609,16 @@ class DriverRuntime:
             spec = self._lineage_specs.get(task_id) if task_id else None
             if (spec is not None and spec.actor_id is None
                     and not getattr(spec, "streaming", False)):
+                # Reset ONLY this lost object — sibling returns that are
+                # inline or still have live payloads keep serving reads;
+                # the re-run's seal simply refreshes their location.
+                e.state, e.loc, e.error = "pending", None, None
                 if task_id not in resubmitted:
                     resubmitted.add(task_id)
                     te = self.gcs.tasks.get(task_id)
                     if te is not None:
                         te.state = "PENDING"
                         te.finished_at = None
-                    for roid in spec.return_ids:
-                        re_ = self.gcs.objects.get(roid)
-                        if re_ is not None:
-                            re_.state, re_.loc, re_.error = ("pending",
-                                                             None, None)
                     self._respawnable_specs[task_id] = spec
                     self.pending_tasks.append(spec)
                     sys.stderr.write(

@@ -196,11 +196,13 @@ class Dataset:
         if not fractions or sum(fractions) >= 1.0 \
                 or any(f <= 0 for f in fractions):
             raise ValueError("fractions must be positive and sum to <1")
-        total = self.count()
+        # one plan execution: rows are materialized once and len() serves
+        # as the count
+        rows = list(self.iter_rows())
+        total = len(rows)
         sizes = [int(total * f) for f in fractions]
         out: List["Dataset"] = []
         start = 0
-        rows = list(self.iter_rows())
         for sz in sizes + [total - sum(sizes)]:
             out.append(from_items(rows[start:start + sz]))
             start += sz

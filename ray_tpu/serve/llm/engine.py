@@ -197,7 +197,10 @@ class LLMEngine:
         the chosen token under the RAW model distribution)."""
         jnp = self._jnp
         jax = self._jax
-        raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # cfg.logprobs is a plain Python bool at trace time: disabled
+        # engines compile WITHOUT the full-vocab log_softmax + gather
+        raw_logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+                    if self.cfg.logprobs else None)
         if self.cfg.top_k and self.cfg.top_k > 0:
             kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -224,8 +227,11 @@ class LLMEngine:
                               lambda s: s, scaled)
         sampled = jax.random.categorical(rng_key, scaled, axis=-1)
         toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-        logps = jnp.take_along_axis(raw_logp, toks[:, None],
-                                    axis=-1)[:, 0]
+        if raw_logp is None:
+            logps = jnp.zeros(toks.shape, jnp.float32)
+        else:
+            logps = jnp.take_along_axis(raw_logp, toks[:, None],
+                                        axis=-1)[:, 0]
         return toks, logps
 
     def _prefill_impl(self, params, cache, tokens, slot, true_len, temp,
