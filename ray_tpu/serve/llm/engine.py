@@ -104,8 +104,13 @@ def _engine_metrics():
     `engine` tag — re-instantiating per engine would clobber the
     registry entry and drop earlier engines' series)."""
     global _metrics_singletons
+    from ...util import metrics as metrics_mod  # noqa: PLC0415
+    if (_metrics_singletons is not None
+            and metrics_mod.get_metric("llm_engine_tokens_generated")
+            is not _metrics_singletons[0]):
+        # the registry was cleared (tests do); re-register fresh metrics
+        _metrics_singletons = None
     if _metrics_singletons is None:
-        from ...util import metrics as metrics_mod  # noqa: PLC0415
         _metrics_singletons = (
             metrics_mod.Counter("llm_engine_tokens_generated",
                                 "tokens sampled across all requests",
