@@ -16,7 +16,7 @@ import importlib
 from .api import (init, shutdown, is_initialized, remote, get, put, wait,
                   kill, cancel, get_actor, free, cluster_resources,
                   available_resources, get_runtime_context, method, nodes,
-                  timeline, get_tpu_ids)
+                  timeline, get_tpu_ids, actor_exit)
 from .core.object_ref import ObjectRef, ObjectRefGenerator
 from .core.actor import ActorHandle
 from . import exceptions
@@ -40,7 +40,8 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "free", "cluster_resources",
     "available_resources", "get_runtime_context", "method", "nodes",
-    "timeline", "get_tpu_ids", "ObjectRef", "ObjectRefGenerator",
+    "timeline", "get_tpu_ids", "actor_exit", "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "exceptions", "__version__", *_LAZY_SUBMODULES,
 ]

@@ -254,6 +254,19 @@ def free(refs: Sequence[ObjectRef]):
     runtime_mod.get_runtime().free(list(refs))
 
 
+def actor_exit():
+    """Gracefully shut down the current actor from inside one of its
+    methods (reference: ray.actor.exit_actor). The in-flight call
+    returns None; the actor dies without restart; subsequent calls
+    raise ActorDiedError."""
+    from .exceptions import ActorExitRequest  # noqa: PLC0415
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver or getattr(rt, "current_actor_id", None) is None:
+        raise RuntimeError("actor_exit() must be called inside an "
+                           "actor method")
+    raise ActorExitRequest()
+
+
 def method(**opts):
     """Per-method actor defaults, e.g. `@ray_tpu.method(num_returns=2)`.
 

@@ -449,6 +449,8 @@ class DriverRuntime:
             self._gen_abandon_worker(m[1])
         elif mtype == "actor_created":
             self._on_actor_created(wid, m[1], m[2], m[3])
+        elif mtype == "actor_exit":
+            self._on_actor_exit(m[1])
         elif mtype == "put":
             self._seal(m[1], m[2])
         elif mtype == "submit":
@@ -1614,19 +1616,43 @@ class DriverRuntime:
         if w.actor_id:
             self._on_actor_worker_dead(w.actor_id, wid)
 
-    def _on_actor_worker_dead(self, aid: str, wid: str):
-        ae = self.gcs.actors.get(aid)
-        if ae is None or ae.state == "DEAD":
-            return
-        # fail in-flight tasks on that actor
+    def _fail_inflight_actor_tasks(self, aid: str, cause: str) -> None:
+        err = ActorDiedError(f"actor {aid} {cause}")
         for task_id, te in self.gcs.tasks.items():
             if te.actor_id == aid and te.state == "RUNNING":
                 te.state = "FAILED"
-                err = ActorDiedError(f"actor {aid} worker died")
                 for oid in self._return_ids_of(task_id):
                     self._fail_object(oid, err)
                 self._gen_settle(task_id, err)
         self.actor_inflight[aid] = 0
+
+    def _drain_actor_queue(self, aid: str, cause: str) -> None:
+        err = ActorDiedError(f"actor {aid} {cause}")
+        for spec in self.actor_queues.get(aid, []):
+            self.gcs.tasks[spec.task_id].state = "FAILED"
+            for oid in spec.return_ids:
+                self._fail_object(oid, err)
+            self._gen_settle(spec.task_id, err)
+        self.actor_queues.pop(aid, None)
+
+    def _on_actor_exit(self, aid: str) -> None:
+        """Graceful self-exit (ray_tpu.actor_exit()): DEAD before the
+        socket-close event so no restart happens; any OTHER in-flight or
+        queued calls fail like a death (the exiting call itself already
+        completed)."""
+        ae = self.gcs.actors.get(aid)
+        if ae is None or ae.state == "DEAD":
+            return
+        ae.state = "DEAD"
+        ae.death_cause = "actor_exit() called"
+        self._fail_inflight_actor_tasks(aid, "exited via actor_exit()")
+        self._drain_actor_queue(aid, "exited via actor_exit()")
+
+    def _on_actor_worker_dead(self, aid: str, wid: str):
+        ae = self.gcs.actors.get(aid)
+        if ae is None or ae.state == "DEAD":
+            return
+        self._fail_inflight_actor_tasks(aid, "worker died")
         if ae.num_restarts < ae.max_restarts:
             ae.num_restarts += 1
             ae.state = "RESTARTING"
@@ -1639,13 +1665,7 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or f"worker {wid} died"
-            for spec in self.actor_queues.get(aid, []):
-                self.gcs.tasks[spec.task_id].state = "FAILED"
-                err = ActorDiedError(f"actor {aid} died")
-                for oid in spec.return_ids:
-                    self._fail_object(oid, err)
-                self._gen_settle(spec.task_id, err)
-            self.actor_queues.pop(aid, None)
+            self._drain_actor_queue(aid, "died")
 
     # ---------------- worker-side blocking verbs ----------------
     def _worker_get(self, w: Optional[WorkerState], rid, oids, timeout):

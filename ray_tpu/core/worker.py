@@ -411,6 +411,7 @@ class WorkerLoop:
         return False
 
     def _run_actor_task(self, spec: TaskSpec) -> None:
+        from ..exceptions import ActorExitRequest  # noqa: PLC0415
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
@@ -422,6 +423,13 @@ class WorkerLoop:
                 return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
+        except ActorExitRequest:
+            # graceful self-exit: this call returns None, then the actor
+            # goes down for good (no restart)
+            sealed = self._seal_returns(spec, None)
+            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self.conn.send(("actor_exit", self.rt.current_actor_id))
+            os._exit(0)  # works from threadpool threads too
         except BaseException as e:  # noqa: BLE001
             err = TaskError(repr(e), traceback.format_exc(),
                             f"{type(self._actor_instance).__name__}."

@@ -66,3 +66,9 @@ class ObjectStoreFullError(RayTpuError):
 class PlacementGroupError(RayTpuError):
     """A placement group cannot be satisfied (e.g. STRICT_SPREAD with more
     bundles than alive nodes)."""
+
+
+class ActorExitRequest(RayTpuError):
+    """Raised by ray_tpu.actor_exit() inside an actor method: the current
+    call completes as a normal (None) result and the actor shuts down
+    gracefully without restart (reference: ray.actor.exit_actor)."""

@@ -134,3 +134,39 @@ def test_async_actor(rt):
     refs = [a.work.remote(0.3) for _ in range(6)]
     assert ray_tpu.get(refs) == [0.3] * 6
     assert time.time() - t0 < 1.2
+
+
+def test_actor_exit_graceful(rt):
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def __init__(self):
+            self.n = 0
+
+        def work(self):
+            self.n += 1
+            return self.n
+
+        def quit(self):
+            ray_tpu.actor_exit()
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.work.remote(), timeout=30) == 1
+    # the exiting call completes with None
+    assert ray_tpu.get(q.quit.remote(), timeout=30) is None
+    # despite max_restarts, a graceful exit is final
+    import time as _t
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        try:
+            ray_tpu.get(q.work.remote(), timeout=5)
+            _t.sleep(0.2)
+        except Exception as e:
+            assert "died" in str(e).lower() or "Died" in type(e).__name__
+            break
+    else:
+        raise AssertionError("actor did not stay dead")
+
+
+def test_actor_exit_outside_actor_raises(rt):
+    with pytest.raises(RuntimeError):
+        ray_tpu.actor_exit()
