@@ -93,7 +93,7 @@ def _resolve_pg_strategy(opts: Dict[str, Any]) -> Dict[str, Any]:
 class RemoteFunction:
     def __init__(self, fn, *, num_cpus=None, num_tpus=None, resources=None,
                  num_returns=1, max_retries=0, retry_exceptions=False,
-                 placement_group=None, bundle_index=-1,
+                 max_calls=0, placement_group=None, bundle_index=-1,
                  scheduling_strategy=None, runtime_env=None):
         from .core import runtime_env as renv_mod
         self._fn = fn
@@ -102,6 +102,7 @@ class RemoteFunction:
                           resources=resources, num_returns=num_returns,
                           max_retries=max_retries,
                           retry_exceptions=retry_exceptions,
+                          max_calls=max_calls,
                           placement_group=placement_group,
                           bundle_index=bundle_index,
                           scheduling_strategy=scheduling_strategy,
@@ -134,6 +135,7 @@ class RemoteFunction:
                 resources=o["resources"]),
             max_retries=o["max_retries"],
             retry_exceptions=o["retry_exceptions"],
+            max_calls=o.get("max_calls", 0),
             func_bytes=self._func_bytes, func_id=self._func_id,
             placement_group_id=getattr(pg, "pg_id", None),
             bundle_index=o.get("bundle_index", -1),
@@ -172,8 +174,9 @@ def remote(*args, **kwargs):
                               **{k: v for k, v in opts.items()
                                  if k in allowed})
         allowed = ("num_cpus", "num_tpus", "resources", "num_returns",
-                   "max_retries", "retry_exceptions", "placement_group",
-                   "bundle_index", "scheduling_strategy", "runtime_env")
+                   "max_retries", "retry_exceptions", "max_calls",
+                   "placement_group", "bundle_index",
+                   "scheduling_strategy", "runtime_env")
         return RemoteFunction(target,
                               **{k: v for k, v in opts.items()
                                  if k in allowed})

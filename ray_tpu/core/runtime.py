@@ -72,7 +72,8 @@ def _non_cpu(held: Dict[str, float]) -> Dict[str, float]:
 class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
                  "actor_id", "held_resources", "held_tpu_ids", "blocked",
-                 "started_at", "purpose", "tpu_capable", "node_id")
+                 "started_at", "purpose", "tpu_capable", "node_id",
+                 "func_calls")
 
     def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
                  purpose=None, tpu_capable: bool = False,
@@ -86,6 +87,7 @@ class WorkerState:
         self.actor_id: Optional[str] = None
         self.held_resources: Dict[str, float] = {}
         self.held_tpu_ids: List[int] = []
+        self.func_calls: Dict[str, int] = {}   # func_id -> executions
         self.blocked = False
         self.started_at = time.time()
         self.purpose = purpose         # None (general) | actor_id
@@ -1550,6 +1552,14 @@ class DriverRuntime:
             self._return_tpu_ids(w)
             w.held_resources = {}
             w.state, w.current_task, w.blocked = "idle", None, False
+            if spec is not None and getattr(spec, "max_calls", 0) > 0:
+                # worker recycling (@remote(max_calls=N)): retire the
+                # process once it has run this function N times — the
+                # escape hatch for leaky native libraries
+                w.func_calls[spec.func_id] = \
+                    w.func_calls.get(spec.func_id, 0) + 1
+                if w.func_calls[spec.func_id] >= spec.max_calls:
+                    self._terminate_worker(w)
 
     def _return_ids_of(self, task_id: str) -> List[str]:
         return [oid for oid, e in self.gcs.objects.items()

@@ -24,6 +24,10 @@ class TaskSpec:
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
     max_retries: int = 0
     retry_exceptions: bool = False
+    # recycle the executing worker after this many calls of this
+    # function (0 = never; reference: @ray.remote(max_calls=N) for
+    # leaky native libraries)
+    max_calls: int = 0
     # streaming-generator task: yielded items become individually sealed
     # objects announced via "gen_item"; return_ids stays empty
     streaming: bool = False
@@ -81,7 +85,8 @@ def extract_arg_deps(args: Tuple, kwargs: Dict[str, Any]) -> List[str]:
 
 def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
                    resources=None, max_retries=0, retry_exceptions=False,
-                   func_bytes=None, func_id="", placement_group_id=None,
+                   max_calls=0, func_bytes=None, func_id="",
+                   placement_group_id=None,
                    bundle_index=-1, scheduling_strategy=None,
                    runtime_env=None) -> TaskSpec:
     tid = new_task_id()
@@ -97,6 +102,7 @@ def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
         resources=dict(resources or {"CPU": 1.0}),
         max_retries=max_retries,
         retry_exceptions=retry_exceptions,
+        max_calls=max_calls,
         func_id=func_id,
         placement_group_id=placement_group_id,
         bundle_index=bundle_index,

@@ -121,3 +121,17 @@ def test_options_override(rt):
 def test_cluster_resources(rt):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] >= 1
+
+
+def test_max_calls_recycles_worker(rt):
+    @ray_tpu.remote(max_calls=2)
+    def whoami():
+        import os
+        return os.getpid()
+
+    pids = [ray_tpu.get(whoami.remote(), timeout=30) for _ in range(6)]
+    # every pid appears at most max_calls times
+    from collections import Counter
+    counts = Counter(pids)
+    assert all(c <= 2 for c in counts.values()), counts
+    assert len(counts) >= 3
