@@ -166,6 +166,10 @@ def remote(*args, **kwargs):
     """`@remote` decorator for tasks and actors, with or without options."""
     def decorate(target, opts):
         if isinstance(target, type):
+            if opts.get("max_calls"):
+                raise ValueError(
+                    "max_calls is not supported for actors (reference "
+                    "semantics); use max_restarts or actor_exit()")
             allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
                        "max_concurrency", "name", "namespace", "lifetime",
                        "runtime_env", "placement_group", "bundle_index",

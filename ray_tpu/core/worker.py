@@ -440,6 +440,7 @@ class WorkerLoop:
         """Streaming from an `async def ... yield` actor method. Requires
         num_returns=\"streaming\" on the call (enforced below — a plain
         call would otherwise try to seal an async_generator object)."""
+        from ..exceptions import ActorExitRequest  # noqa: PLC0415
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
@@ -457,18 +458,28 @@ class WorkerLoop:
                 self._put_gen_item(spec, item)
             self.conn.send(("task_done", spec.task_id, [],
                             "cancelled" if cancelled else None))
+        except ActorExitRequest:
+            self.conn.send(("task_done", spec.task_id, [], None))
+            self.conn.send(("actor_exit", self.rt.current_actor_id))
+            os._exit(0)
         except BaseException as e:  # noqa: BLE001
             err = TaskError(repr(e), traceback.format_exc(),
                             f"asyncgen.{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
 
     async def _run_actor_task_async(self, spec: TaskSpec) -> None:
+        from ..exceptions import ActorExitRequest  # noqa: PLC0415
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
             result = await method(*args, **kwargs)
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
+        except ActorExitRequest:
+            sealed = self._seal_returns(spec, None)
+            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self.conn.send(("actor_exit", self.rt.current_actor_id))
+            os._exit(0)
         except BaseException as e:  # noqa: BLE001
             err = TaskError(repr(e), traceback.format_exc(),
                             f"async.{spec.method_name}")

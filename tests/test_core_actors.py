@@ -170,3 +170,34 @@ def test_actor_exit_graceful(rt):
 def test_actor_exit_outside_actor_raises(rt):
     with pytest.raises(RuntimeError):
         ray_tpu.actor_exit()
+
+
+def test_actor_exit_from_async_method(rt):
+    @ray_tpu.remote
+    class AQuitter:
+        async def quit(self):
+            ray_tpu.actor_exit()
+
+        async def ping(self):
+            return "alive"
+
+    a = AQuitter.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "alive"
+    assert ray_tpu.get(a.quit.remote(), timeout=30) is None
+    import time as _t
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=5)
+            _t.sleep(0.2)
+        except Exception:
+            break
+    else:
+        raise AssertionError("async actor did not exit")
+
+
+def test_max_calls_rejected_for_actors():
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(max_calls=3)
+        class Nope:
+            pass
