@@ -169,8 +169,11 @@ class LLMEngine:
         self._top_ps_dev = None
         self._mask_dirty = True
         self._shutdown = threading.Event()
+        # no "preempted" stat: slots are statically sized for
+        # prompt+budget at admission, so mid-stream KV eviction (vLLM's
+        # preemption trigger) cannot occur by construction
         self.stats = {"prefills": 0, "decode_steps": 0,
-                      "tokens_generated": 0, "preempted": 0}
+                      "tokens_generated": 0}
         # surfaced on the shared metrics registry (/metrics, dashboard);
         # one labeled series per engine instance
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
