@@ -572,3 +572,23 @@ def test_llm_engine_stream_detailed_logprobs(tiny_llm):
             eng2.shutdown()
     finally:
         eng.shutdown()
+
+
+def test_llm_engine_serves_moe_model():
+    """The engine's cache contract covers MoE decoders too (Mixtral) —
+    the fork's LLM-serving scope is not Llama-only."""
+    import jax
+    from ray_tpu.models import Mixtral, MixtralConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    cfg = MixtralConfig.debug()
+    model = Mixtral(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16,)))
+    try:
+        outs = [eng.generate_sync(np.arange(1, 8 + i) % 256,
+                                  max_new_tokens=6) for i in range(3)]
+        assert all(len(o) == 6 for o in outs)
+        assert all(0 <= t < 256 for o in outs for t in o)
+    finally:
+        eng.shutdown()
