@@ -13,7 +13,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops import layer_norm, multi_head_attention
+from ..ops import layer_norm, multi_head_attention, cached_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +35,10 @@ class GPT2Config:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.n_heads   # MHA: the serving engine sizes KV by this
 
     @staticmethod
     def small(**kw) -> "GPT2Config":      # 124M
@@ -58,7 +62,7 @@ class GPT2Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, positions=None):
         cfg = self.cfg
         ln1_w = self.param("ln_1_scale", nn.initializers.ones, (cfg.d_model,))
         ln1_b = self.param("ln_1_bias", nn.initializers.zeros, (cfg.d_model,))
@@ -72,8 +76,12 @@ class GPT2Block(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        att = multi_head_attention(q, k, v, causal=True,
-                                   impl=cfg.attn_impl)
+        new_cache = None
+        if cache is None:
+            att = multi_head_attention(q, k, v, causal=True,
+                                       impl=cfg.attn_impl)
+        else:
+            att, new_cache = cached_attention(q, k, v, cache, positions)
         att = att.reshape(b, s, cfg.d_model)
         x = x + nn.Dense(cfg.d_model, name="attn_out", dtype=cfg.dtype)(att)
 
@@ -81,14 +89,19 @@ class GPT2Block(nn.Module):
         h = nn.Dense(cfg.d_ff, name="fc_in", dtype=cfg.dtype)(h)
         h = jax.nn.gelu(h)
         x = x + nn.Dense(cfg.d_model, name="fc_out", dtype=cfg.dtype)(h)
-        return x
+        return x, new_cache
 
 
 class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, cache=None, positions=None):
+        """tokens (B, S) -> (logits, cache) when cache is given, plain
+        logits otherwise (training callers predate the serving
+        contract). With cache: same per-layer (k, v, lengths) pytree as
+        Llama, so the LLM engine serves GPT-2 too; `positions` also
+        select the learned positional embeddings."""
         cfg = self.cfg
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, name="wte",
                        dtype=cfg.dtype,
@@ -97,10 +110,16 @@ class GPT2(nn.Module):
                        dtype=cfg.dtype,
                        embedding_init=nn.initializers.normal(0.01))
         b, s = tokens.shape
-        x = wte(tokens) + wpe(jnp.arange(s)[None, :])
-        block_cls = nn.remat(GPT2Block) if cfg.remat else GPT2Block
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        x = wte(tokens) + wpe(jnp.clip(positions, 0, cfg.max_seq_len - 1))
+        block_cls = (nn.remat(GPT2Block)
+                     if (cfg.remat and cache is None) else GPT2Block)
+        new_cache = []
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"h_{i}")(x)
+            x, c = block_cls(cfg, name=f"h_{i}")(
+                x, None if cache is None else cache[i], positions)
+            new_cache.append(c)
         lnf_w = self.param("ln_f_scale", nn.initializers.ones, (cfg.d_model,))
         lnf_b = self.param("ln_f_bias", nn.initializers.zeros, (cfg.d_model,))
         x = layer_norm(x, lnf_w, lnf_b, cfg.norm_eps)
@@ -112,7 +131,9 @@ class GPT2(nn.Module):
         logits = jnp.einsum("bsd,vd->bsv", x,
                             wte.embedding.astype(x.dtype),
                             preferred_element_type=jnp.float32)
-        return logits
+        if cache is None:
+            return logits
+        return logits, new_cache
 
     def init_params(self, rng, batch=1, seq=8):
         tokens = jnp.zeros((batch, seq), dtype=jnp.int32)

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import (rms_norm, apply_rotary, rope_frequencies,
+                   cached_attention,
                    multi_head_attention, swiglu)
 
 
@@ -103,29 +104,9 @@ class LlamaAttention(nn.Module):
             out = multi_head_attention(q, k, v, causal=True,
                                        impl=cfg.attn_impl)
         else:
-            # Decode: write new k/v at `positions`, attend over prefix.
-            ck, cv, lengths = cache  # (B, L, Hkv, D) x2, (B,)
-            idx = jnp.arange(b)
-            ck = ck.at[idx[:, None], positions].set(k.astype(ck.dtype))
-            cv = cv.at[idx[:, None], positions].set(v.astype(cv.dtype))
-            new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
-            # mask out slots beyond each row's length
-            L = ck.shape[1]
-            valid = jnp.arange(L)[None, :] < new_lengths[:, None]
-            logits_mask = jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
-            rep = cfg.n_heads // cfg.n_kv_heads
-            kk = jnp.repeat(ck, rep, axis=2)
-            vv = jnp.repeat(cv, rep, axis=2)
-            att = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
-                             preferred_element_type=jnp.float32) * hd ** -0.5
-            att = att + logits_mask[:, None, None, :]
-            # causal within the written span
-            pos_k = jnp.arange(L)[None, None, None, :]
-            pos_q = positions[:, None, :, None]
-            att = jnp.where(pos_k <= pos_q, att, jnp.finfo(jnp.float32).min)
-            probs = jax.nn.softmax(att, axis=-1).astype(q.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
-            new_cache = (ck, cv, new_lengths)
+            # Decode: write new k/v at `positions`, attend over prefix
+            # (shared zoo-wide cached path, ops/attention.py).
+            out, new_cache = cached_attention(q, k, v, cache, positions)
 
         out = out.reshape(b, s, cfg.n_heads * hd)
         out = nn.Dense(cfg.d_model, use_bias=False, name="o_proj",

@@ -7,13 +7,15 @@ Every op here is jit-traceable with static shapes.
 """
 from .norms import rms_norm, layer_norm
 from .rotary import apply_rotary, rope_frequencies
-from .attention import multi_head_attention, causal_attention_mask
+from .attention import (multi_head_attention, causal_attention_mask,
+                        cached_attention)
 from .activations import swiglu, geglu
 from .ring_attention import ring_attention
 from .moe import (moe_dispatch_combine, top_k_routing, expert_capacity,
                   MoEAux)
 
 __all__ = ["rms_norm", "layer_norm", "apply_rotary", "rope_frequencies",
-           "multi_head_attention", "causal_attention_mask", "swiglu",
+           "multi_head_attention", "causal_attention_mask",
+           "cached_attention", "swiglu",
            "geglu", "ring_attention", "moe_dispatch_combine",
            "top_k_routing", "expert_capacity", "MoEAux"]

@@ -97,6 +97,42 @@ def _resolve_impl(impl: str, q: jax.Array, k: jax.Array, causal: bool,
     return "pallas"
 
 
+def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
+                     positions: jax.Array,
+                     scale: Optional[float] = None):
+    """Decode/continuation attention against a per-sequence KV cache.
+
+    q/k/v: (B, S, H{q,kv}, D) for the NEW tokens; cache = (ck, cv,
+    lengths) with ck/cv (B, L, Hkv, D) and lengths (B,). Writes k/v at
+    `positions` (B, S), attends causally over the written prefix, and
+    returns (out (B, S, Hq, D), new_cache). Shared by every decoder in
+    the zoo (llama.py, gpt2.py) — the engine's serving contract."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    ck, cv, lengths = cache
+    idx = jnp.arange(b)
+    ck = ck.at[idx[:, None], positions].set(k.astype(ck.dtype))
+    cv = cv.at[idx[:, None], positions].set(v.astype(cv.dtype))
+    new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+    L = ck.shape[1]
+    valid = jnp.arange(L)[None, :] < new_lengths[:, None]
+    logits_mask = jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
+    rep = hq // hkv
+    kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
+    vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                     preferred_element_type=jnp.float32) * scale
+    att = att + logits_mask[:, None, None, :]
+    pos_k = jnp.arange(L)[None, None, None, :]
+    pos_q = positions[:, None, :, None]
+    att = jnp.where(pos_k <= pos_q, att, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    return out, (ck, cv, new_lengths)
+
+
 def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          *, causal: bool = True,
                          segment_ids: Optional[jax.Array] = None,

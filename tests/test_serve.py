@@ -592,3 +592,32 @@ def test_llm_engine_serves_moe_model():
         assert all(0 <= t < 256 for o in outs for t in o)
     finally:
         eng.shutdown()
+
+
+def test_llm_engine_serves_gpt2():
+    """GPT-2 now implements the zoo-wide cache contract: greedy engine
+    decode equals the dense-forward argmax continuation."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    cfg = GPT2Config.debug(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = np.arange(1, 9) % 256
+
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=64, prefill_buckets=(16,)))
+    try:
+        got = eng.generate_sync(prompt, max_new_tokens=5,
+                                temperature=0.0)
+    finally:
+        eng.shutdown()
+
+    # dense greedy reference (no cache)
+    toks = list(prompt)
+    for _ in range(5):
+        logits = model.apply({"params": params},
+                             jnp.asarray([toks], jnp.int32))
+        toks.append(int(np.argmax(np.asarray(logits[0, -1]))))
+    assert got == toks[len(prompt):], (got, toks[len(prompt):])
