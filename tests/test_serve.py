@@ -274,6 +274,9 @@ def test_max_queued_requests_backpressure():
         return "done"
 
     h = serve.run(blocker.bind(), name="bp-app", route_prefix="/bp")
+    # warm the replica so the timing below measures the queue, not
+    # replica spin-up (this suite shares one CPU with jit compiles)
+    assert h.remote(None).result(timeout_s=60) == "done"
     first = h.remote(None)  # occupies the single replica slot
 
     hit = []
@@ -282,18 +285,18 @@ def test_max_queued_requests_backpressure():
         # the second caller will spin waiting for capacity, holding the
         # queued-request token...
         try:
-            h.remote(None).result(timeout_s=10)
+            h.remote(None).result(timeout_s=30)
         except Exception as e:
             hit.append(e)
 
     t = threading.Thread(target=try_second, daemon=True)
     t.start()
-    time.sleep(0.3)
+    time.sleep(0.5)
     # ...so a third immediate call must bounce with BackPressureError.
     with pytest.raises(serve.BackPressureError):
         h.remote(None)
-    assert first.result(timeout_s=10) == "done"
-    t.join(timeout=15)
+    assert first.result(timeout_s=30) == "done"
+    t.join(timeout=35)
 
 
 # ---- LLM engine --------------------------------------------------------
