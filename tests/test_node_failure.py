@@ -117,6 +117,7 @@ def _deterministic_blob(n, tag):
     return {"tag": tag, "data": np.arange(n) * 2}
 
 
+@pytest.mark.slow
 def test_lineage_reconstruction_after_node_death(failover_cluster):
     rt = failover_cluster
     proc, nid = _start_agent(rt, {"doomed2": 1.0})

@@ -389,6 +389,7 @@ def test_llm_serve_deployment(tiny_llm):
     assert stats["prefills"] >= 2
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("block", [3])
 def test_decode_block_matches_single_step(block):
     """Fused K-step decode (lax.scan) must be token-identical to the
@@ -417,6 +418,7 @@ def test_decode_block_matches_single_step(block):
     assert len(outs[block][1]) == 32 - 28
 
 
+@pytest.mark.slow
 def test_batched_prefill_matches_serial():
     """max_prefill_batch>1 groups same-bucket prompts into one jitted
     prefill; greedy outputs must match the serial path exactly."""
@@ -491,6 +493,7 @@ def test_llm_engine_metrics_registered(tiny_llm):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_engine_chunked_prefill_matches_whole():
     """Chunked prefill must produce the same greedy continuation as the
     monolithic prefill (same KV contents, same samples)."""
@@ -528,6 +531,7 @@ def test_llm_engine_chunked_prefill_matches_whole():
     assert got2 == ref
 
 
+@pytest.mark.slow
 def test_llm_engine_chunked_and_short_interleave():
     import jax
     from ray_tpu.models import Llama, LlamaConfig
@@ -552,6 +556,7 @@ def test_llm_engine_chunked_and_short_interleave():
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_engine_stream_detailed_logprobs(tiny_llm):
     from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
     model, params = tiny_llm
@@ -577,6 +582,7 @@ def test_llm_engine_stream_detailed_logprobs(tiny_llm):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_engine_serves_moe_model():
     """The engine's cache contract covers MoE decoders too (Mixtral) —
     the fork's LLM-serving scope is not Llama-only."""
@@ -597,6 +603,7 @@ def test_llm_engine_serves_moe_model():
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_engine_serves_gpt2():
     """GPT-2 now implements the zoo-wide cache contract: greedy engine
     decode equals the dense-forward argmax continuation."""

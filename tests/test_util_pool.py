@@ -16,7 +16,8 @@ def _addmul(a, b):
 
 def test_pool_map(rt):
     with Pool(processes=4) as p:
-        assert p.map(_sq, range(20)) == [i * i for i in range(20)]
+        assert p.map(_sq, range(20), chunksize=5) == \
+            [i * i for i in range(20)]
 
 
 def test_pool_starmap_and_chunksize(rt):
