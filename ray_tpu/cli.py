@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -120,6 +122,49 @@ def cmd_job(args):
         sys.exit(0 if status == "SUCCEEDED" else 1)
 
 
+def cmd_serve(args):
+    """`ray_tpu serve run module:app` — import an Application and serve
+    it, blocking (reference: `serve run` CLI). status/shutdown talk to a
+    live controller through the dashboard-less in-process runtime."""
+    import importlib
+
+    import ray_tpu
+    from ray_tpu import serve as serve_mod
+
+    if args.serve_cmd == "run":
+        if ":" not in args.target:
+            sys.stderr.write("error: target must be module:attribute, "
+                             "e.g. myapp:app\n")
+            sys.exit(2)
+        mod_name, attr = args.target.split(":", 1)
+        sys.path.insert(0, os.getcwd())
+        app = getattr(importlib.import_module(mod_name), attr)
+        ray_tpu.init()
+        kwargs = {}
+        if args.route_prefix is not None:
+            kwargs["route_prefix"] = args.route_prefix
+        serve_mod.run(app, name=args.name, **kwargs)
+        from .serve.http_proxy import start_proxy
+        _proxy, port = start_proxy(host=args.host, port=args.port)
+        print(f"serving {args.target} on http://{args.host}:{port}",
+              flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            serve_mod.shutdown()
+        return
+    if args.serve_cmd == "status":
+        import json as jsonmod
+        ray_tpu.init()
+        print(jsonmod.dumps(serve_mod.status(), indent=2, default=str))
+        return
+    if args.serve_cmd == "shutdown":
+        ray_tpu.init()
+        serve_mod.shutdown()
+        print("serve shut down")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster state CLI")
@@ -149,6 +194,18 @@ def main(argv=None):
 
     sub.add_parser("metrics", help="Prometheus exposition").set_defaults(
         fn=cmd_metrics)
+
+    svp = sub.add_parser("serve", help="serve an Application over HTTP")
+    svsub = svp.add_subparsers(dest="serve_cmd", required=True)
+    svr = svsub.add_parser("run", help="import module:app and serve it")
+    svr.add_argument("target")
+    svr.add_argument("--name", default="default")
+    svr.add_argument("--route-prefix", default=None)
+    svr.add_argument("--host", default="127.0.0.1")
+    svr.add_argument("--port", type=int, default=8000)
+    svr.set_defaults(fn=cmd_serve)
+    svsub.add_parser("status").set_defaults(fn=cmd_serve)
+    svsub.add_parser("shutdown").set_defaults(fn=cmd_serve)
 
     jp = sub.add_parser("job", help="run a driver script as a job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

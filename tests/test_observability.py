@@ -344,3 +344,50 @@ def test_profiler_trace_and_timing(tmp_path):
     r = profiler.timed_steps(step, jnp.float32(0), jnp.ones((4, 4)),
                              warmup=1, iters=3)
     assert r["steps_per_s"] > 0
+
+
+def test_cli_serve_run(tmp_path):
+    """`ray_tpu serve run module:app` serves over real HTTP."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    import time as _time
+    import urllib.request
+    app_py = tmp_path / "myapp.py"
+    app_py.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "def hello(body):\n"
+        "    return {'hello': body}\n"
+        "app = hello.bind()\n")
+    import os as _os
+    env = dict(_os.environ)
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env["PYTHONPATH"] = _os.pathsep.join(
+        [repo, str(tmp_path), *env.get("PYTHONPATH", "").split(_os.pathsep)])
+    from ray_tpu.util.jaxenv import subprocess_env_cpu
+    subprocess_env_cpu(env)
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "ray_tpu", "serve", "run", "myapp:app",
+         "--port", "0"],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving myapp:app on http://" in line, line
+        url = line.strip().rsplit(" ", 1)[-1]
+        deadline = _time.time() + 20
+        out = None
+        while _time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    url + "/", data=_json.dumps(7).encode(),
+                    headers={"Content-Type": "application/json"})
+                out = _json.loads(
+                    urllib.request.urlopen(req, timeout=5).read())
+                break
+            except Exception:
+                _time.sleep(0.3)
+        assert out == {"hello": 7}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
