@@ -124,8 +124,9 @@ def cmd_job(args):
 
 def cmd_serve(args):
     """`ray_tpu serve run module:app` — import an Application and serve
-    it, blocking (reference: `serve run` CLI). status/shutdown talk to a
-    live controller through the dashboard-less in-process runtime."""
+    it, blocking (reference: `serve run` CLI). `serve status` reads the
+    live driver's dashboard (--address); there is no remote shutdown —
+    Ctrl-C the `serve run` process."""
     import importlib
 
     import ray_tpu
@@ -155,14 +156,10 @@ def cmd_serve(args):
             serve_mod.shutdown()
         return
     if args.serve_cmd == "status":
-        import json as jsonmod
-        ray_tpu.init()
-        print(jsonmod.dumps(serve_mod.status(), indent=2, default=str))
+        # read-only: attach to the LIVE driver via its dashboard (an
+        # in-process runtime would report an empty fresh cluster)
+        print(json.dumps(_fetch(args.address, "/api/serve"), indent=2))
         return
-    if args.serve_cmd == "shutdown":
-        ray_tpu.init()
-        serve_mod.shutdown()
-        print("serve shut down")
 
 
 def main(argv=None):
@@ -204,8 +201,11 @@ def main(argv=None):
     svr.add_argument("--host", default="127.0.0.1")
     svr.add_argument("--port", type=int, default=8000)
     svr.set_defaults(fn=cmd_serve)
-    svsub.add_parser("status").set_defaults(fn=cmd_serve)
-    svsub.add_parser("shutdown").set_defaults(fn=cmd_serve)
+    svst = svsub.add_parser(
+        "status", help="serve apps of the live driver (via --address "
+                       "dashboard); stop a served app with Ctrl-C on "
+                       "its `serve run` process")
+    svst.set_defaults(fn=cmd_serve)
 
     jp = sub.add_parser("job", help="run a driver script as a job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

@@ -142,6 +142,13 @@ class LLMEngine:
         mcfg = model.cfg
         if cfg.eos_token_id is None:
             cfg.eos_token_id = getattr(mcfg, "eos_token_id", None)
+        model_max = getattr(mcfg, "max_seq_len", None)
+        if model_max is not None and cfg.max_seq_len > model_max:
+            # absolute-position models (GPT-2's learned wpe) would
+            # silently reuse their last embedding past this; fail loudly
+            raise ValueError(
+                f"engine max_seq_len {cfg.max_seq_len} exceeds the "
+                f"model's max_seq_len {model_max}")
         S, L = cfg.max_slots, cfg.max_seq_len
         # +1 scratch slot when prefill batching is on: padding rows of a
         # batched prefill write their KV there; it is never admitted, so

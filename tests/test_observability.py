@@ -371,6 +371,10 @@ def test_cli_serve_run(tmp_path):
         [_sys.executable, "-m", "ray_tpu", "serve", "run", "myapp:app",
          "--port", "0"],
         env=env, cwd=str(tmp_path), stdout=subprocess.PIPE, text=True)
+    # watchdog: a wedged child must fail the test, not hang readline()
+    import threading as _threading
+    killer = _threading.Timer(60, proc.kill)
+    killer.start()
     try:
         line = proc.stdout.readline()
         assert "serving myapp:app on http://" in line, line
@@ -389,5 +393,6 @@ def test_cli_serve_run(tmp_path):
                 _time.sleep(0.3)
         assert out == {"hello": 7}
     finally:
+        killer.cancel()
         proc.terminate()
         proc.wait(timeout=10)

@@ -631,3 +631,11 @@ def test_llm_engine_serves_gpt2():
                              jnp.asarray([toks], jnp.int32))
         toks.append(int(np.argmax(np.asarray(logits[0, -1]))))
     assert got == toks[len(prompt):], (got, toks[len(prompt):])
+
+
+def test_engine_rejects_seq_len_beyond_model(tiny_llm):
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm   # model max_seq_len = 128
+    with pytest.raises(ValueError):
+        LLMEngine(model, params, LLMEngineConfig(max_slots=2,
+                                                 max_seq_len=256))
