@@ -323,6 +323,50 @@ class Dataset:
     def columns(self) -> List[str]:
         return list(self.schema().keys())
 
+    # ---- global aggregate terminals (reference: Dataset.sum/mean/...) ----
+    def _col_blocks(self, col: str):
+        for block in self.iter_blocks():
+            if col not in block:
+                raise KeyError(f"no column {col!r}; have "
+                               f"{list(block.keys())}")
+            yield np.asarray(block[col])
+
+    def sum(self, col: str):
+        return sum(b.sum() for b in self._col_blocks(col))
+
+    def min(self, col: str):
+        return min(b.min() for b in self._col_blocks(col))
+
+    def max(self, col: str):
+        return max(b.max() for b in self._col_blocks(col))
+
+    def mean(self, col: str) -> float:
+        total, n = 0.0, 0
+        for b in self._col_blocks(col):
+            total += float(b.sum())
+            n += b.size
+        return total / max(n, 1)
+
+    def std(self, col: str, ddof: int = 1) -> float:
+        # two-pass over streamed blocks: exact, no full materialization
+        mu = self.mean(col)
+        ssq, n = 0.0, 0
+        for b in self._col_blocks(col):
+            ssq += float(((b - mu) ** 2).sum())
+            n += b.size
+        return math.sqrt(ssq / max(n - ddof, 1))
+
+    def unique(self, col: str) -> List[Any]:
+        seen = set()
+        out: List[Any] = []
+        for b in self._col_blocks(col):
+            for v in np.unique(b):
+                key = v.item() if hasattr(v, "item") else v
+                if key not in seen:
+                    seen.add(key)
+                    out.append(key)
+        return out
+
     def size_bytes(self) -> int:
         return sum(block_size_bytes(b) for b in self.iter_blocks())
 

@@ -266,3 +266,20 @@ def test_split_proportionately_and_train_test():
         ds.split_proportionately([0.7, 0.5])
     with _pytest.raises(ValueError):
         ds.train_test_split(1.5)
+
+
+def test_global_aggregates_and_unique():
+    import math
+    import numpy as np
+    import ray_tpu.data as rdata
+    ds = rdata.range(100).map(lambda r: {"id": r["id"],
+                                         "mod": r["id"] % 5})
+    assert ds.sum("id") == 4950
+    assert ds.min("id") == 0 and ds.max("id") == 99
+    assert abs(ds.mean("id") - 49.5) < 1e-9
+    ref = np.arange(100)
+    assert abs(ds.std("id") - ref.std(ddof=1)) < 1e-9
+    assert sorted(ds.unique("mod")) == [0, 1, 2, 3, 4]
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        ds.sum("nope")
