@@ -639,3 +639,25 @@ def test_engine_rejects_seq_len_beyond_model(tiny_llm):
     with pytest.raises(ValueError):
         LLMEngine(model, params, LLMEngineConfig(max_slots=2,
                                                  max_seq_len=256))
+
+
+@pytest.mark.slow
+def test_decode_block_with_logprobs(tiny_llm):
+    """The lax.scan decode path must thread logprobs correctly too."""
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=64, prefill_buckets=(16,),
+        decode_block=3, logprobs=True))
+    try:
+        rid = eng.submit(np.arange(1, 6), max_new_tokens=6,
+                         temperature=0.0)
+        pairs = list(eng.stream_detailed(rid))
+        assert len(pairs) == 6
+        assert all(lp is not None and lp <= 0.0 for _t, lp in pairs)
+        # greedy chosen token is the argmax -> logprob bounded well away
+        # from uniform
+        import math
+        assert all(lp > math.log(1.0 / 128) for _t, lp in pairs)
+    finally:
+        eng.shutdown()
