@@ -11,6 +11,7 @@ from .env import (BanditEnv, CartPole, Env, GridWorld, Space, VectorEnv,
                   make_env, register_env)
 from .env_runner import EnvRunner
 from .grpo import (EngineSampler, GRPOConfig, GRPOLearner, GRPOTrainer,
+                   make_lora_grpo_trainer,
                    group_relative_advantages)
 from .learner import Learner, LearnerGroup
 from .ppo import PPO, PPOConfig
@@ -22,6 +23,7 @@ from .sample_batch import SampleBatch, compute_gae, concat_samples
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "EngineSampler", "GRPOConfig", "GRPOLearner", "GRPOTrainer",
+    "make_lora_grpo_trainer",
     "group_relative_advantages",
     "Env", "Space", "CartPole", "GridWorld", "BanditEnv", "VectorEnv",
     "make_env", "register_env", "EnvRunner", "Learner", "LearnerGroup",

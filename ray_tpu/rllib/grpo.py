@@ -273,3 +273,41 @@ class GRPOTrainer:
     def shutdown(self) -> None:
         if self.sampler is not None and hasattr(self.sampler, "shutdown"):
             self.sampler.shutdown()
+
+
+def make_lora_grpo_trainer(model, base_params, lora, reward_fn, *,
+                           cfg: Optional[GRPOConfig] = None,
+                           eos_id: Optional[int] = None,
+                           max_seq_len: int = 512) -> GRPOTrainer:
+    """GRPO post-training over LoRA ADAPTERS: the policy update touches
+    only the adapter pytree (optimizer state O(adapter)), the frozen
+    base keeps its shardings, and sampling still runs through the serve
+    engine — the engine receives the merged weights after every step.
+    The KL reference is the initial (zero-delta) policy.
+
+    Standard recipe composition: train/lora.py provides the adapters;
+    this wires them into the GRPO loop end-to-end.
+    """
+    from ..train.lora import merge_lora  # noqa: PLC0415
+
+    meta = {"rank": lora["rank"], "alpha": lora["alpha"]}
+
+    def apply_fn(adapters, tokens):
+        merged = merge_lora(base_params, {**meta, "adapters": adapters})
+        out = model.apply({"params": merged}, tokens)
+        return out[0] if isinstance(out, tuple) else out
+
+    trainer = GRPOTrainer(apply_fn=apply_fn, params=lora["adapters"],
+                          reward_fn=reward_fn, cfg=cfg, eos_id=eos_id)
+    sampler = EngineSampler(model, merge_lora(base_params, lora),
+                            cfg or trainer.cfg, eos_id=eos_id,
+                            max_seq_len=max_seq_len)
+    push_merged = sampler.set_params
+
+    def set_params(adapters):
+        push_merged(merge_lora(base_params,
+                               {**meta, "adapters": adapters}))
+
+    sampler.set_params = set_params
+    trainer.sampler = sampler
+    return trainer
