@@ -68,3 +68,17 @@ def test_lora_train_only_moves_adapters(tiny):
     # merged model evaluates with the trained adapters (sanity forward)
     logits, _ = model.apply({"params": merged}, batch["tokens"])
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_lora_checkpoint_roundtrip(tiny, tmp_path):
+    from ray_tpu.train import save_pytree, restore_pytree
+    _cfg, _model, params = tiny
+    lora = init_lora(params, jax.random.PRNGKey(3), rank=4,
+                     targets=("q_proj",))
+    path = str(tmp_path / "lora_ckpt")
+    save_pytree(lora, path)
+    back = restore_pytree(path, target=lora)
+    assert back["rank"] == 4
+    for a, b in zip(jax.tree_util.tree_leaves(lora["adapters"]),
+                    jax.tree_util.tree_leaves(back["adapters"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
