@@ -74,6 +74,10 @@ class LLMEngineConfig:
     # distribution) to the host and expose it via stream_detailed().
     # Off by default: it adds one small device->host array per step.
     logprobs: bool = False
+    # Compile every prefill bucket + the decode step during __init__
+    # (blocking) so the first real request never pays a jit compile —
+    # the dominant term in cold TTFT (seconds even for toy models).
+    precompile: bool = False
 
 
 @dataclass
@@ -88,8 +92,12 @@ class _Request:
         default_factory=lambda: queue_mod.Queue(maxsize=4096))
     slot: int = -1
     generated: int = 0
+    aborted: bool = False
     prefill_pos: int = 0            # next prompt index (chunked prefill)
     submit_ts: float = field(default_factory=time.time)
+    admit_ts: Optional[float] = None       # slot assigned
+    prefill_dispatch_ms: float = 0.0       # host time in the prefill
+                                           # call (compile on first use)
     first_token_ts: Optional[float] = None
 
 
@@ -181,6 +189,11 @@ class LLMEngine:
         # preemption trigger) cannot occur by construction
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "tokens_generated": 0}
+        # TTFT breakdown (VERDICT r4 ask): queue wait vs prefill
+        # dispatch (compile on a bucket's first use) vs emit lag.
+        self._ttft_samples: collections.deque = collections.deque(
+            maxlen=512)
+        self._prefill_compile_ms: Dict[int, float] = {}  # bucket -> ms
         # surfaced on the shared metrics registry (/metrics, dashboard);
         # one labeled series per engine instance
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
@@ -203,6 +216,8 @@ class LLMEngine:
         self._loop_thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
         self._loop_thread.start()
+        if cfg.precompile:
+            self.precompile()
 
     # ---- jitted kernels ---------------------------------------------------
     def _sample_tokens(self, logits, temps, top_ps, rng_key):
@@ -396,8 +411,7 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        if not (self.cfg.prefill_chunk > 0
-                and prompt.size > self.cfg.prefill_chunk):
+        if not self._use_chunked(prompt.size):
             # chunked prompts bypass the buckets; all others must fit one
             self._bucket(prompt.size)  # validate in the caller, not loop
         budget = max_new_tokens or self.cfg.max_new_tokens_default
@@ -439,14 +453,61 @@ class LLMEngine:
             self._requests.pop(request_id, None)
 
     def abort(self, request_id: str) -> None:
-        """Best-effort early termination: the request's budget collapses
-        to what it has already generated, so the engine releases its slot
-        at the next drain. The consumer should keep draining its stream
-        to the end marker (a few lagged tokens may still arrive)."""
+        """Best-effort early termination. Decoding requests collapse
+        their budget to what they have already generated, so the engine
+        releases the slot at the next drain (the consumer should keep
+        draining to the end marker; a few lagged tokens may still
+        arrive). Requests that have not produced a token yet — still
+        queued or chunk-prefilling — are cancelled outright: no prefill
+        runs, no token is forced."""
         req = self._requests.get(request_id)
-        if req is not None:
-            req.max_new_tokens = min(req.max_new_tokens,
-                                     max(req.generated, 1))
+        if req is None:
+            return
+        req.aborted = True
+        if req.generated == 0 and req.slot == -1:
+            # still in _waiting: the loop discards it at admission;
+            # unblock the consumer immediately (a duplicate end marker
+            # from a concurrent admission is harmless — the consumer
+            # stops at the first one)
+            req.out_queue.put(_END)
+        elif req.generated > 0:
+            req.max_new_tokens = min(req.max_new_tokens, req.generated)
+        # else: slot assigned but no token yet (chunk-prefilling / prefill
+        # in flight) — the loop cancels it at its next touch point
+
+    def precompile(self) -> None:
+        """Warm every jitted path before real traffic: one dummy request
+        per prefill bucket plus one chunked prompt when chunking is on,
+        each generating 2 tokens (prefill sample + one decode step).
+        Blocks until the dummy streams drain; afterwards all slots are
+        free again (stats do count the dummy work)."""
+        rids = []
+        prev = 0
+        for b in sorted(self.cfg.prefill_buckets):
+            if b > self.cfg.max_seq_len:
+                continue
+            # smallest prompt length that maps to THIS bucket and takes
+            # the bucket (non-chunked) path — a length-b dummy would be
+            # routed through chunked prefill whenever b > prefill_chunk,
+            # leaving the bucket's jit cold (review r4)
+            n = min(b, self.cfg.max_seq_len - 2)
+            if self.cfg.prefill_chunk > 0:
+                n = min(n, self.cfg.prefill_chunk)
+            n = max(1, n)
+            if n <= prev:
+                prev = b
+                continue  # no non-chunked prompt can reach this bucket
+            rids.append(self.submit(np.ones((n,), np.int32),
+                                    max_new_tokens=2))
+            prev = b
+        if self.cfg.prefill_chunk > 0:
+            n = max(1, min(self.cfg.prefill_chunk + 1,
+                           self.cfg.max_seq_len - 2))
+            rids.append(self.submit(np.ones((n,), np.int32),
+                                    max_new_tokens=2))
+        for rid in rids:
+            for _ in self.stream(rid):
+                pass
 
     def generate_sync(self, prompt_ids, max_new_tokens=None,
                       temperature: float = 0.0, top_p: float = 1.0,
@@ -457,10 +518,20 @@ class LLMEngine:
 
     def get_stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {**self.stats, "active": len(self._active),
-                    "waiting": self._waiting.qsize(),
-                    "prefilling": len(self._prefilling),
-                    "free_slots": len(self._free_slots)}
+            out = {**self.stats, "active": len(self._active),
+                   "waiting": self._waiting.qsize(),
+                   "prefilling": len(self._prefilling),
+                   "free_slots": len(self._free_slots)}
+            samples = list(self._ttft_samples)
+        if samples:
+            def p50(key):
+                vals = sorted(s[key] for s in samples)
+                return round(vals[len(vals) // 2], 1)
+            out["ttft_breakdown_p50_ms"] = {
+                k: p50(k) for k in ("queue_ms", "prefill_dispatch_ms",
+                                    "emit_ms", "total_ms")}
+        out["prefill_compile_ms"] = dict(self._prefill_compile_ms)
+        return out
 
     def shutdown(self):
         self._shutdown.set()
@@ -472,6 +543,16 @@ class LLMEngine:
                 return b
         raise ValueError(f"prompt length {n} exceeds largest prefill "
                          f"bucket {self.cfg.prefill_buckets[-1]}")
+
+    def _use_chunked(self, n: int) -> bool:
+        """Chunked prefill serves prompts longer than prefill_chunk AND
+        any prompt that overflows the largest bucket (so bucket coverage
+        never rejects what the chunked path could handle)."""
+        if self.cfg.prefill_chunk <= 0:
+            return False
+        largest = max((b for b in self.cfg.prefill_buckets
+                       if b <= self.cfg.max_seq_len), default=0)
+        return n > self.cfg.prefill_chunk or n > largest
 
     def _admit_all(self, inflight) -> None:
         """Dispatch prefills for every waiting request that can get a
@@ -485,10 +566,15 @@ class LLMEngine:
                 req = self._waiting.get_nowait()
             except queue_mod.Empty:
                 break
+            if req.aborted:
+                # cancelled before admission: abort() already unblocked
+                # the consumer; never take a slot or prefill
+                self._requests.pop(req.request_id, None)
+                continue
             slot = self._free_slots.pop()
             req.slot = slot
-            if (self.cfg.prefill_chunk > 0
-                    and req.prompt.size > self.cfg.prefill_chunk):
+            req.admit_ts = time.time()
+            if self._use_chunked(req.prompt.size):
                 # long prompt: prefill in chunks interleaved with decode
                 # steps (one chunk per loop iteration)
                 self._prefilling.append(req)
@@ -511,6 +597,7 @@ class LLMEngine:
         rows) so compile count stays O(buckets * log2(cap))."""
         jnp = self._jnp
         g_real = len(members)
+        t_dispatch = time.time()
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
             if g_real == 1 and self.cfg.max_prefill_batch <= 1:
@@ -556,8 +643,12 @@ class LLMEngine:
                 req.out_queue.put(("error", e))
                 req.out_queue.put(_END)
             return
+        dispatch_ms = (time.time() - t_dispatch) * 1000
+        # first dispatch of a bucket blocks on its jit compile: record it
+        self._prefill_compile_ms.setdefault(pad_len, round(dispatch_ms, 1))
         self.stats["prefills"] += g_real
         for req, slot in members:
+            req.prefill_dispatch_ms = dispatch_ms
             self._active[slot] = req
         self._mask_dirty = True
         self._start_fetch(toks_dev)
@@ -571,12 +662,19 @@ class LLMEngine:
         final chunk samples the first token and activates the slot."""
         jnp = self._jnp
         req = self._prefilling[0]
+        if req.aborted:
+            # cancelled mid-chunk-prefill: drop remaining chunks, free
+            # the slot, close the stream with no token forced
+            self._prefilling.popleft()
+            self._release(req)
+            return
         C = self.cfg.prefill_chunk
         start = req.prefill_pos
         true = min(C, req.prompt.size - start)
         is_last = start + true >= req.prompt.size
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :true] = req.prompt[start:start + true]
+        t_dispatch = time.time()
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
             tok_dev, lp_dev, self._cache = self._prefill_chunk_jit(
@@ -592,6 +690,7 @@ class LLMEngine:
             req.out_queue.put(_END)
             return
         req.prefill_pos = start + true
+        req.prefill_dispatch_ms += (time.time() - t_dispatch) * 1000
         if is_last:
             self._prefilling.popleft()
             self.stats["prefills"] += 1
@@ -618,7 +717,15 @@ class LLMEngine:
         self.stats["tokens_generated"] += 1
         self._m_tokens.inc(1.0, tags=self._mtags)
         if req.first_token_ts is None:
-            req.first_token_ts = time.time()
+            now = time.time()
+            req.first_token_ts = now
+            admit = req.admit_ts or req.submit_ts
+            self._ttft_samples.append({
+                "queue_ms": (admit - req.submit_ts) * 1000,
+                "prefill_dispatch_ms": req.prefill_dispatch_ms,
+                "emit_ms": max(0.0, (now - admit) * 1000
+                               - req.prefill_dispatch_ms),
+                "total_ms": (now - req.submit_ts) * 1000})
         req.out_queue.put(("token", (tok, logp)))
         if ((self.cfg.eos_token_id is not None
              and tok == self.cfg.eos_token_id)
@@ -674,6 +781,11 @@ class LLMEngine:
             flat_lps = lps.reshape(-1) if lps is not None else None
             for i, req in enumerate(reqs):
                 if req.slot < 0:
+                    continue
+                if req.aborted and req.generated == 0:
+                    # aborted while the prefill was in flight: discard
+                    # its first token and release without emitting
+                    self._release(req)
                     continue
                 self._emit(req, int(firsts[i]),
                            float(flat_lps[i]) if flat_lps is not None

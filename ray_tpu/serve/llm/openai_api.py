@@ -216,6 +216,19 @@ class OpenAIServer(LLMServer):
                     "choices": [{"index": 0, **choice,
                                  "finish_reason": finish}]}
 
+        def holdback(text: str) -> int:
+            """Length of the longest suffix of `text` that is a prefix
+            of some stop string. That tail is withheld from the client:
+            if the stop completes on a later token it must never have
+            been sent (streamed and unary outputs would diverge)."""
+            h = 0
+            for s in stops:
+                for k in range(min(len(s), len(text)), h, -1):
+                    if text.endswith(s[:k]):
+                        h = max(h, k)
+                        break
+            return h
+
         def gen():
             if lead_chunk is not None:
                 yield wrap(lead_chunk)
@@ -223,6 +236,7 @@ class OpenAIServer(LLMServer):
             toks: List[int] = []
             last_tok = None
             by_string = False
+            full = ""
             for tok, _lp in self.engine.stream_detailed(rid):
                 if by_string:
                     continue  # draining to the end marker post-abort
@@ -230,14 +244,24 @@ class OpenAIServer(LLMServer):
                 last_tok = tok
                 full, by_string = self._apply_stops(
                     self._decode_text(toks), stops)
-                delta = full[len(emitted):]
+                # withhold any tail that could still grow into a stop
+                # match (a suffix of the truncated text never reaches
+                # back into already-emitted text: that prefix was itself
+                # a stop prefix and was withheld on the earlier step)
+                safe = full if by_string else full[:len(full)
+                                                   - holdback(full)]
+                delta = safe[len(emitted):]
                 if delta:
-                    emitted = full
+                    emitted = safe
                     yield wrap(content_chunk(delta))
                 if by_string:
                     # stop sequence landed: cut the engine request short
                     # but keep consuming so its stream closes cleanly
                     self.engine.abort(rid)
+            if not by_string and len(full) > len(emitted):
+                # stream ended (budget/EOS) with a withheld partial stop
+                # match that can no longer complete: flush it
+                yield wrap(content_chunk(full[len(emitted):]))
             yield wrap(final_extra(), finish=self._finish_reason(
                 len(toks), effective, last_tok, stop_ids, by_string))
             yield "[DONE]"

@@ -185,3 +185,52 @@ def test_completions_logprobs(openai_app):
     # the max, so it must be > log(1/vocab)
     import math
     assert all(x > math.log(1.0 / 128) for x in lp["token_logprobs"])
+
+
+def test_stream_withholds_partial_stop_match(openai_app):
+    """Streamed deltas must never contain text that a later-completing
+    multi-char stop string truncates: the concatenated stream equals the
+    unary result for the same request (ADVICE r3, medium)."""
+    port = openai_app
+    with _post(port, {"prompt": [9, 8, 7], "max_tokens": 8,
+                      "temperature": 0}) as r:
+        full = json.loads(r.read())["choices"][0]["text"]
+    assert len(full) >= 5
+    stop = full[2:4]                    # 2-char stop seen mid-stream
+    expect = full[:full.find(stop)]
+    with _post(port, {"prompt": [9, 8, 7], "max_tokens": 8,
+                      "temperature": 0, "stop": stop}) as r:
+        unary = json.loads(r.read())["choices"][0]["text"]
+    assert unary == expect
+    with _post(port, {"prompt": [9, 8, 7], "max_tokens": 8,
+                      "temperature": 0, "stop": stop,
+                      "stream": True}) as r:
+        raw = r.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    chunks = [json.loads(e) for e in events[:-1]]
+    streamed = "".join(c["choices"][0].get("text") or "" for c in chunks)
+    assert streamed == unary, (streamed, unary)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_stream_flushes_withheld_tail_on_length_finish(openai_app):
+    """A trailing partial stop match must flush once the stream ends on
+    budget — withholding must not eat final text."""
+    port = openai_app
+    with _post(port, {"prompt": [9, 8, 7], "max_tokens": 8,
+                      "temperature": 0}) as r:
+        full = json.loads(r.read())["choices"][0]["text"]
+    # stop = last char + something that never appears: the last emitted
+    # char is a partial match right up to the end of the stream
+    stop = full[-1] + "\x00"
+    with _post(port, {"prompt": [9, 8, 7], "max_tokens": 8,
+                      "temperature": 0, "stop": stop,
+                      "stream": True}) as r:
+        raw = r.read().decode()
+    events = [line[len("data: "):] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    chunks = [json.loads(e) for e in events[:-1]]
+    streamed = "".join(c["choices"][0].get("text") or "" for c in chunks)
+    assert streamed == full, (streamed, full)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"

@@ -661,3 +661,43 @@ def test_decode_block_with_logprobs(tiny_llm):
         assert all(lp > math.log(1.0 / 128) for _t, lp in pairs)
     finally:
         eng.shutdown()
+
+
+def test_abort_before_first_token_cancels_outright(tiny_llm):
+    """abort() on a request that has not produced a token must NOT force
+    a prefill + one emitted token (ADVICE r3): waiting requests are
+    dropped from the queue and their stream closes empty."""
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=1, max_seq_len=128, prefill_buckets=(16,)))
+    try:
+        a = eng.submit(np.arange(1, 6), max_new_tokens=24)
+        b = eng.submit(np.arange(2, 7), max_new_tokens=24)
+        # b cannot be admitted while a holds the only slot
+        eng.abort(b)
+        toks_b = list(eng.stream(b))
+        assert toks_b == []          # no token was forced
+        toks_a = list(eng.stream(a))
+        assert len(toks_a) == 24     # a was untouched
+        assert eng.get_stats()["prefills"] == 1   # b never prefilled
+    finally:
+        eng.shutdown()
+
+
+def test_prompt_beyond_largest_bucket_uses_chunked_path(tiny_llm):
+    """A prompt longer than every prefill bucket but within
+    prefill_chunk must route through chunked prefill instead of being
+    rejected at submit (ADVICE r3)."""
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    prompt = (np.arange(1, 41) * 3) % 128      # 40 tokens
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16, 32),
+        prefill_chunk=64))
+    try:
+        toks = eng.generate_sync(prompt, max_new_tokens=6)
+        assert len(toks) == 6
+        assert eng.get_stats()["prefills"] == 1
+    finally:
+        eng.shutdown()
