@@ -126,7 +126,7 @@ class GenStream:
     yields; consumers pop them in order via gen_next (reference parity:
     ObjectRefGenerator / streaming generator tasks, _raylet.pyx)."""
     __slots__ = ("task_id", "items", "done", "error", "waiters",
-                 "terminal_sent")
+                 "terminal_sent", "retained")
 
     def __init__(self, task_id: str):
         self.task_id = task_id
@@ -135,6 +135,8 @@ class GenStream:
         self.error: Optional[BaseException] = None
         # each waiter: (cb, abandoned_flag_list); cb((kind, payload))
         self.waiters: collections.deque = collections.deque()
+        # already enqueued on the retention-eviction deque
+        self.retained = False
         # the done/error reply reached a consumer (GC precondition: the
         # real error object must be delivered before the stream drops to
         # the generic task-table fallback)
@@ -247,6 +249,16 @@ class DriverRuntime:
         self._gen_worker_waiters: Dict[str, tuple] = {}
         # settled-but-unconsumed streams, oldest first (bounded retention)
         self._gen_settled: collections.deque = collections.deque()
+        # settled streams still holding undrained items (larger bound)
+        self._gen_undrained: collections.deque = collections.deque()
+        # task_ids whose undrained items were evicted: late consumers
+        # get an explicit ObjectLostError, not a silent "done".
+        # deque bounds the memory; the set makes _gen_lookup's
+        # membership check O(1) on the dispatcher thread.
+        self._gen_evicted: collections.deque = collections.deque()
+        self._gen_evicted_set: set = set()
+        # batched-submission round-trips (compiled DAG test hook)
+        self.submit_many_calls = 0
         self._kv_lock = threading.Lock()
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
@@ -403,6 +415,10 @@ class DriverRuntime:
                 e.loc = item[2]
         elif kind == "api_submit":
             self._register_task(item[1])
+        elif kind == "api_submit_many":
+            # one inbox round-trip for a whole compiled-DAG level
+            for spec in item[1]:
+                self._register_task(spec)
         elif kind == "api_submit_actor":
             self._register_actor_creation(item[1])
         elif kind == "api_seal":
@@ -686,11 +702,17 @@ class DriverRuntime:
         s.items.append(oid)
         self._gen_fire(s)
 
-    # Settled streams a consumer never drained are kept for this many
-    # entries, then evicted oldest-first (their item refs stay valid in
-    # the store; _gen_lookup answers done/error from the task table).
-    # Bounds driver memory for fire-and-forget generator workloads.
+    # Fully-drained settled streams a consumer never took the terminal
+    # reply for are kept for this many entries, then evicted
+    # oldest-first (their item refs stay valid in the store;
+    # _gen_lookup answers done/error from the task table). Settled
+    # streams still HOLDING undrained items get a separate, larger
+    # bound (_GEN_UNDRAINED_RETAIN): evicting one loses item refs, so
+    # it happens only under sustained fire-and-forget abuse and
+    # surfaces as an explicit ObjectLostError, never a silent "done".
+    # Together they bound driver memory for fire-and-forget workloads.
     _GEN_SETTLED_RETAIN = 1024
+    _GEN_UNDRAINED_RETAIN = 4096
 
     def _gen_settle(self, task_id: str, error=None) -> None:
         s = self._gen_streams.get(task_id)
@@ -701,11 +723,38 @@ class DriverRuntime:
         else:
             s.error = error
         self._gen_fire(s)
-        if task_id in self._gen_streams:     # not yet drained+GC'd
-            self._gen_settled.append(task_id)
-            while len(self._gen_settled) > self._GEN_SETTLED_RETAIN:
-                old = self._gen_settled.popleft()
-                self._gen_streams.pop(old, None)
+        if task_id not in self._gen_streams:     # drained+GC'd already
+            return
+        if s.items:
+            self._gen_undrained.append(task_id)
+            while len(self._gen_undrained) > self._GEN_UNDRAINED_RETAIN:
+                old_id = self._gen_undrained.popleft()
+                old = self._gen_streams.get(old_id)
+                if old is None or not old.items:
+                    continue  # drained in the meantime: retained deque
+                              # (or the task table) already covers it
+                self._gen_streams.pop(old_id, None)
+                self._gen_evicted.append(old_id)
+                self._gen_evicted_set.add(old_id)
+                while len(self._gen_evicted) > self._GEN_UNDRAINED_RETAIN:
+                    self._gen_evicted_set.discard(
+                        self._gen_evicted.popleft())
+        else:
+            self._gen_retain(s)
+
+    def _gen_retain(self, s: GenStream) -> None:
+        """Enqueue a settled stream for retention-eviction — but ONLY
+        once it holds no unconsumed item refs: evicting a stream with
+        pending items would make _gen_lookup answer the task-table
+        "done" fallback and silently lose them. Streams still holding
+        items are re-enqueued by _gen_gc when their last item drains."""
+        if s.items or s.retained:
+            return
+        s.retained = True
+        self._gen_settled.append(s.task_id)
+        while len(self._gen_settled) > self._GEN_SETTLED_RETAIN:
+            old = self._gen_settled.popleft()
+            self._gen_streams.pop(old, None)
 
     def _gen_reply(self, s: GenStream):
         """(kind, payload) if the stream can answer now, else None."""
@@ -742,6 +791,11 @@ class DriverRuntime:
         s = self._gen_streams.get(task_id)
         if s is not None:
             return s, None
+        if task_id in self._gen_evicted_set:
+            return None, ("error", ObjectLostError(
+                f"streaming generator {task_id}: undrained item refs "
+                f"were evicted (stream settled and was never consumed "
+                f"past the retention bound)"))
         te = self.gcs.tasks.get(task_id)
         if te is None:
             return None, ("error", ValueError(
@@ -760,6 +814,10 @@ class DriverRuntime:
         from the task table afterwards)."""
         if s.terminal_sent and not s.items and not s.waiters:
             self._gen_streams.pop(s.task_id, None)
+        elif (s.done or s.error is not None) and not s.items:
+            # settled stream just fully drained its items (consumer has
+            # not taken the terminal reply yet): now safe to bound
+            self._gen_retain(s)
 
     def _gen_request(self, task_id: str, cb, abandoned) -> None:
         """Answer immediately if possible, else park the waiter."""
@@ -1876,6 +1934,18 @@ class DriverRuntime:
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         return self.submit(spec)
+
+    def submit_many(self, specs: List[TaskSpec]) -> List[List[ObjectRef]]:
+        """Submit a batch of (task or actor-method) specs in ONE
+        dispatcher round-trip — compiled DAG levels come through here
+        (SURVEY C16: batched submissions; vs one inbox message per
+        .remote() call)."""
+        specs = list(specs)
+        for spec in specs:
+            self._respawnable_specs[spec.task_id] = spec
+        self.inbox.put(("api_submit_many", specs))
+        self.submit_many_calls += 1
+        return [[ObjectRef(oid) for oid in s.return_ids] for s in specs]
 
     def gen_next(self, task_id: str,
                  timeout: Optional[float] = None) -> Optional[ObjectRef]:

@@ -156,6 +156,68 @@ def test_async_actor_streaming_method(rt):
         ray_tpu.get(a.feed.options(num_returns=1).remote(2), timeout=30)
 
 
+def test_settled_stream_with_items_survives_retention(rt):
+    """A settled-but-undrained stream holding item refs must not be
+    evicted by the retention bound — a late consumer still gets every
+    item (ADVICE r3). Fully-drained settled streams ARE bounded."""
+    from ray_tpu.core import runtime as rt_mod
+    node = rt_mod.get_runtime()
+    old = rt_mod.DriverRuntime._GEN_SETTLED_RETAIN
+    rt_mod.DriverRuntime._GEN_SETTLED_RETAIN = 4
+    try:
+        keeper = count_to.remote(3)          # never drained until later
+        # wait for the keeper's stream to settle with its items parked
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            done = [s for s in node._gen_streams.values() if s.done]
+            if done:
+                break
+            time.sleep(0.05)
+        # flood with settled+drained streams to push past the bound
+        for _ in range(8):
+            list(count_to.remote(2))
+        vals = [ray_tpu.get(ref, timeout=30) for ref in keeper]
+        assert vals == [0, 1, 4]             # nothing was lost
+    finally:
+        rt_mod.DriverRuntime._GEN_SETTLED_RETAIN = old
+
+
+def test_undrained_eviction_is_loud(rt):
+    """If sustained fire-and-forget pressure DOES evict a stream that
+    still holds items, late consumers get an explicit ObjectLostError,
+    never a silent task-table 'done'."""
+    from ray_tpu.core import runtime as rt_mod
+    from ray_tpu.exceptions import ObjectLostError
+    node = rt_mod.get_runtime()
+    old = rt_mod.DriverRuntime._GEN_UNDRAINED_RETAIN
+    rt_mod.DriverRuntime._GEN_UNDRAINED_RETAIN = 2
+    try:
+        victim = count_to.remote(3)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(s.done and s.items
+                   for s in node._gen_streams.values()):
+                break
+            time.sleep(0.05)
+        # push past the bound by one eviction: the victim's eviction
+        # record must stay within the (equally-bounded) evicted set
+        refs = [count_to.remote(3) for _ in range(3)]
+        deadline = time.time() + 30
+        while time.time() < deadline and not node._gen_evicted:
+            time.sleep(0.05)
+        assert node._gen_evicted
+        with pytest.raises(ObjectLostError):
+            for ref in victim:
+                ray_tpu.get(ref, timeout=30)
+        for g in refs:      # newer streams still drain fine
+            try:
+                [ray_tpu.get(r, timeout=30) for r in g]
+            except ObjectLostError:
+                pass        # may itself have been evicted: loud is fine
+    finally:
+        rt_mod.DriverRuntime._GEN_UNDRAINED_RETAIN = old
+
+
 # Keep last: re-creates the runtime, which invalidates the module-scoped
 # `rt` fixture for any test that would run after it.
 def test_generator_consumed_in_task_on_one_cpu():
