@@ -117,8 +117,10 @@ class RemoteFunction:
         rf._func_bytes, rf._func_id = self._func_bytes, self._func_id
         return rf
 
-    def remote(self, *args, **kwargs):
-        rt = _ensure_init()
+    def _make_spec(self, rt, args, kwargs):
+        """Build the TaskSpec WITHOUT submitting (compiled DAGs batch
+        specs from many nodes into one runtime.submit_many call).
+        Returns (spec, streaming)."""
         if self._func_bytes is None:
             self._func_bytes = serialization.dumps_call(self._fn)
             self._func_id = hashlib.sha1(self._func_bytes).hexdigest()
@@ -141,6 +143,12 @@ class RemoteFunction:
             bundle_index=o.get("bundle_index", -1),
             scheduling_strategy=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"))
+        return spec, streaming
+
+    def remote(self, *args, **kwargs):
+        rt = _ensure_init()
+        spec, streaming = self._make_spec(rt, args, kwargs)
+        o = self._opts
         if streaming:
             # generator task: items become refs as the remote yields
             spec.streaming = True

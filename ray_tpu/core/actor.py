@@ -56,9 +56,11 @@ class ActorHandle:
         opts = self.__dict__.get("_method_opts", {}).get(name, {})
         return ActorMethod(self, name, **opts)
 
-    def _invoke(self, method_name: str, args, kwargs,
-                num_returns=1) -> Any:
-        rt = runtime_mod.get_runtime()
+    def _make_task_spec(self, method_name: str, args, kwargs,
+                        num_returns=1):
+        """Build the method-call TaskSpec without submitting (compiled
+        DAGs batch these through runtime.submit_many). Returns
+        (spec, streaming)."""
         streaming = num_returns in ("streaming", "dynamic")
         n = 1 if streaming else num_returns
         spec = TaskSpec(
@@ -76,6 +78,13 @@ class ActorHandle:
             streaming=streaming,
             dep_object_ids=extract_arg_deps(args, kwargs),
         )
+        return spec, streaming
+
+    def _invoke(self, method_name: str, args, kwargs,
+                num_returns=1) -> Any:
+        rt = runtime_mod.get_runtime()
+        spec, streaming = self._make_task_spec(method_name, args, kwargs,
+                                               num_returns)
         refs = rt.submit_actor_task(spec)
         if streaming:
             from .object_ref import ObjectRefGenerator  # noqa: PLC0415

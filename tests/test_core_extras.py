@@ -141,6 +141,72 @@ def test_dag_multi_output(rt):
     assert ray_tpu.get(refs) == [6, 10]
 
 
+def test_compiled_dag_levels_and_reuse(rt):
+    """experimental_compile(): one batched driver round-trip per
+    topological level, plan + actor reuse across execute() calls
+    (SURVEY C16; VERDICT r3 item 2)."""
+    from ray_tpu.core import runtime as rt_mod
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    with InputNode() as inp:
+        a = _add.bind(inp, 1)          # level 0
+        b = _mul.bind(inp, 2)          # level 0
+        c = _add.bind(a, 10)           # level 1 (depends on a)
+        dag = MultiOutputNode([c, b])
+    comp = dag.experimental_compile()
+    node = rt_mod.get_runtime()
+
+    before = node.submit_many_calls
+    refs = comp.execute(5)
+    assert ray_tpu.get(refs) == [16, 10]
+    # two levels of submittable nodes -> exactly two batched calls
+    assert comp.stats["submit_calls"] == 2
+    assert node.submit_many_calls - before == 2
+
+    # reuse: same compiled plan, new input, same batch count
+    refs = comp.execute(1)
+    assert ray_tpu.get(refs) == [12, 2]
+    assert comp.stats["submit_calls"] == 2
+    # lazy path still works and agrees
+    assert ray_tpu.get(dag.execute(5)) == [16, 10]
+
+
+def test_compiled_dag_actor_reuse(rt):
+    """Compiled actor-method DAGs keep one actor across executes."""
+    from ray_tpu.dag import InputNode
+    acc = _Accum.bind(0)
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    comp = dag.experimental_compile()
+    assert ray_tpu.get(comp.execute(5)) == 5
+    assert ray_tpu.get(comp.execute(3)) == 8     # same actor state
+    # diamond through an actor + tasks mixes batched and inline fine
+    with InputNode() as inp:
+        dag2 = _mul.bind(acc.add.bind(inp), 2)
+    comp2 = dag2.experimental_compile()
+    assert ray_tpu.get(comp2.execute(2)) == 20   # (8+2)*2
+
+
+def test_compiled_dag_honors_method_num_returns(rt):
+    """@method(num_returns=N) must behave identically under
+    experimental_compile() and the lazy path (review r4)."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Pair:
+        @ray_tpu.method(num_returns=2)
+        def split(self, x):
+            return x, x + 1
+
+    pair = Pair.bind()
+    with InputNode() as inp:
+        dag = pair.split.bind(inp)
+    lazy = ray_tpu.get(dag.execute(5))
+    comp = dag.experimental_compile()
+    compiled = ray_tpu.get(comp.execute(5))
+    assert lazy == compiled == [5, 6]
+
+
 # ---------- workflow ----------
 
 def test_workflow_run_and_resume(rt, tmp_path):
