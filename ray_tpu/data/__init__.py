@@ -7,7 +7,7 @@ from .block import Block
 from .dataset import (Dataset, from_items, from_blocks, from_numpy,
                       from_pandas, range_,
                       read_text, read_jsonl, read_csv, read_npy,
-                      read_parquet, AggregateFn)
+                      read_parquet, read_images, AggregateFn)
 from .device_loader import device_put_iterator
 from . import preprocessors
 
@@ -17,5 +17,5 @@ range = range_  # noqa: A001
 __all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
            "from_pandas",
            "range", "range_", "read_text", "read_jsonl", "read_csv",
-           "read_npy", "read_parquet", "AggregateFn", "device_put_iterator",
-           "preprocessors"]
+           "read_npy", "read_parquet", "read_images", "AggregateFn",
+           "device_put_iterator", "preprocessors"]

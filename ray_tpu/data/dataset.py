@@ -196,16 +196,31 @@ class Dataset:
         if not fractions or sum(fractions) >= 1.0 \
                 or any(f <= 0 for f in fractions):
             raise ValueError("fractions must be positive and sum to <1")
-        # one plan execution: rows are materialized once and len() serves
-        # as the count
-        rows = list(self.iter_rows())
-        total = len(rows)
+        # one plan execution at BLOCK granularity: only boundary blocks
+        # are sliced; interior blocks pass through by reference (no
+        # per-row materialization in driver memory)
+        blocks = list(self.iter_blocks())
+        counts = [block_num_rows(b) for b in blocks]
+        total = sum(counts)
         sizes = [int(total * f) for f in fractions]
+        sizes.append(total - sum(sizes))
         out: List["Dataset"] = []
-        start = 0
-        for sz in sizes + [total - sum(sizes)]:
-            out.append(from_items(rows[start:start + sz]))
-            start += sz
+        bi, off = 0, 0
+        for sz in sizes:
+            need = sz
+            parts: List[Block] = []
+            while need > 0 and bi < len(blocks):
+                take = min(counts[bi] - off, need)
+                if take == counts[bi] and off == 0:
+                    parts.append(blocks[bi])
+                else:
+                    parts.append(block_slice(blocks[bi], off, off + take))
+                need -= take
+                off += take
+                if off >= counts[bi]:
+                    bi += 1
+                    off = 0
+            out.append(from_blocks(parts, name="split_prop"))
         return out
 
     def train_test_split(self, test_size: float, *,
@@ -648,6 +663,63 @@ def read_npy(path: str, column: str = "data",
              block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
     arr = np.load(path)
     return from_numpy({column: arr}, block_rows)
+
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(path: str, *, size: Optional[Tuple[int, int]] = None,
+                mode: str = "RGB", include_paths: bool = False,
+                block_rows: int = 64) -> Dataset:
+    """Directory (recursive) or single file of images -> blocks with an
+    "image" column of uint8 arrays (reference: python/ray/data
+    read_api.py read_images — Arrow/PIL there; numpy blocks + PIL here,
+    feeding the ViT/CLIP pipeline of BASELINE config 3).
+
+    size=(H, W) resizes at decode so the column stacks into one dense
+    (N, H, W, C) array per block — the layout iter_jax_batches ships to
+    TPU. Without `size`, images keep their native resolutions as an
+    object column (stack later with a map_batches resize).
+    """
+    import glob as globmod
+    import os as osmod
+
+    from PIL import Image
+
+    if osmod.path.isdir(path):
+        files = sorted(
+            f for f in globmod.glob(osmod.path.join(path, "**", "*"),
+                                    recursive=True)
+            if f.lower().endswith(_IMAGE_EXTS))
+        if not files:
+            raise FileNotFoundError(f"no image files under {path!r}")
+    else:
+        files = [path]
+
+    def decode(fp: str) -> np.ndarray:
+        with Image.open(fp) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize((size[1], size[0]))  # PIL wants (W, H)
+            return np.asarray(im, dtype=np.uint8)
+
+    def make_blocks():
+        for i in range(0, len(files), block_rows):
+            chunk = files[i:i + block_rows]
+            imgs = [decode(f) for f in chunk]
+            if size is not None:
+                col = np.stack(imgs)
+            else:
+                col = np.empty(len(imgs), dtype=object)
+                for j, a in enumerate(imgs):
+                    col[j] = a
+            block: Block = {"image": col}
+            if include_paths:
+                block["path"] = np.asarray(chunk, dtype=object)
+            yield block
+
+    return Dataset(_Source(f"read_images({path})", make_blocks,
+                           num_rows=len(files)))
 
 
 def read_parquet(path: str,

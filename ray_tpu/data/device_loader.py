@@ -26,6 +26,12 @@ def device_put_iterator(host_batches: Iterator[Dict[str, np.ndarray]],
         out = {}
         for k, v in batch.items():
             arr = np.asarray(v)
+            if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+                # non-numeric columns (paths, labels-as-text) stay on
+                # host — devices only hold numeric arrays (reference:
+                # iter_torch_batches passes non-tensor columns through)
+                out[k] = arr
+                continue
             if dtypes and k in dtypes:
                 arr = arr.astype(dtypes[k])
             elif arr.dtype == np.int64:

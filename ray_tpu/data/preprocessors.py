@@ -150,3 +150,57 @@ class Tokenizer(Preprocessor):
         out[self.output_column] = np.asarray(self.tokenize_fn(texts),
                                              dtype=np.int32)
         return out
+
+
+class ImageAugmenter(Preprocessor):
+    """Host-side decode-time augmentation for the image pipeline
+    (reference: the torchvision transform stacks ray.data examples feed
+    TorchTrainer; here numpy-only so dense uint8 blocks stay the wire
+    format and the device sees ready float batches).
+
+    Operates on an "image" column of (N, H, W, C) uint8: optional
+    horizontal random flip + random crop (pad-and-crop), then scales to
+    float32 and normalizes with per-channel mean/std (defaults: simple
+    [0,1] scaling)."""
+
+    def __init__(self, *, flip: bool = True, crop_padding: int = 0,
+                 mean=None, std=None, column: str = "image",
+                 seed: int = 0):
+        self.flip = flip
+        self.crop_padding = crop_padding
+        self.mean = None if mean is None else np.asarray(
+            mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+        self.column = column
+        self._rng = np.random.RandomState(seed)
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def transform_batch(self, batch: Block) -> Block:
+        imgs = batch[self.column]
+        if imgs.dtype == object:
+            raise ValueError(
+                "ImageAugmenter needs a dense (N,H,W,C) image column; "
+                "pass size=(H,W) to read_images")
+        n, h, w, _c = imgs.shape
+        if self.flip:
+            do = self._rng.rand(n) < 0.5
+            imgs = np.where(do[:, None, None, None],
+                            imgs[:, :, ::-1, :], imgs)
+        if self.crop_padding > 0:
+            p = self.crop_padding
+            padded = np.pad(imgs, ((0, 0), (p, p), (p, p), (0, 0)),
+                            mode="reflect")
+            ys = self._rng.randint(0, 2 * p + 1, size=n)
+            xs = self._rng.randint(0, 2 * p + 1, size=n)
+            imgs = np.stack([padded[i, ys[i]:ys[i] + h,
+                                    xs[i]:xs[i] + w] for i in range(n)])
+        out = dict(batch)
+        x = imgs.astype(np.float32) / 255.0
+        if self.mean is not None or self.std is not None:
+            mean = self.mean if self.mean is not None else 0.0
+            std = self.std if self.std is not None else 1.0
+            x = (x - mean) / std
+        out[self.column] = x
+        return out

@@ -283,3 +283,18 @@ def test_global_aggregates_and_unique():
     import pytest as _pytest
     with _pytest.raises(KeyError):
         ds.sum("nope")
+
+
+def test_split_proportionately_block_level():
+    """Splits slice only boundary blocks instead of materializing rows
+    (ADVICE r3): multi-block dataset, exact sizes, order preserved."""
+    import ray_tpu.data as rd
+    ds = rd.from_items([{"x": i} for i in range(1000)], block_rows=64)
+    a, b, c = ds.split_proportionately([0.33, 0.5])
+    xa = [r["x"] for r in a.iter_rows()]
+    xb = [r["x"] for r in b.iter_rows()]
+    xc = [r["x"] for r in c.iter_rows()]
+    assert len(xa) == 330 and len(xb) == 500 and len(xc) == 170
+    assert xa + xb + xc == list(range(1000))
+    # interior blocks pass through whole: the first split spans >1 block
+    assert len(list(a.iter_blocks())) >= 2

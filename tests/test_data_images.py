@@ -1,0 +1,90 @@
+"""Image pipeline (VERDICT r3 item 8): read_images -> augment ->
+iter_jax_batches -> ViT train step. Reference:
+python/ray/data/read_api.py read_images + the torchvision transform
+pipelines the reference's image examples feed TorchTrainer."""
+import numpy as np
+import pytest
+
+import ray_tpu.data as rd
+
+
+@pytest.fixture()
+def image_dir(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    sub = tmp_path / "cls_a"
+    sub.mkdir()
+    for i in range(10):
+        arr = rng.randint(0, 255, (40 + i, 40, 3), np.uint8)
+        Image.fromarray(arr).save(sub / f"img_{i:02d}.png")
+    return tmp_path
+
+
+def test_read_images_resized_dense(image_dir):
+    ds = rd.read_images(str(image_dir), size=(32, 32),
+                        include_paths=True)
+    blocks = list(ds.iter_blocks())
+    imgs = np.concatenate([b["image"] for b in blocks])
+    assert imgs.shape == (10, 32, 32, 3) and imgs.dtype == np.uint8
+    paths = [p for b in blocks for p in b["path"]]
+    assert all(p.endswith(".png") for p in paths)
+    assert paths == sorted(paths)
+
+
+def test_read_images_native_object_column(image_dir):
+    ds = rd.read_images(str(image_dir))
+    rows = list(ds.iter_rows())
+    assert len(rows) == 10
+    shapes = {r["image"].shape for r in rows}
+    assert len(shapes) == 10          # native sizes preserved
+
+
+def test_image_augmenter_normalizes_and_keeps_shape(image_dir):
+    from ray_tpu.data.preprocessors import ImageAugmenter
+    ds = rd.read_images(str(image_dir), size=(32, 32))
+    aug = ImageAugmenter(flip=True, crop_padding=2,
+                         mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    out = aug.transform(ds)
+    batch = next(out.iter_batches(batch_size=10))
+    x = batch["image"]
+    assert x.shape == (10, 32, 32, 3) and x.dtype == np.float32
+    assert -3.0 < x.mean() < 3.0
+
+
+def test_images_feed_vit_train_step(image_dir):
+    """End-to-end: directory -> blocks -> jax batches -> ViT step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from ray_tpu.data.preprocessors import ImageAugmenter
+    from ray_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.debug()
+    model = ViT(cfg)
+    ds = rd.read_images(str(image_dir), size=(32, 32))
+    ds = ImageAugmenter().transform(ds)
+    labels = np.arange(10) % cfg.num_classes
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    seen = 0
+    for batch in ds.iter_jax_batches(batch_size=5, drop_last=True):
+        images = batch["image"]
+        lab = jnp.asarray(labels[seen:seen + images.shape[0]])
+        params, opt_state, loss = step(params, opt_state, images, lab)
+        seen += int(images.shape[0])
+    assert seen == 10
+    assert np.isfinite(float(loss))
