@@ -1,0 +1,173 @@
+"""HF weight-import parity (VERDICT r3 item 5): the imported flax
+params must reproduce the torch transformers model's logits — the real
+HF modeling code runs as the oracle (zero-egress image: models are
+config-built with random init, which exercises every weight layout and
+transpose exactly like a downloaded checkpoint would).
+
+Reference counterpart: python/ray/train/huggingface weight interop.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _replace(cfg, **kw):
+    import dataclasses
+    return dataclasses.replace(cfg, **kw)
+
+
+@pytest.fixture(scope="module")
+def hf_gpt2():
+    from transformers import GPT2Config as HFGPT2Config
+    from transformers import GPT2LMHeadModel
+    torch.manual_seed(0)
+    hf_cfg = HFGPT2Config(vocab_size=96, n_positions=64, n_embd=48,
+                          n_layer=2, n_head=4, resid_pdrop=0.0,
+                          embd_pdrop=0.0, attn_pdrop=0.0)
+    return GPT2LMHeadModel(hf_cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+    torch.manual_seed(0)
+    hf_cfg = HFLlamaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attention_dropout=0.0)
+    return LlamaForCausalLM(hf_cfg).eval()
+
+
+def test_gpt2_logits_match_hf(hf_gpt2):
+    from ray_tpu.models.gpt2 import GPT2
+    from ray_tpu.train.adapters import import_hf_gpt2_weights
+
+    tokens = np.array([[3, 17, 42, 7, 9, 23, 1, 0]], np.int32)
+    with torch.no_grad():
+        ref = hf_gpt2(torch.tensor(tokens.astype(np.int64))
+                      ).logits.numpy()
+    params, cfg = import_hf_gpt2_weights(hf_gpt2)
+    model = GPT2(_replace(cfg, dtype=jnp.float32))
+    out = model.apply({"params": params}, jnp.asarray(tokens))
+    logits = np.asarray(out[0] if isinstance(out, tuple) else out)
+    np.testing.assert_allclose(logits, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_llama_logits_match_hf(hf_llama):
+    from ray_tpu.models.llama import Llama
+    from ray_tpu.train.adapters import import_hf_llama_weights
+
+    tokens = np.array([[5, 12, 33, 2, 64, 8]], np.int32)
+    with torch.no_grad():
+        ref = hf_llama(torch.tensor(tokens.astype(np.int64))
+                       ).logits.numpy()
+    params, cfg = import_hf_llama_weights(hf_llama)
+    model = Llama(_replace(cfg, dtype=jnp.float32))
+    logits, _ = model.apply({"params": params}, jnp.asarray(tokens))
+    # XLA vs torch-oneDNN fp32 matmul reassociation noise reaches
+    # ~3.5e-3 through 2 rmsnormed blocks; a genuine layout/transpose
+    # bug produces O(1) errors, so 1e-2 still catches real breakage
+    np.testing.assert_allclose(np.asarray(logits), ref,
+                               atol=1e-2, rtol=1e-2)
+    # greedy argmax agreement is the functional bar for serving
+    assert (np.asarray(logits)[0, -1].argmax()
+            == ref[0, -1].argmax())
+
+
+def test_imported_gpt2_greedy_matches_hf_generate(hf_gpt2):
+    """Imported weights served through the continuous-batching engine
+    produce exactly HF's greedy continuation (token-level e2e proof
+    that served outputs are correct, not just shaped right)."""
+    from ray_tpu.models.gpt2 import GPT2
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    from ray_tpu.train.adapters import import_hf_gpt2_weights
+
+    prompt = [3, 17, 42, 7]
+    n_new = 6
+    with torch.no_grad():
+        hf_out = hf_gpt2.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new,
+            do_sample=False, pad_token_id=0)
+    expected = hf_out[0, len(prompt):].tolist()
+
+    params, cfg = import_hf_gpt2_weights(hf_gpt2)
+    model = GPT2(_replace(cfg, dtype=jnp.float32))
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=64, prefill_buckets=(8, 16)))
+    try:
+        got = eng.generate_sync(prompt, max_new_tokens=n_new)
+    finally:
+        eng.shutdown()
+    assert got == expected, (got, expected)
+
+
+def test_imported_gpt2_serves_over_openai_api(hf_gpt2):
+    """Full serving e2e: import -> OpenAI-compatible API -> completion
+    equals HF greedy decode."""
+    import json
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.http_proxy import start_proxy
+    from ray_tpu.serve.llm import build_openai_deployment
+    from ray_tpu.train.adapters import import_hf_gpt2_weights
+
+    prompt = [3, 17, 42, 7]
+    n_new = 5
+    with torch.no_grad():
+        hf_out = hf_gpt2.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new,
+            do_sample=False, pad_token_id=0)
+    expected = hf_out[0, len(prompt):].tolist()
+
+    params, cfg = import_hf_gpt2_weights(hf_gpt2)
+
+    def factory(cfg=cfg, params=params):
+        from ray_tpu.models.gpt2 import GPT2
+        return GPT2(_replace(cfg, dtype=jnp.float32)), params
+
+    class IdTok:
+        """decode: id -> "<id>" so the completion text spells out the
+        exact sampled token ids."""
+
+        def encode(self, text):
+            return [int(t) for t in text.strip("<>").split("><")]
+
+        def decode(self, ids):
+            return "".join(f"<{int(t)}>" for t in ids)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        app = build_openai_deployment(
+            factory, tokenizer=IdTok(),
+            engine_config={"max_slots": 2, "max_seq_len": 64,
+                           "prefill_buckets": (8, 16)},
+            model_name="hf-gpt2-import")
+        serve.run(app, name="hf-import", route_prefix="/v1")
+        _proxy, port = start_proxy(port=0)
+        time.sleep(1.0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": n_new,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["usage"]["completion_tokens"] == n_new
+        assert out["choices"][0]["finish_reason"] == "length"
+        # the completion text IS the sampled id sequence: must equal
+        # HF's greedy continuation exactly
+        assert out["choices"][0]["text"] == \
+            "".join(f"<{t}>" for t in expected)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
