@@ -100,7 +100,14 @@ def cmd_metrics(args):
 
 def cmd_job(args):
     from .core.jobs import JobSubmissionClient
-    client = JobSubmissionClient()
+    # submit runs the entrypoint as a local child unless --remote sends
+    # it to the --address dashboard's /api/jobs (reference: ray job CLI
+    # -> dashboard job head). The query verbs (status/logs/stop/list)
+    # ALWAYS go over HTTP: a fresh CLI process has no local job table,
+    # so local mode could never find anything.
+    remote = (args.job_cmd != "submit"
+              or getattr(args, "remote", False))
+    client = JobSubmissionClient(address=args.address if remote else None)
     if args.job_cmd == "submit":
         entry = list(args.entrypoint)
         if entry and entry[0] == "--":       # `job submit -- cmd ...`
@@ -110,6 +117,9 @@ def cmd_job(args):
                              "e.g. `ray_tpu job submit -- python x.py`\n")
             sys.exit(2)
         sid = client.submit_job(entrypoint=" ".join(entry))
+        if args.no_wait:
+            print(sid)
+            return
         try:
             status = client.wait_until_finished(sid, timeout=args.timeout)
         except TimeoutError:
@@ -120,6 +130,41 @@ def cmd_job(args):
         print(client.get_job_logs(sid), end="")
         print(f"job {sid}: {status}")
         sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "list":
+        try:
+            _print_table(client.list_jobs(),
+                         ["submission_id", "status", "entrypoint"])
+        except ValueError as e:
+            sys.stderr.write(f"error: {e}\n")
+            sys.exit(1)
+        except OSError as e:
+            sys.stderr.write(f"error: cannot reach dashboard at "
+                             f"{args.address}: {e}\n")
+            sys.exit(1)
+    else:
+        try:
+            if args.job_cmd == "status":
+                print(client.get_job_status(args.submission_id))
+            elif args.job_cmd == "logs":
+                if args.follow:
+                    for piece in client.tail_job_logs(
+                            args.submission_id):
+                        sys.stdout.write(piece)
+                        sys.stdout.flush()
+                else:
+                    sys.stdout.write(
+                        client.get_job_logs(args.submission_id))
+            elif args.job_cmd == "stop":
+                stopped = client.stop_job(args.submission_id)
+                print(f"job {args.submission_id}: "
+                      f"{'stopped' if stopped else 'already finished'}")
+        except ValueError as e:
+            sys.stderr.write(f"error: {e}\n")
+            sys.exit(1)
+        except OSError as e:
+            sys.stderr.write(f"error: cannot reach dashboard at "
+                             f"{args.address}: {e}\n")
+            sys.exit(1)
 
 
 def cmd_serve(args):
@@ -211,8 +256,21 @@ def main(argv=None):
     jsub = jp.add_subparsers(dest="job_cmd", required=True)
     jsp = jsub.add_parser("submit")
     jsp.add_argument("--timeout", type=float, default=3600.0)
+    jsp.add_argument("--no-wait", action="store_true",
+                     help="print the submission id and return")
+    jsp.add_argument("--remote", action="store_true",
+                     help="submit via --address dashboard /api/jobs")
     jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
     jsp.set_defaults(fn=cmd_job)
+    jls = jsub.add_parser("list", help="jobs on the --address dashboard")
+    jls.set_defaults(fn=cmd_job)
+    for verb in ("status", "logs", "stop"):
+        jv = jsub.add_parser(verb,
+                             help=f"{verb} via the --address dashboard")
+        jv.add_argument("submission_id")
+        if verb == "logs":
+            jv.add_argument("--follow", action="store_true")
+        jv.set_defaults(fn=cmd_job)
 
     npp = sub.add_parser(
         "node", help="join this host to a driver as a node agent "

@@ -50,17 +50,55 @@ class _Job:
 
 
 class JobSubmissionClient:
-    """Reference-parity client. address is accepted and ignored (local)."""
+    """Reference-parity client (python/ray/job_submission). Two modes:
+
+    * local (address=None): jobs run as subprocesses of THIS process.
+    * HTTP (address="http://host:port"): every call proxies to a
+      dashboard's /api/jobs endpoints (observability/dashboard.py), the
+      way the reference client talks to the dashboard job head — submit
+      from any process, logs stream back over chunked HTTP.
+    """
 
     def __init__(self, address: Optional[str] = None,
                  log_dir: Optional[str] = None):
+        self._address = (address.rstrip("/")
+                         if address and address.startswith("http")
+                         else None)
         self._jobs: Dict[str, _Job] = {}
         self._log_dir = log_dir or tempfile.mkdtemp(prefix="ray_tpu_jobs_")
+
+    # ---- HTTP proxy plumbing ----
+    def _http(self, route: str, payload=None, timeout: float = 30.0):
+        import json as json_mod
+        import urllib.request
+        req = urllib.request.Request(
+            self._address + route,
+            data=(json_mod.dumps(payload).encode()
+                  if payload is not None else None),
+            headers={"Content-Type": "application/json"},
+            method="POST" if payload is not None else "GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                out = json_mod.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json_mod.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise ValueError(msg) from None
+        if isinstance(out, dict) and "error" in out:
+            raise ValueError(out["error"])
+        return out
 
     def submit_job(self, *, entrypoint: str,
                    runtime_env: Optional[Dict[str, Any]] = None,
                    submission_id: Optional[str] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
+        if self._address:
+            return self._http("/api/jobs", {
+                "entrypoint": entrypoint, "runtime_env": runtime_env,
+                "submission_id": submission_id,
+                "metadata": metadata})["submission_id"]
         from . import runtime_env as renv_mod
         renv = renv_mod.validate(runtime_env)
         sid = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
@@ -91,18 +129,26 @@ class JobSubmissionClient:
         return self._jobs[sid]
 
     def get_job_status(self, submission_id: str) -> str:
+        if self._address:
+            return self.get_job_info(submission_id)["status"]
         return self._job(submission_id).status()
 
     def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        if self._address:
+            return self._http(f"/api/jobs/{submission_id}")
         j = self._job(submission_id)
         return {"submission_id": j.submission_id, "status": j.status(),
                 "entrypoint": j.entrypoint, "metadata": j.metadata,
                 "start_time": j.start_time, "end_time": j.end_time}
 
     def list_jobs(self) -> List[Dict[str, Any]]:
+        if self._address:
+            return self._http("/api/jobs")
         return [self.get_job_info(sid) for sid in self._jobs]
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._address:
+            return self._http(f"/api/jobs/{submission_id}/logs")["logs"]
         j = self._job(submission_id)
         try:
             with open(j.log_path, "rb") as f:
@@ -112,6 +158,9 @@ class JobSubmissionClient:
 
     def tail_job_logs(self, submission_id: str,
                       poll_interval_s: float = 0.1) -> Iterator[str]:
+        if self._address:
+            yield from self._tail_http(submission_id)
+            return
         j = self._job(submission_id)
         pos = 0
         while True:
@@ -126,7 +175,25 @@ class JobSubmissionClient:
             else:
                 time.sleep(poll_interval_s)
 
+    def _tail_http(self, submission_id: str) -> Iterator[str]:
+        """Stream the dashboard's chunked follow endpoint until EOF."""
+        import urllib.request
+        url = (f"{self._address}/api/jobs/{submission_id}/logs"
+               f"?follow=1")
+        try:
+            with urllib.request.urlopen(url, timeout=None) as r:
+                while True:
+                    piece = r.read1(65536)
+                    if not piece:
+                        return
+                    yield piece.decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            raise ValueError(f"tail failed: {e}") from None
+
     def stop_job(self, submission_id: str) -> bool:
+        if self._address:
+            return self._http(f"/api/jobs/{submission_id}/stop",
+                              {})["stopped"]
         j = self._job(submission_id)
         if j.proc.poll() is not None:
             return False

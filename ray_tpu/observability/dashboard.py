@@ -13,6 +13,16 @@ panel the reference renders is available as JSON:
   GET /api/placement_groups
   GET /api/timeline    — chrome-trace events
   GET /metrics         — Prometheus text exposition
+
+Job submission over HTTP (reference: python/ray/dashboard/modules/job/
+job_head.py + job_manager.py — submit/status/logs via the dashboard):
+
+  POST /api/jobs                 {"entrypoint": ..., "runtime_env": ...}
+  GET  /api/jobs                 — job table
+  GET  /api/jobs/<sid>           — one job's info
+  GET  /api/jobs/<sid>/logs      — captured logs (?follow=1 streams
+                                   chunked text until the job exits)
+  POST /api/jobs/<sid>/stop      — SIGTERM the job's process group
 """
 from __future__ import annotations
 
@@ -27,7 +37,27 @@ from ..util import state as state_mod
 from . import timeline as timeline_mod
 
 
+_jobs_client = None
+_jobs_lock = threading.Lock()
+
+
+def _jobs():
+    """One shared JobSubmissionClient behind the HTTP surface (jobs
+    submitted over HTTP and via this process's Python client share a
+    table the way the reference's JobManager does)."""
+    global _jobs_client
+    with _jobs_lock:
+        if _jobs_client is None:
+            from ..core.jobs import JobSubmissionClient
+            _jobs_client = JobSubmissionClient()
+        return _jobs_client
+
+
 class _Handler(BaseHTTPRequestHandler):
+    # chunked Transfer-Encoding (log follow) is only legal on HTTP/1.1;
+    # everything else sends Content-Length so keep-alive stays correct
+    protocol_version = "HTTP/1.1"
+
     def log_message(self, *a):       # silence per-request stderr noise
         pass
 
@@ -73,6 +103,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(timeline_mod.timeline_events())
             elif route == "/api/serve":
                 self._json(_serve_status())
+            elif route == "/api/jobs":
+                self._json(_jobs().list_jobs())
+            elif route.startswith("/api/jobs/"):
+                parts = route.split("/")  # ['', 'api', 'jobs', sid, ...]
+                sid = parts[3]
+                if len(parts) == 4:
+                    self._json(_jobs().get_job_info(sid))
+                elif parts[4] == "logs" and q.get("follow", ["0"])[0] \
+                        in ("1", "true"):
+                    self._stream_logs(sid)
+                elif parts[4] == "logs":
+                    self._json({"submission_id": sid,
+                                "logs": _jobs().get_job_logs(sid)})
+                else:
+                    self._json({"error": f"no route {route}"}, 404)
             elif route == "/metrics":
                 self._send(200, metrics_mod.exposition().encode(),
                            "text/plain; version=0.0.4")
@@ -88,11 +133,73 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/summary/tasks",
                                        "/api/summary/actors",
                                        "/api/summary/objects",
+                                       "/api/jobs",
                                        "/api/timeline", "/metrics"]})
             else:
                 self._json({"error": f"no route {route}"}, 404)
+        except ValueError as e:      # unknown job id etc.
+            self._json({"error": str(e)}, 404)
         except Exception as e:  # surface errors as JSON, keep serving
             self._json({"error": repr(e)}, 500)
+
+    def do_POST(self):
+        route = urlparse(self.path).path.rstrip("/")
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}") if n else {}
+            if route == "/api/jobs":
+                sid = _jobs().submit_job(
+                    entrypoint=body["entrypoint"],
+                    runtime_env=body.get("runtime_env"),
+                    submission_id=body.get("submission_id"),
+                    metadata=body.get("metadata"))
+                self._json({"submission_id": sid})
+            elif route.startswith("/api/jobs/") and \
+                    route.endswith("/stop"):
+                sid = route.split("/")[3]
+                self._json({"submission_id": sid,
+                            "stopped": _jobs().stop_job(sid)})
+            else:
+                self._json({"error": f"no route {route}"}, 404)
+        except KeyError as e:
+            self._json({"error": f"missing field {e}"}, 400)
+        except ValueError as e:
+            self._json({"error": str(e)}, 404)
+        except Exception as e:  # noqa: BLE001
+            self._json({"error": repr(e)}, 500)
+
+    def _stream_logs(self, sid: str) -> None:
+        """Chunked text/plain tail of a job's logs until it exits
+        (reference: JobSubmissionClient.tail_job_logs)."""
+        _jobs().get_job_info(sid)   # raise ValueError BEFORE headers
+        gen = _jobs().tail_job_logs(sid)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for piece in gen:
+                if piece:
+                    chunk(piece.encode(errors="replace"))
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass                      # client hung up mid-tail
+        except Exception:  # noqa: BLE001
+            # mid-stream failure AFTER headers went out (e.g. the log
+            # file vanished): a second HTTP response would corrupt the
+            # chunked framing — terminate the stream and drop the
+            # connection instead
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            self.close_connection = True
 
 
 def _serve_status() -> Any:
