@@ -39,6 +39,11 @@ class LlamaConfig:
     tie_embeddings: bool = False
     remat: bool = False
     dtype: Any = jnp.bfloat16
+    # Storage dtype of the big parameter tensors (embeddings + matmul
+    # kernels). fp32 default; bf16 halves parameter HBM — the knob that
+    # fits >=1B-param training on one 16 GB chip (norm weights stay
+    # fp32 regardless: they're tiny and fp32 norms are load-bearing).
+    param_dtype: Any = jnp.float32
     attn_impl: str = "auto"         # "auto" | "xla" | "pallas"
 
     def __post_init__(self):
@@ -59,18 +64,20 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
-    # ---- presets (sizes follow the public Llama-3 family) ----
+    # ---- presets (sizes follow the public Llama-3 family; kwargs
+    # override any preset default, e.g. max_seq_len / remat) ----
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
-                           n_heads=32, n_kv_heads=8, d_ff=14336,
-                           max_seq_len=8192, remat=True, **kw)
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq_len=8192, remat=True),
+            **kw})
 
     @staticmethod
     def llama3_1b(**kw) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=128256, d_model=2048, n_layers=16,
-                           n_heads=32, n_kv_heads=8, d_ff=8192,
-                           max_seq_len=8192, **kw)
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, d_model=2048, n_layers=16, n_heads=32,
+            n_kv_heads=8, d_ff=8192, max_seq_len=8192), **kw})
 
     @staticmethod
     def debug(**kw) -> "LlamaConfig":
@@ -87,11 +94,11 @@ class LlamaAttention(nn.Module):
         cfg = self.cfg
         hd = cfg.head_dim
         q = nn.Dense(cfg.n_heads * hd, use_bias=False, name="q_proj",
-                     dtype=cfg.dtype)(x)
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
         k = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="k_proj",
-                     dtype=cfg.dtype)(x)
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
         v = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="v_proj",
-                     dtype=cfg.dtype)(x)
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
         b, s, _ = x.shape
         q = q.reshape(b, s, cfg.n_heads, hd)
         k = k.reshape(b, s, cfg.n_kv_heads, hd)
@@ -110,7 +117,7 @@ class LlamaAttention(nn.Module):
 
         out = out.reshape(b, s, cfg.n_heads * hd)
         out = nn.Dense(cfg.d_model, use_bias=False, name="o_proj",
-                       dtype=cfg.dtype)(out)
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
         return out, new_cache
 
 
@@ -121,11 +128,12 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         gate = nn.Dense(cfg.d_ff, use_bias=False, name="gate_proj",
-                        dtype=cfg.dtype)(x)
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
         up = nn.Dense(cfg.d_ff, use_bias=False, name="up_proj",
-                      dtype=cfg.dtype)(x)
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
         return nn.Dense(cfg.d_model, use_bias=False, name="down_proj",
-                        dtype=cfg.dtype)(swiglu(gate, up))
+                        dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)(swiglu(gate, up))
 
 
 class LlamaBlock(nn.Module):
@@ -148,15 +156,18 @@ class LlamaBlock(nn.Module):
 
 
 class _LMHead(nn.Module):
-    """Untied head, kernel stored fp32 at params['lm_head']['kernel']
-    (same tree as nn.Dense). Matmul runs bf16-in/fp32-accumulate — MXU
-    native — instead of nn.Dense(dtype=fp32)'s full-fp32 pass."""
+    """Untied head, kernel stored at params['lm_head']['kernel'] (same
+    tree as nn.Dense) in `param_dtype`. Matmul runs bf16-in/fp32-
+    accumulate — MXU native — instead of nn.Dense(dtype=fp32)'s
+    full-fp32 pass."""
     vocab_size: int
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (x.shape[-1], self.vocab_size))
+                            (x.shape[-1], self.vocab_size),
+                            self.param_dtype)
         return jnp.einsum("bsd,dv->bsv", x, kernel.astype(x.dtype),
                           preferred_element_type=jnp.float32)
 
@@ -170,7 +181,7 @@ class Llama(nn.Module):
         (k, v, lengths). Returns (logits, new_cache)."""
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
-                         dtype=cfg.dtype,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          embedding_init=nn.initializers.normal(0.02))
         from ..parallel.sharding import constrain_activations  # noqa: PLC0415
         # Pin the residual stream to batch/sequence sharding right at the
@@ -203,7 +214,8 @@ class Llama(nn.Module):
                                 embed.embedding.astype(x.dtype),
                                 preferred_element_type=jnp.float32)
         else:
-            logits = _LMHead(cfg.vocab_size, name="lm_head")(x)
+            logits = _LMHead(cfg.vocab_size, cfg.param_dtype,
+                             name="lm_head")(x)
         return logits, (new_cache if cache is not None else None)
 
     # ---- convenience ----

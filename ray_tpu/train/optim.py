@@ -42,6 +42,11 @@ def make_optimizer(name: str = "adamw", *, learning_rate=3e-4,
         core = optax.sgd(lr, momentum=0.9)
     elif name == "lion":
         core = optax.lion(lr, weight_decay=weight_decay)
+    elif name == "adafactor":
+        # factored second moments + no first moment: optimizer state is
+        # O(rows+cols) per matrix instead of 2x params — the memory
+        # budget that lets >=1B-param training fit one 16 GB chip
+        core = optax.adafactor(lr)
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if grad_clip:

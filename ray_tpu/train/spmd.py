@@ -75,19 +75,53 @@ class SpmdStep:
 def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     *, loss_fn: Optional[Callable] = None,
                     rules: Optional[ShardingRules] = None,
-                    donate_state: bool = True) -> Callable:
+                    donate_state: bool = True,
+                    accum_steps: int = 1) -> Callable:
     """Build the jitted SPMD step for `model` on `mesh`.
 
     Returns init_fn; calling init_fn(rng, example_batch) produces
     (TrainState sharded onto the mesh, SpmdStep compiled step).
+
+    accum_steps > 1 enables gradient accumulation INSIDE the jitted
+    step: the batch's leading dim splits into `accum_steps`
+    micro-batches run under lax.scan (activation memory scales with the
+    micro-batch, the fit-big-models knob on one 16 GB chip); gradients
+    accumulate in fp32 and one optimizer update applies at the end —
+    numerically a large-batch step, not accum_steps small ones.
     """
     loss_fn = loss_fn or partial(next_token_loss, model.apply)
+
+    def _value_and_grad(params, batch):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, batch), has_aux=True)(params)
 
     def raw_step(state: TrainState, batch):
         from ..parallel.sharding import activation_mesh  # noqa: PLC0415
         with activation_mesh(mesh):
-            (loss, metrics), grads = jax.value_and_grad(
-                lambda p: loss_fn(p, batch), has_aux=True)(state.params)
+            if accum_steps <= 1:
+                (_loss, metrics), grads = _value_and_grad(state.params,
+                                                          batch)
+            else:
+                micro = jax.tree_util.tree_map(
+                    lambda x: x.reshape(
+                        (accum_steps, x.shape[0] // accum_steps)
+                        + x.shape[1:]), batch)
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    state.params)
+
+                def body(gsum, mb):
+                    (_l, m), g = _value_and_grad(state.params, mb)
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(jnp.float32), gsum, g)
+                    return gsum, m
+
+                gsum, ms = jax.lax.scan(body, zeros, micro)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / accum_steps).astype(p.dtype),
+                    gsum, state.params)
+                metrics = jax.tree_util.tree_map(
+                    lambda x: jnp.mean(x, axis=0), ms)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
