@@ -7,7 +7,8 @@ dp-shardable over a jax Mesh (LearnerGroup).
 """
 from .algorithm import Algorithm, AlgorithmConfig
 from .dqn import DQN, DQNConfig
-from .env import (BanditEnv, CartPole, Env, GridWorld, Space, VectorEnv,
+from .env import (BanditEnv, CartPole, Env, GridWorld, Pendulum,
+                  Space, VectorEnv,
                   make_env, register_env)
 from .env_runner import EnvRunner
 from .grpo import (EngineSampler, GRPOConfig, GRPOLearner, GRPOTrainer,
@@ -15,13 +16,14 @@ from .grpo import (EngineSampler, GRPOConfig, GRPOLearner, GRPOTrainer,
                    group_relative_advantages)
 from .learner import Learner, LearnerGroup
 from .ppo import PPO, PPOConfig
+from .sac import SAC, SACConfig
 from .replay import EpisodeReplayBuffer, ReplayBuffer
 from .rl_module import (Categorical, DiagGaussian, RLModule, RLModuleSpec,
                         spec_for_env)
 from .sample_batch import SampleBatch, compute_gae, concat_samples
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "SAC", "SACConfig", "Pendulum", "DQN", "DQNConfig",
     "EngineSampler", "GRPOConfig", "GRPOLearner", "GRPOTrainer",
     "make_lora_grpo_trainer",
     "group_relative_advantages",

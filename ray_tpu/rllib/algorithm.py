@@ -189,9 +189,9 @@ class Algorithm:
         }
         ei = self.config.evaluation_interval
         if ei and self.iteration % ei == 0:
-            result["evaluation"] = self.local_runner.evaluate(
-                self.params,
-                num_episodes=self.config.evaluation_num_episodes)
+            # through self.evaluate() so algorithms with their own
+            # policy nets (SAC's squashed actor) evaluate correctly
+            result["evaluation"] = self.evaluate()
         return result
 
     def evaluate(self) -> Dict[str, float]:

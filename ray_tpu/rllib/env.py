@@ -136,6 +136,50 @@ class GridWorld(Env):
                 self._t >= self.max_steps, {})
 
 
+class Pendulum(Env):
+    """Classic torque-limited pendulum swing-up (standard dynamics:
+    theta'' = 3g/(2l) sin(theta) + 3/(ml^2) u). Continuous action in
+    [-2, 2]; obs (cos, sin, theta_dot); reward
+    -(angle^2 + 0.1 theta_dot^2 + 0.001 u^2); 200-step episodes. The
+    in-repo continuous-control benchmark for SAC (reference:
+    Pendulum-v1 used across rllib/algorithms tuned examples)."""
+
+    observation_space = Space.box((3,), -8.0, 8.0)
+    action_space = Space.box((1,), -2.0, 2.0)
+    max_steps = 200
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._th = 0.0
+        self._thdot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th),
+                         self._thdot], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = float(self._rng.uniform(-np.pi, np.pi))
+        self._thdot = float(self._rng.uniform(-1.0, 1.0))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        th_norm = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        self._thdot += (3 * g / (2 * length) * np.sin(self._th)
+                        + 3.0 / (m * length ** 2) * u) * dt
+        self._thdot = float(np.clip(self._thdot, -8.0, 8.0))
+        self._th += self._thdot * dt
+        self._t += 1
+        return self._obs(), -float(cost), False, \
+            self._t >= self.max_steps, {}
+
+
 class BanditEnv(Env):
     """K-armed stochastic bandit; 1-step episodes (reference: bandit envs
     in rllib/examples)."""
@@ -198,6 +242,8 @@ _REGISTRY = {
     "CartPole": CartPole,
     "GridWorld": GridWorld,
     "Bandit": BanditEnv,
+    "Pendulum-v1": Pendulum,
+    "Pendulum": Pendulum,
 }
 
 

@@ -339,3 +339,63 @@ def test_grpo_over_lora_adapters():
                     jax.tree_util.tree_leaves(
                         jax.tree_util.tree_map(np.asarray, base))):
         np.testing.assert_array_equal(a, b)
+
+
+# ---------- SAC (off-policy continuous control) ----------
+
+def test_sac_machinery_on_pendulum():
+    """SAC wiring: squashed-Gaussian rollouts fill the replay buffer,
+    the fused update advances actor/critics/alpha, checkpoints
+    round-trip."""
+    import tempfile
+    from ray_tpu.rllib import SAC, SACConfig, Pendulum
+
+    cfg = (SACConfig()
+           .environment(env=Pendulum)
+           .env_runners(num_envs_per_env_runner=4,
+                        rollout_fragment_length=64)
+           .training(learning_starts=256, train_batch_size=64,
+                     num_gradient_steps=4, buffer_size=5000)
+           .debugging(seed=0))
+    algo = cfg.build()
+    for _ in range(4):
+        res = algo.train()
+    st = res["learner"]
+    assert np.isfinite(st["q_loss"]) and np.isfinite(st["pi_loss"])
+    assert 0.0 < st["alpha"] < 10.0
+    a = algo.compute_single_action(np.zeros(3, np.float32))
+    assert a.shape == (1,) and abs(float(a[0])) <= 2.0
+    ev = algo.evaluate()
+    assert np.isfinite(ev["episode_return_mean"])
+
+    with tempfile.TemporaryDirectory() as d:
+        algo.save(d)
+        algo2 = cfg.copy().build()
+        algo2.restore(d)
+        obs = np.ones(3, np.float32)
+        np.testing.assert_allclose(
+            algo.compute_single_action(obs),
+            algo2.compute_single_action(obs), rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_sac_learns_pendulum_swingup():
+    """Learning signal: ~40k env steps of SAC solve the swing-up
+    (measured curve: -1697 untrained -> ~-257 at 80 iters, 16s)."""
+    from ray_tpu.rllib import SAC, SACConfig, Pendulum
+
+    cfg = (SACConfig()
+           .environment(env=Pendulum)
+           .env_runners(num_envs_per_env_runner=8,
+                        rollout_fragment_length=64)
+           .training(learning_starts=1000, train_batch_size=128,
+                     num_gradient_steps=64, buffer_size=50_000)
+           .evaluation(evaluation_num_episodes=5)
+           .debugging(seed=0))
+    algo = cfg.build()
+    before = algo.evaluate()["episode_return_mean"]
+    for _ in range(80):                    # 80 * 512 env steps
+        algo.train()
+    after = algo.evaluate()["episode_return_mean"]
+    assert after > before + 800, (before, after)
+    assert after > -600, (before, after)
