@@ -70,6 +70,46 @@ def _progress(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+# On-chip results are too precious to lose to a later tunnel wedge
+# (round 3 lost a measured 36.6%-MFU A/B to prose): the moment any
+# phase completes on platform=tpu its result is appended here, and the
+# final bench JSON merges the freshest snapshot for any phase that had
+# to fall back to CPU — labeled as a snapshot, never passed off as live.
+SNAPSHOT_PATH = os.path.join(REPO, "BENCH_TPU.json")
+
+
+def _snapshot_write(phase: str, result: dict) -> None:
+    if result.get("platform") != "tpu":
+        return
+    try:
+        with open(SNAPSHOT_PATH, "a") as f:
+            f.write(json.dumps(
+                {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                 "phase": phase, "result": result}) + "\n")
+        _progress(f"on-TPU snapshot persisted: {phase} -> BENCH_TPU.json")
+    except OSError as e:
+        _progress(f"snapshot write failed (non-fatal): {e}")
+
+
+def _snapshot_latest(phase: str) -> "dict | None":
+    """Freshest persisted on-TPU result for `phase`, or None."""
+    try:
+        with open(SNAPSHOT_PATH) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    best = None
+    for line in lines:
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if entry.get("phase") == phase:
+            if best is None or entry.get("ts", "") >= best.get("ts", ""):
+                best = entry
+    return best
+
+
 # Child exits with this code when the TPU backend doesn't come up within
 # RAY_TPU_BENCH_TPU_INIT_TIMEOUT; the parent then retries the phase on the
 # CPU platform so a wedged tunnel (observed: jax.devices() hanging for
@@ -134,26 +174,46 @@ def phase_train(which: str = "gpt2") -> dict:
     from ray_tpu.train import make_train_step, make_optimizer
 
     platform = devs[0].platform
+    accum = 1
+    opt_name = "adamw"
     if which == "gpt2":
         from ray_tpu.models import GPT2, GPT2Config
         cfg = GPT2Config.small()
         model = GPT2(cfg)
     else:  # flagship llama-family decoder (SURVEY §6 MFU target model)
         from ray_tpu.models import Llama, LlamaConfig
-        cfg = LlamaConfig(vocab_size=32000, d_model=1024, n_layers=16,
-                          n_heads=16, n_kv_heads=8, d_ff=2816,
-                          max_seq_len=max(1024, SEQ))
+        # On-chip the flagship is the REAL 1B+ preset (BASELINE's
+        # headline is tokens/sec/chip at Llama scale, not 254M):
+        # bf16 params + adafactor + remat + grad accumulation keep a
+        # ~1.9B-param model inside 16 GB HBM. CPU fallback keeps the
+        # small config (1B on 1 CPU core would blow every timeout).
+        preset = os.environ.get(
+            "RAY_TPU_BENCH_LLAMA",
+            "1b" if platform == "tpu" else "small")
+        if preset == "1b":
+            cfg = LlamaConfig.llama3_1b(
+                remat=True, param_dtype=jnp.bfloat16,
+                max_seq_len=max(1024, SEQ))
+            opt_name = "adafactor"
+            accum = int(os.environ.get("RAY_TPU_BENCH_ACCUM", "4"))
+        else:
+            cfg = LlamaConfig(vocab_size=32000, d_model=1024,
+                              n_layers=16, n_heads=16, n_kv_heads=8,
+                              d_ff=2816, max_seq_len=max(1024, SEQ))
         model = Llama(cfg)
     n_layers, d_model = cfg.n_layers, cfg.d_model
     batch_sz, seq = BATCH, SEQ
+    if accum > 1 and batch_sz % accum:
+        accum = 1
     mesh = build_mesh(MeshSpec(), devices=devs[:1])
-    tx = make_optimizer("adamw", learning_rate=3e-4)
+    tx = make_optimizer(opt_name, learning_rate=3e-4)
     rng = np.random.RandomState(0)
     batch = {"tokens": jnp.asarray(
         rng.randint(0, cfg.vocab_size, (batch_sz, seq + 1)), jnp.int32)}
 
-    _progress(f"compiling train step ({which}, seq {seq})")
-    init_fn = make_train_step(model, tx, mesh)
+    _progress(f"compiling train step ({which}, seq {seq}, "
+              f"opt={opt_name}, accum={accum})")
+    init_fn = make_train_step(model, tx, mesh, accum_steps=accum)
     t0 = time.time()
     state, step = init_fn(jax.random.PRNGKey(0), batch)
     n_params = sum(int(np.prod(x.shape))
@@ -186,6 +246,7 @@ def phase_train(which: str = "gpt2") -> dict:
     return {"tokens_per_s": tps, "compile_s": compile_s,
             "step_ms": dt / MEASURE_STEPS * 1000,
             "platform": platform, "mfu": mfu, "n_params": n_params,
+            "optimizer": opt_name, "accum_steps": accum,
             "batch": batch_sz, "seq": seq, "final_loss": final_loss}
 
 
@@ -243,6 +304,197 @@ def phase_kernels() -> dict:
             "interpret_parity_ok": ok, "flash_fwd_err": fwd_err,
             "flash_bwd_rel_err": bwd_err, "rmsnorm_err": rms_err,
             "platform": devs[0].platform}
+
+
+def phase_data() -> dict:
+    """Image-pipeline throughput (BASELINE config 3: ViT/CLIP data
+    path): synthetic PNGs -> read_images(resize) -> ImageAugmenter ->
+    iter_jax_batches (double-buffered host->device). Reports images/s
+    end-to-end including decode."""
+    jax, devs = _setup_jax_child()
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import ImageAugmenter
+
+    n_imgs = int(os.environ.get("RAY_TPU_BENCH_DATA_IMGS", "192"))
+    tmp = tempfile.mkdtemp(prefix="rtpu_bench_imgs_")
+    try:
+        rng = np.random.RandomState(0)
+        for i in range(n_imgs):
+            Image.fromarray(rng.randint(0, 255, (96, 96, 3), np.uint8)
+                            ).save(os.path.join(tmp, f"i{i:04d}.png"))
+        _progress(f"data: {n_imgs} synthetic pngs; measuring pipeline")
+
+        def run_epoch():
+            ds = rd.read_images(tmp, size=(224, 224))
+            ds = ImageAugmenter(crop_padding=4).transform(ds)
+            total = 0
+            last = None
+            for batch in ds.iter_jax_batches(batch_size=32,
+                                             drop_last=False):
+                total += int(batch["image"].shape[0])
+                last = batch["image"]
+            _sync(last[0, 0, 0, 0])   # drain the device pipeline
+            return total
+
+        run_epoch()                   # warm decode caches + compiles
+        t0 = time.time()
+        total = run_epoch()
+        dt = time.time() - t0
+        imgs_s = total / dt
+        _progress(f"data: {imgs_s:.1f} imgs/s "
+                  f"({total} imgs in {dt:.2f}s)")
+        return {"data_imgs_per_s": imgs_s, "n_images": total,
+                "resize": [224, 224], "platform": devs[0].platform}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def phase_probe_8b() -> dict:
+    """Where does Llama-3-8B break on ONE 16 GB chip? (VERDICT r3 item
+    3: 'attempt an 8B forward pass and record where it breaks'.)
+    Tries a bf16 forward at descending layer counts of the genuine 8B
+    config; reports the largest prefix of the model that fits plus the
+    failure signature of the full one. Run manually / via snapshot —
+    not part of the default parent sweep (each try is a fresh compile)."""
+    jax, devs = _setup_jax_child()
+    import dataclasses
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import Llama, LlamaConfig
+
+    platform = devs[0].platform
+    attempts = []
+    best = None
+    for n_layers in (32, 16, 8, 4):
+        cfg = dataclasses.replace(
+            LlamaConfig.llama3_8b(param_dtype=jnp.bfloat16),
+            n_layers=n_layers, max_seq_len=512)
+        model = Llama(cfg)
+        t0 = time.time()
+        try:
+            params = jax.jit(
+                lambda rng: model.init(
+                    rng, jnp.zeros((1, 8), jnp.int32))["params"]
+            )(jax.random.PRNGKey(0))
+            n_params = sum(int(np.prod(x.shape)) for x in
+                           jax.tree_util.tree_leaves(params))
+            logits, _ = jax.jit(model.apply)(
+                {"params": params},
+                jnp.zeros((1, 128), jnp.int32))
+            _sync(logits[0, 0, 0])
+            entry = {"n_layers": n_layers, "ok": True,
+                     "params_b": round(n_params / 1e9, 2),
+                     "wall_s": round(time.time() - t0, 1)}
+            attempts.append(entry)
+            _progress(f"8b probe: {entry}")
+            best = entry
+            break    # largest fitting prefix found (descending order)
+        except BaseException as e:  # noqa: BLE001
+            entry = {"n_layers": n_layers, "ok": False,
+                     "error": repr(e)[:300],
+                     "wall_s": round(time.time() - t0, 1)}
+            attempts.append(entry)
+            _progress(f"8b probe: {entry}")
+        finally:
+            params = None
+    return {"platform": platform, "attempts": attempts, "fits": best}
+
+
+def phase_flash_ab() -> dict:
+    """XLA vs Pallas flash attention across seq lengths at flagship head
+    shapes (fwd+bwd, bf16), the committed A/B table VERDICT r3 asked
+    for. On TPU the table also lands in FLASH_AB.json; the router
+    (ops/attention.py:_resolve_impl) should agree with its crossover."""
+    jax, devs = _setup_jax_child()
+    import jax.numpy as jnp
+    from ray_tpu.ops.attention import multi_head_attention
+
+    platform = devs[0].platform
+    b, h, d = 4, 16, 64
+    seqs = tuple(int(s) for s in os.environ.get(
+        "RAY_TPU_BENCH_FLASH_SEQS", "512,1024,2048,4096").split(","))
+    reps = 10
+    # sweep mode additionally tunes Pallas block sizes per seq len
+    sweep = os.environ.get("RAY_TPU_BENCH_FLASH_SWEEP") == "1"
+    blocks = ((128, 128), (256, 128), (128, 256), (256, 256),
+              (512, 512)) if sweep else ((128, 128),)
+    rows = []
+
+    def time_grad(fn, *args):
+        step = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        g = step(*args)
+        _sync(g[0][0, 0, 0, 0])
+        t0 = time.time()
+        for _ in range(reps):
+            g = step(*args)
+        _sync(g[0][0, 0, 0, 0])
+        return (time.time() - t0) / reps
+
+    for seq in seqs:
+        ks = jax.random.split(jax.random.PRNGKey(seq), 3)
+        q = jax.random.normal(ks[0], (b, seq, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, seq, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, seq, h, d), jnp.bfloat16)
+        # causal fwd: (QK^T + AV) = 2 * 2*b*h*s^2*d, halved by the
+        # causal mask; bwd ~2.5x fwd
+        flops = (2 * 2 * b * h * seq * seq * d / 2) * 3.5
+        row = {"seq": seq}
+        try:
+            def xla_loss(q, k, v):
+                out = multi_head_attention(q, k, v, causal=True,
+                                           impl="xla")
+                return (out.astype(jnp.float32) ** 2).mean()
+
+            dt = time_grad(xla_loss, q, k, v)
+            row["xla_ms"] = round(dt * 1000, 3)
+            row["xla_tflops"] = round(flops / dt / 1e12, 2)
+        except BaseException as e:  # noqa: BLE001
+            row["xla_error"] = repr(e)[:200]
+        if platform == "tpu":
+            from ray_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            best = None
+            for bq, bk in blocks:
+                if bq > seq or bk > seq:
+                    continue
+
+                def pl_loss(q, k, v, bq=bq, bk=bk):
+                    out = flash_attention(q, k, v, causal=True,
+                                          block_q=bq, block_k=bk)
+                    return (out.astype(jnp.float32) ** 2).mean()
+
+                try:
+                    dt = time_grad(pl_loss, q, k, v)
+                    if best is None or dt < best[0]:
+                        best = (dt, bq, bk)
+                except BaseException as e:  # noqa: BLE001
+                    row.setdefault("pallas_errors", []).append(
+                        f"bq{bq}/bk{bk}: {repr(e)[:120]}")
+            if best is not None:
+                dt, bq, bk = best
+                row["pallas_ms"] = round(dt * 1000, 3)
+                row["pallas_tflops"] = round(flops / dt / 1e12, 2)
+                row["pallas_block"] = [bq, bk]
+        if "xla_tflops" in row and "pallas_tflops" in row:
+            row["winner"] = ("pallas" if row["pallas_tflops"]
+                             > row["xla_tflops"] else "xla")
+        _progress(f"flash-ab seq={seq}: {row}")
+        rows.append(row)
+    result = {"platform": platform, "shape": {"batch": b, "heads": h,
+                                              "head_dim": d},
+              "reps": reps, "rows": rows}
+    if platform == "tpu":
+        with open(os.path.join(REPO, "FLASH_AB.json"), "w") as f:
+            json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                       **result}, f, indent=1)
+        _progress("wrote FLASH_AB.json")
+    return result
 
 
 def phase_serve() -> dict:
@@ -303,14 +555,18 @@ def phase_serve() -> dict:
     n_req = 32
     wall, ttfts = run_load(n_req)
     tokens_measured = engine.stats["tokens_generated"] - tokens_before
+    stats = engine.get_stats()
     engine.shutdown()
     p50 = float(np.percentile(ttfts, 50) * 1000)
     p95 = float(np.percentile(ttfts, 95) * 1000)
     req_s = n_req / wall
-    _progress(f"serve: {req_s:.1f} req/s, ttft p50={p50:.0f}ms")
+    _progress(f"serve: {req_s:.1f} req/s, ttft p50={p50:.0f}ms "
+              f"breakdown={stats.get('ttft_breakdown_p50_ms')}")
     return {"serve_req_s": req_s, "serve_ttft_p50_ms": p50,
             "serve_ttft_p95_ms": p95,
             "serve_tokens_s": tokens_measured / wall,
+            "ttft_breakdown_p50_ms": stats.get("ttft_breakdown_p50_ms"),
+            "prefill_compile_ms": stats.get("prefill_compile_ms"),
             "platform": devs[0].platform}
 
 
@@ -444,7 +700,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--measure-torch-baseline", action="store_true")
     ap.add_argument("--phase",
-                    choices=["kernels", "train", "train-llama", "serve"])
+                    choices=["kernels", "train", "train-llama", "serve",
+                             "flash-ab", "probe-8b", "data"])
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
 
@@ -457,10 +714,14 @@ def main():
             r = {"kernels": phase_kernels,
                  "train": lambda: phase_train("gpt2"),
                  "train-llama": lambda: phase_train("llama"),
-                 "serve": phase_serve}[args.phase]()
+                 "serve": phase_serve,
+                 "flash-ab": phase_flash_ab,
+                 "probe-8b": phase_probe_8b,
+                 "data": phase_data}[args.phase]()
         except BaseException as e:  # noqa: BLE001
             _progress(f"phase {args.phase} failed: {e!r}")
             raise SystemExit(3)
+        _snapshot_write(args.phase, r)
         print(json.dumps(r), flush=True)
         # Skip interpreter teardown: XLA/engine worker threads can abort
         # the process during exit (observed "FATAL: exception not
@@ -475,9 +736,26 @@ def main():
     llama, llama_err = _run_phase("train-llama", TRAIN_TIMEOUT_S)
     serve, serve_err = (None, "skipped") if args.skip_serve else \
         _run_phase("serve", SERVE_TIMEOUT_S)
+    data, data_err = _run_phase("data", 600)
 
     extra = {"elapsed_s": round(time.time() - t_start, 1),
              "baseline": "torch-cpu gpt2-124m train step on this host"}
+    # When a phase had to run off-chip (wedged tunnel), surface the
+    # freshest persisted on-TPU measurement next to the live number so
+    # a wedge can never erase on-chip evidence (labeled, with its ts).
+    for phase_name, live, key in (("kernels", kernels, "kernels"),
+                                  ("train", train, "train"),
+                                  ("train-llama", llama, "llama"),
+                                  ("serve", serve, "serve"),
+                                  ("data", data, "data"),
+                                  ("flash-ab", None, "flash_ab"),
+                                  ("probe-8b", None, "probe_8b")):
+        if live and live.get("platform") == "tpu":
+            continue
+        snap = _snapshot_latest(phase_name)
+        if snap:
+            extra[f"{key}_tpu_snapshot"] = {
+                "ts": snap.get("ts"), **snap.get("result", {})}
     if kernels:
         extra.update(pallas_ok=kernels["pallas_ok"],
                      flash_fwd_err=round(kernels["flash_fwd_err"], 5),
@@ -502,6 +780,10 @@ def main():
             llama_params_m=round(llama["n_params"] / 1e6, 1))
     else:
         extra["llama_train_error"] = llama_err
+    if data:
+        extra.update(data_imgs_per_s=round(data["data_imgs_per_s"], 1))
+    else:
+        extra["data_error"] = data_err
     if serve:
         extra.update(
             serve_req_s=round(serve["serve_req_s"], 1),

@@ -330,13 +330,22 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """q: (B, Sq, Hq, D); k/v: (B, Sk, Hkv, D). Returns (B, Sq, Hq, D).
 
     GQA is handled by expanding kv heads before the kernel (the extra HBM
     reads are amortized by the block streaming).
+
+    Block sizes default to 128x128; RAY_TPU_FLASH_BLOCK_Q/K override for
+    on-chip tuning sweeps (bench.py --phase flash-ab).
     """
+    import os
+    if block_q is None:
+        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        block_k = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "128"))
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if scale is None:
