@@ -38,13 +38,23 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = False
+    # "full": recompute everything in backward (max HBM savings, ~1.33x
+    # FLOPs). "dots": jax.checkpoint saves matmul outputs and
+    # recomputes only the cheap elementwise ops — most of the memory
+    # win at a fraction of the recompute (the >=1B single-chip MFU
+    # lever once grad accumulation keeps micro-batches small).
+    remat_policy: str = "full"
     dtype: Any = jnp.bfloat16
     # Storage dtype of the big parameter tensors (embeddings + matmul
     # kernels). fp32 default; bf16 halves parameter HBM — the knob that
     # fits >=1B-param training on one 16 GB chip (norm weights stay
     # fp32 regardless: they're tiny and fp32 norms are load-bearing).
     param_dtype: Any = jnp.float32
-    attn_impl: str = "auto"         # "auto" | "xla" | "pallas"
+    attn_impl: str = "auto"         # "auto" | "xla" | "dpa" | "pallas"
+    # None = fp weights; "int8" = weight-only quantized projections
+    # (ops/quant.py QuantDense; params from quantize_llama_params).
+    # Serving-only: int8 kernels are not trained.
+    quant: Optional[str] = None
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -59,6 +69,12 @@ class LlamaConfig:
             raise ValueError(
                 f"n_heads={self.n_heads} must be divisible by "
                 f"n_kv_heads={self.n_kv_heads} (GQA groups)")
+        if self.quant not in (None, "int8"):
+            raise ValueError(f"quant={self.quant!r}; valid: None, "
+                             f"'int8'")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"remat_policy={self.remat_policy!r}; "
+                             f"valid: 'full', 'dots'")
 
     @property
     def head_dim(self) -> int:
@@ -86,6 +102,16 @@ class LlamaConfig:
                            max_seq_len=128, **kw)
 
 
+def _proj(cfg: LlamaConfig, features: int, name: str):
+    """Projection layer: nn.Dense, or QuantDense under quant='int8'
+    (same param-tree position; kernel -> kernel_q/scale)."""
+    if cfg.quant == "int8":
+        from ..ops.quant import QuantDense  # noqa: PLC0415
+        return QuantDense(features, name=name, dtype=cfg.dtype)
+    return nn.Dense(features, use_bias=False, name=name,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
@@ -93,12 +119,9 @@ class LlamaAttention(nn.Module):
     def __call__(self, x, cos, sin, cache=None, positions=None):
         cfg = self.cfg
         hd = cfg.head_dim
-        q = nn.Dense(cfg.n_heads * hd, use_bias=False, name="q_proj",
-                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
-        k = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="k_proj",
-                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
-        v = nn.Dense(cfg.n_kv_heads * hd, use_bias=False, name="v_proj",
-                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        q = _proj(cfg, cfg.n_heads * hd, "q_proj")(x)
+        k = _proj(cfg, cfg.n_kv_heads * hd, "k_proj")(x)
+        v = _proj(cfg, cfg.n_kv_heads * hd, "v_proj")(x)
         b, s, _ = x.shape
         q = q.reshape(b, s, cfg.n_heads, hd)
         k = k.reshape(b, s, cfg.n_kv_heads, hd)
@@ -116,8 +139,7 @@ class LlamaAttention(nn.Module):
             out, new_cache = cached_attention(q, k, v, cache, positions)
 
         out = out.reshape(b, s, cfg.n_heads * hd)
-        out = nn.Dense(cfg.d_model, use_bias=False, name="o_proj",
-                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
+        out = _proj(cfg, cfg.d_model, "o_proj")(out)
         return out, new_cache
 
 
@@ -127,13 +149,9 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        gate = nn.Dense(cfg.d_ff, use_bias=False, name="gate_proj",
-                        dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
-        up = nn.Dense(cfg.d_ff, use_bias=False, name="up_proj",
-                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
-        return nn.Dense(cfg.d_model, use_bias=False, name="down_proj",
-                        dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype)(swiglu(gate, up))
+        gate = _proj(cfg, cfg.d_ff, "gate_proj")(x)
+        up = _proj(cfg, cfg.d_ff, "up_proj")(x)
+        return _proj(cfg, cfg.d_model, "down_proj")(swiglu(gate, up))
 
 
 class LlamaBlock(nn.Module):
@@ -196,8 +214,13 @@ class Llama(nn.Module):
         # path (cache is not None) never checkpoints. Param paths stay
         # "layer_{i}/..." under both classes, so one weight pytree serves
         # train and serve.
-        block_cls = (nn.remat(LlamaBlock)
-                     if (cfg.remat and cache is None) else LlamaBlock)
+        if cfg.remat and cache is None:
+            policy = (jax.checkpoint_policies
+                      .dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            block_cls = nn.remat(LlamaBlock, policy=policy)
+        else:
+            block_cls = LlamaBlock
         for i in range(cfg.n_layers):
             block = block_cls(cfg, name=f"layer_{i}")
             x, c = block(x, cos, sin,

@@ -151,6 +151,18 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                              or pallas_flash_lowers(q, k, v, causal, scale)):
         from .pallas.flash_attention import flash_attention  # noqa: PLC0415
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "dpa":
+        # jax.nn.dot_product_attention: XLA's own fused attention,
+        # which on TPU can lower to the compiler's flash kernel —
+        # A/B against "xla" (hand einsum) + "pallas" via flash-ab.
+        # Same no-silent-fallback rule as explicit pallas: unsupported
+        # arguments must error, not contaminate A/B numbers.
+        if segment_ids is not None or q.shape[1] != k.shape[1]:
+            raise ValueError(
+                "impl='dpa' supports only self-attention without "
+                "segment_ids; use impl='xla' for packed/cached shapes")
+        return jax.nn.dot_product_attention(
+            q, k, v, is_causal=causal, scale=scale)
 
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape

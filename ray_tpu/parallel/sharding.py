@@ -48,10 +48,13 @@ DEFAULT_RULES: Sequence[Rule] = (
      P(("tp", "fsdp"), None)),
     # untied output head: (d_model, vocab) column-parallel over vocab
     (r".*(lm_head|output_proj)\b.*kernel$", P("fsdp", "tp")),
-    (r".*(wq|wk|wv|qkv|q_proj|k_proj|v_proj)\b.*kernel$", P("fsdp", "tp")),
-    (r".*(wo|o_proj|out_proj|attn_out)\b.*kernel$", P("tp", "fsdp")),
-    (r".*(gate_proj|up_proj|w1|w3|fc_in)\b.*kernel$", P("fsdp", "tp")),
-    (r".*(down_proj|w2|fc_out)\b.*kernel$", P("tp", "fsdp")),
+    (r".*(wq|wk|wv|qkv|q_proj|k_proj|v_proj)\b.*kernel(_q)?$",
+     P("fsdp", "tp")),
+    (r".*(wo|o_proj|out_proj|attn_out)\b.*kernel(_q)?$",
+     P("tp", "fsdp")),
+    (r".*(gate_proj|up_proj|w1|w3|fc_in)\b.*kernel(_q)?$",
+     P("fsdp", "tp")),
+    (r".*(down_proj|w2|fc_out)\b.*kernel(_q)?$", P("tp", "fsdp")),
     (r".*(pos_embed|wpe)\b.*embedding$", P(None, "fsdp")),
     (r".*(norm|ln_f|ln_1|ln_2|layernorm).*$", P()),
     (r".*bias$", P()),

@@ -45,8 +45,13 @@ def next_token_loss(apply_fn: Callable, params, batch: Dict[str, jax.Array]):
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     out = apply_fn({"params": params}, inputs)
     logits = out[0] if isinstance(out, tuple) else out
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    logits = logits.astype(jnp.float32)
+    # fused cross-entropy: logit[target] - logsumexp instead of a full
+    # (B,S,V) fp32 log_softmax + gather — at flagship shapes the logp
+    # array alone is ~1 GB of HBM the MXU then waits on
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, targets[..., None],
+                             axis=-1)[..., 0] - lse
     mask = batch.get("loss_mask")
     if mask is None:
         mask = jnp.ones_like(ll)
