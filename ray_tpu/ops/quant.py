@@ -1,0 +1,88 @@
+"""Weight-only int8 quantization for serving.
+
+Reference counterpart: the reference serves large models through vLLM
+quantization backends (GPTQ/AWQ/int8 weight-only); TPU-first version:
+per-output-channel symmetric int8 kernels with fp32 scales, dequantized
+INSIDE the matmul (XLA fuses the int8->bf16 convert into the dot's
+operand read, so the kernel streams HBM at 1 byte/weight — the whole
+point: Llama-3-8B's ~6.6B matmul weights drop from 13 GB bf16 to
+6.6 GB, fitting one 16 GB chip with KV cache to spare).
+
+Accuracy: symmetric per-column scales keep relative error ~1/256 per
+weight; logits stay argmax-stable for serving (test-asserted).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantDense(nn.Module):
+    """Drop-in nn.Dense(use_bias=False) with int8 weights.
+
+    Params: kernel_q (in, out) int8, scale (out,) fp32 — produced by
+    quantize_dense / quantize_llama_params, never trained. The matmul
+    runs in `dtype` with fp32 accumulation on the MXU.
+    """
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        kq = self.param("kernel_q", nn.initializers.zeros,
+                        (in_dim, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        y = jnp.einsum("...i,io->...o", x.astype(self.dtype),
+                       kq.astype(self.dtype),
+                       preferred_element_type=jnp.float32)
+        return (y * scale).astype(self.dtype)
+
+
+def quantize_dense(kernel: np.ndarray) -> Dict[str, np.ndarray]:
+    """fp kernel (in, out) -> {kernel_q int8, scale fp32} with
+    symmetric per-output-channel scales."""
+    w = np.asarray(kernel, np.float32)
+    amax = np.maximum(np.abs(w).max(axis=0), 1e-8)      # (out,)
+    scale = (amax / 127.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return {"kernel_q": q, "scale": scale}
+
+
+_DENSE_NAMES = ("q_proj", "k_proj", "v_proj", "o_proj",
+                "gate_proj", "up_proj", "down_proj")
+
+
+def quantize_llama_params(params) -> Any:
+    """Llama fp param tree -> the tree a quant='int8' Llama expects:
+    every projection kernel becomes {kernel_q, scale}; norms,
+    embeddings and the LM head stay in their original dtype (the head
+    feeds sampling — keep it full precision)."""
+    def walk(tree):
+        out = {}
+        for k, v in tree.items():
+            if k in _DENSE_NAMES and isinstance(v, dict) \
+                    and "kernel" in v:
+                out[k] = quantize_dense(np.asarray(v["kernel"]))
+            elif isinstance(v, dict):
+                out[k] = walk(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk(jax.device_get(params))
+
+
+def quantized_bytes(params) -> int:
+    """Total parameter bytes of a (possibly quantized) tree."""
+    return sum(np.asarray(x).nbytes
+               for x in jax.tree_util.tree_leaves(params))
+
+
+__all__ = ["QuantDense", "quantize_dense", "quantize_llama_params",
+           "quantized_bytes"]
