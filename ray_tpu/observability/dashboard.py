@@ -239,6 +239,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Actors</h2><table id=actors></table>
 <h2>Task summary</h2><table id=tasks></table>
 <h2>Serve</h2><table id=serve></table>
+<h2>Jobs</h2><table id=jobs></table>
 <script>
 const cell = v => typeof v === 'object' && v !== null
   ? JSON.stringify(v) : String(v);
@@ -261,6 +262,7 @@ async function refresh(){
     rows('tasks', await get('/api/summary/tasks'));
     const s = await get('/api/serve');
     rows('serve', s.running ? s.applications : {running: false});
+    rows('jobs', await get('/api/jobs'));
     document.getElementById('err').textContent = '';
   } catch (e) {
     document.getElementById('err').textContent = 'refresh failed: ' + e;

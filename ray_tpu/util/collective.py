@@ -6,6 +6,11 @@ groups). TPU-first split: ON-MESH tensor collectives are XLA's job
 module covers the CONTROL-PLANE case — host numpy arrays synchronized
 across worker processes (e.g. data-loader coordination, eval metric
 reduction) — via a named rendezvous actor, no NCCL.
+
+NOT a training-step data path: every round funnels all ranks' payloads
+through one actor (O(world) serialized hops + full copies of each
+payload). Gradient/parameter tensors belong inside jit on the mesh;
+allreduce() warns once past _PAYLOAD_WARN_BYTES to catch misuse.
 """
 from __future__ import annotations
 
@@ -139,8 +144,23 @@ class CollectiveGroup:
     def barrier(self, timeout: float = 60.0) -> None:
         self._round("barrier", None, "barrier", timeout)
 
+    # beyond this, the single-actor rendezvous is the wrong tool — the
+    # tensor belongs on the mesh where XLA reduces it over ICI
+    _PAYLOAD_WARN_BYTES = 16 * 1024 * 1024
+    _size_warned = False
+
     def allreduce(self, array, op: str = "sum", timeout: float = 60.0):
-        return self._round("allreduce", np.asarray(array), op, timeout)
+        array = np.asarray(array)
+        if (array.nbytes > self._PAYLOAD_WARN_BYTES
+                and not CollectiveGroup._size_warned):
+            CollectiveGroup._size_warned = True
+            import warnings
+            warnings.warn(
+                f"collective.allreduce of {array.nbytes >> 20} MiB "
+                f"through the control-plane rendezvous actor (O(world) "
+                f"serialized hops); large tensors belong in jitted "
+                f"mesh collectives (ray_tpu.parallel)", stacklevel=2)
+        return self._round("allreduce", array, op, timeout)
 
     def allgather(self, value, timeout: float = 60.0) -> List[Any]:
         return self._round("allgather", value, None, timeout)

@@ -90,3 +90,39 @@ def test_quantized_kernels_get_tp_sharding_rules():
     assert spec_q == spec_f != PartitionSpec()
     assert rules._match("layer_0/mlp/down_proj/kernel_q") == \
         rules._match("layer_0/mlp/down_proj/kernel")
+
+
+def test_quantized_llama_forward_sharded_over_tp_mesh(fp_model):
+    """int8 llama jits over a tp=2 mesh with kernel_q actually sharded
+    (each device holds half the projection weights) and matches the
+    unsharded quantized forward."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.parallel.sharding import shard_pytree, sharding_tree
+
+    cfg, _model, params = fp_model
+    qcfg = dataclasses.replace(cfg, quant="int8")
+    qmodel = Llama(qcfg)
+    qparams = jax.tree_util.tree_map(jnp.asarray,
+                                     quantize_llama_params(params))
+    tokens = jnp.asarray([[5, 9, 33, 2, 7, 11]], jnp.int32)
+    ref, _ = qmodel.apply({"params": qparams}, tokens)
+
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    sharded = shard_pytree(qparams, mesh)
+    kq = sharded["layer_0"]["attention"]["q_proj"]["kernel_q"]
+    # tp axis actually splits the int8 kernel's output dim
+    shard_shapes = {s.data.shape for s in kq.addressable_shards}
+    assert shard_shapes == {(kq.shape[0], kq.shape[1] // 2)}, \
+        shard_shapes
+
+    shardings = sharding_tree(qparams, mesh)
+    fwd = jax.jit(
+        lambda p, t: qmodel.apply({"params": p}, t)[0],
+        in_shardings=(shardings,
+                      NamedSharding(mesh, PartitionSpec())),
+        out_shardings=NamedSharding(mesh, PartitionSpec()))
+    out = fwd(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
