@@ -169,6 +169,15 @@ class ServeController:
                     routes[st.route_prefix] = (st.app_name, st.name)
             return routes
 
+    def get_ingress_targets(self) -> Dict[str, str]:
+        """app_name -> ingress deployment name, INCLUDING apps with
+        route_prefix=None (gRPC-only apps have no HTTP prefix but are
+        still addressable by application name)."""
+        with self._lock:
+            return {st.app_name: st.name
+                    for st in self._deployments.values()
+                    if st.is_ingress}
+
     def graceful_shutdown(self) -> None:
         with self._lock:
             for app in list(self._apps):

@@ -1,0 +1,136 @@
+"""gRPC ingress actor.
+
+Reference parity: python/ray/serve/_private/proxy.py gRPC path +
+grpc_util.py — the reference proxy serves user-defined gRPC services
+next to HTTP, selecting the target application from the `application`
+request metadata. TPU-first simplification: one generic byte-level
+service (no protoc step),
+
+    /ray_tpu.serve.ServeAPI/Predict        unary   -> unary
+    /ray_tpu.serve.ServeAPI/PredictStream  unary   -> server stream
+
+with JSON payloads in/out. The target application comes from the
+`application` metadata key (same convention as the reference); with a
+single running application the metadata may be omitted.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from .handle import DeploymentHandle
+
+GRPC_PROXY_NAME = "_SERVE_GRPC_PROXY"
+_SERVICE = "ray_tpu.serve.ServeAPI"
+
+
+class GrpcProxy:
+    """Actor: owns the grpc.server; refreshes routes from the controller."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import grpc
+
+        self._routes: Dict[str, DeploymentHandle] = {}   # app -> handle
+        self._routes_lock = threading.Lock()
+        proxy = self
+
+        def _resolve(context) -> DeploymentHandle:
+            md = dict(context.invocation_metadata())
+            app = md.get("application")
+            with proxy._routes_lock:
+                routes = dict(proxy._routes)
+            if app is not None:
+                handle = routes.get(app)
+                if handle is None:
+                    context.abort(grpc.StatusCode.NOT_FOUND,
+                                  f"no application {app!r}; running: "
+                                  f"{sorted(routes)}")
+                return handle
+            if len(routes) == 1:
+                return next(iter(routes.values()))
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"{len(routes)} applications running; pass "
+                          f"'application' metadata to pick one")
+
+        def _decode(request: bytes):
+            return json.loads(request) if request else None
+
+        def _encode(result) -> bytes:
+            if isinstance(result, bytes):
+                return result
+            if isinstance(result, str):
+                return result.encode()
+            return json.dumps(result).encode()
+
+        def predict(request: bytes, context) -> bytes:
+            handle = _resolve(context)
+            try:
+                # ValueError covers JSONDecodeError AND the
+                # UnicodeDecodeError non-UTF-8 bytes raise first
+                body = _decode(request)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            try:
+                return _encode(handle.remote(body).result(timeout_s=60))
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        def predict_stream(request: bytes, context):
+            handle = _resolve(context)
+            try:
+                body = _decode(request)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            try:
+                for chunk in handle.options(stream=True).remote(body):
+                    yield _encode(chunk)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                if call_details.method == f"/{_SERVICE}/Predict":
+                    return grpc.unary_unary_rpc_method_handler(predict)
+                if call_details.method == f"/{_SERVICE}/PredictStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        predict_stream)
+                return None
+
+        from concurrent import futures
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        threading.Thread(target=self._route_refresh_loop, daemon=True,
+                         name="serve-grpc-routes").start()
+
+    def _route_refresh_loop(self):
+        from ._proxy_util import rebuild_handles, refresh_routes_forever
+
+        def apply(targets):
+            # get_ingress_targets includes route_prefix=None apps:
+            # gRPC routing is by application NAME, no HTTP prefix needed
+            with self._routes_lock:
+                self._routes = rebuild_handles(
+                    self._routes,
+                    {app: (app, dep) for app, dep in targets.items()})
+
+        refresh_routes_forever(
+            lambda ctrl: ctrl.get_ingress_targets.remote(), apply)
+
+    def ready(self) -> int:
+        return self._port
+
+    def ping(self) -> bool:
+        return True
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0):
+    """Start (or fetch) the gRPC proxy actor; returns (handle, port)."""
+    from ._proxy_util import get_or_create_proxy
+    return get_or_create_proxy(GRPC_PROXY_NAME, GrpcProxy, host, port)
+
+
+__all__ = ["GrpcProxy", "start_grpc_proxy", "GRPC_PROXY_NAME"]

@@ -142,20 +142,14 @@ class HTTPProxy:
                          name="serve-http-routes").start()
 
     def _route_refresh_loop(self):
-        import time
-        import ray_tpu
-        from .controller import CONTROLLER_NAME
-        while True:
-            try:
-                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-                routes = ray_tpu.get(ctrl.get_routes.remote())
-                with self._routes_lock:
-                    self._routes = {
-                        prefix: DeploymentHandle(dep, app)
-                        for prefix, (app, dep) in routes.items()}
-            except Exception:  # noqa: BLE001  controller not up yet
-                pass
-            time.sleep(0.5)
+        from ._proxy_util import rebuild_handles, refresh_routes_forever
+
+        def apply(routes):
+            with self._routes_lock:
+                self._routes = rebuild_handles(self._routes, routes)
+
+        refresh_routes_forever(lambda ctrl: ctrl.get_routes.remote(),
+                               apply)
 
     def address(self):
         return (self._host, self._port)
@@ -169,11 +163,5 @@ class HTTPProxy:
 
 def start_proxy(host: str = "127.0.0.1", port: int = 8000):
     """Start (or fetch) the proxy actor; returns (handle, bound_port)."""
-    import ray_tpu
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-    except Exception:  # noqa: BLE001
-        proxy = ray_tpu.remote(HTTPProxy).options(
-            name=PROXY_NAME, max_concurrency=8).remote(host, port)
-    bound = ray_tpu.get(proxy.ready.remote())
-    return proxy, bound
+    from ._proxy_util import get_or_create_proxy
+    return get_or_create_proxy(PROXY_NAME, HTTPProxy, host, port)
