@@ -7,7 +7,8 @@ from .block import Block
 from .dataset import (Dataset, from_items, from_blocks, from_numpy,
                       from_pandas, range_,
                       read_text, read_jsonl, read_csv, read_npy,
-                      read_parquet, read_images, AggregateFn)
+                      read_parquet, read_images, read_binary_files,
+                      read_tfrecords, AggregateFn)
 from .device_loader import device_put_iterator
 from . import preprocessors
 
@@ -17,5 +18,6 @@ range = range_  # noqa: A001
 __all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
            "from_pandas",
            "range", "range_", "read_text", "read_jsonl", "read_csv",
-           "read_npy", "read_parquet", "read_images", "AggregateFn",
+           "read_npy", "read_parquet", "read_images", "read_binary_files",
+           "read_tfrecords", "AggregateFn",
            "device_put_iterator", "preprocessors"]

@@ -722,6 +722,106 @@ def read_images(path: str, *, size: Optional[Tuple[int, int]] = None,
                            num_rows=len(files)))
 
 
+def read_binary_files(path: str, *, include_paths: bool = True,
+                      suffixes: Optional[Sequence[str]] = None,
+                      block_rows: int = 64) -> Dataset:
+    """Directory (recursive) or single file -> blocks with a "bytes"
+    object column (+ "path"). Reference: read_api.py
+    read_binary_files — the escape hatch for formats without a
+    dedicated reader."""
+    import glob as globmod
+    import os as osmod
+
+    if osmod.path.isdir(path):
+        sfx = (None if suffixes is None
+               else tuple(s.lower() for s in suffixes))
+        files = sorted(
+            f for f in globmod.glob(osmod.path.join(path, "**", "*"),
+                                    recursive=True)
+            if osmod.path.isfile(f)
+            and (sfx is None or f.lower().endswith(sfx)))
+        if not files:
+            raise FileNotFoundError(f"no files under {path!r}")
+    else:
+        files = [path]
+
+    def make_blocks():
+        for i in range(0, len(files), block_rows):
+            chunk = files[i:i + block_rows]
+            col = np.empty(len(chunk), dtype=object)
+            for j, f in enumerate(chunk):
+                with open(f, "rb") as fh:
+                    col[j] = fh.read()
+            block: Block = {"bytes": col}
+            if include_paths:
+                block["path"] = np.asarray(chunk, dtype=object)
+            yield block
+
+    return Dataset(_Source(f"read_binary_files({path})", make_blocks,
+                           num_rows=len(files)))
+
+
+def _tfrecord_records(path: str):
+    """Iterate raw record payloads of one TFRecord file. Framing per the
+    public format: uint64 length, uint32 masked-crc(length), payload,
+    uint32 masked-crc(payload); CRCs are not verified (no snappy/crc32c
+    dependency in-image)."""
+    import struct
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return                     # clean EOF between records
+            if len(header) < 8:
+                raise ValueError(f"truncated TFRecord {path!r} "
+                                 f"(partial length header)")
+            (length,) = struct.unpack("<Q", header)
+            if len(f.read(4)) < 4:         # length crc
+                raise ValueError(f"truncated TFRecord {path!r} "
+                                 f"(missing length crc)")
+            payload = f.read(length)
+            if len(payload) < length:
+                raise ValueError(f"truncated TFRecord {path!r}")
+            if len(f.read(4)) < 4:         # payload crc
+                raise ValueError(f"truncated TFRecord {path!r} "
+                                 f"(missing payload crc)")
+            yield payload
+
+
+def read_tfrecords(path: str, *, parse_fn: Optional[Callable] = None,
+                   block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    """TFRecord file(s) -> blocks (reference: read_api.py
+    read_tfrecords; Arrow/TFX there, numpy blocks here).
+
+    Default rows are {"bytes": record} — pass parse_fn(record_bytes) ->
+    dict to decode (e.g. a tf.train.Example parser via the protobuf
+    runtime); its dicts become columnar blocks."""
+    import glob as globmod
+    import os as osmod
+
+    if osmod.path.isdir(path):
+        files = sorted(
+            globmod.glob(osmod.path.join(path, "*.tfrecord*")))
+        if not files:
+            raise FileNotFoundError(f"no *.tfrecord files in {path!r}")
+    else:
+        files = [path]
+
+    def make_blocks():
+        rows: List[Dict[str, Any]] = []
+        for f in files:
+            for rec in _tfrecord_records(f):
+                rows.append(parse_fn(rec) if parse_fn
+                            else {"bytes": rec})
+                if len(rows) >= block_rows:
+                    yield block_from_rows(rows)
+                    rows = []
+        if rows:
+            yield block_from_rows(rows)
+
+    return Dataset(_Source(f"read_tfrecords({path})", make_blocks))
+
+
 def read_parquet(path: str,
                  block_rows: int = DEFAULT_BLOCK_ROWS,
                  columns=None) -> Dataset:

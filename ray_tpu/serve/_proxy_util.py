@@ -36,8 +36,9 @@ def refresh_routes_forever(fetch: Callable, apply: Callable,
 def rebuild_handles(old: Dict[str, DeploymentHandle],
                     wanted: Dict[str, tuple]
                     ) -> Dict[str, DeploymentHandle]:
-    """key -> (deployment, app): reuse existing handles whose target is
-    unchanged; build fresh ones only for added/retargeted keys."""
+    """wanted: key -> (app_name, deployment_name). Reuses existing
+    handles whose target is unchanged; builds fresh ones only for
+    added/retargeted keys."""
     new = {}
     for key, (app, dep) in wanted.items():
         cur = old.get(key)

@@ -82,11 +82,16 @@ class GrpcProxy:
                 body = _decode(request)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            gen = handle.options(stream=True).remote(body)
             try:
-                for chunk in handle.options(stream=True).remote(body):
+                for chunk in gen:
                     yield _encode(chunk)
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            finally:
+                # client cancellation raises GeneratorExit here (not
+                # Exception): release the stream's replica accounting
+                gen.close()
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, call_details):

@@ -108,6 +108,17 @@ class DeploymentResponseGenerator:
             return self._buffer.pop(0)
         raise StopIteration
 
+    def close(self):
+        """Release routing accounting for an abandoned stream (client
+        cancelled before draining). Idempotent; a fully-drained stream
+        already fired on_done. Without this, a proxy whose client hangs
+        up mid-stream would leak the replica's manual in-flight count
+        forever (handles persist across route refreshes)."""
+        if not self._finished:
+            self._finished = True
+            if self._on_done is not None:
+                self._on_done()
+
     def __aiter__(self):
         return self
 

@@ -89,6 +89,7 @@ class HTTPProxy:
                     or (isinstance(body, dict) and bool(
                         body.get("stream"))))
                 headers_sent = False
+                gen = None
                 try:
                     if wants_stream:
                         gen = handle.options(stream=True).remote(body)
@@ -131,6 +132,12 @@ class HTTPProxy:
                                           json.dumps({"error": repr(e)}))
                     except Exception:  # noqa: BLE001  client went away
                         pass
+                finally:
+                    if gen is not None:
+                        # abandoned stream (client hung up): release
+                        # the replica's manual in-flight count — reused
+                        # handles would otherwise leak it forever
+                        gen.close()
 
             do_GET = do_POST = do_PUT = do_DELETE = _handle
 

@@ -108,3 +108,18 @@ def test_grpc_binary_garbage_is_invalid_argument(grpc_port):
              metadata=(("application", "echo-app"),))
     assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
     ch.close()
+
+
+def test_abandoned_stream_releases_replica_capacity(grpc_port, rt):
+    """A client that hangs up mid-stream must not leak the replica's
+    manual in-flight count (review r4): repeated early cancellations
+    would otherwise saturate routing forever."""
+    from ray_tpu.serve import get_app_handle
+    h = get_app_handle("echo-app")
+    for _ in range(12):          # > max_ongoing_requests default
+        gen = h.options(stream=True).remote({"stream": True})
+        next(iter(gen))          # take one chunk, then abandon
+        gen.close()
+    # functional check: unary traffic still flows after the abandonment
+    out = h.remote({"ping": 1}).result(timeout_s=30)
+    assert out["app"] == "echo-app"
