@@ -665,6 +665,26 @@ def read_npy(path: str, column: str = "data",
     return from_numpy({column: arr}, block_rows)
 
 
+def _list_files(path: str, *, suffixes=None,
+                pattern: str = "*") -> List[str]:
+    """Shared reader file listing: directory (recursive) or single
+    file; case-insensitive suffix filter; deterministic order."""
+    import glob as globmod
+    import os as osmod
+    if not osmod.path.isdir(path):
+        return [path]
+    sfx = (None if suffixes is None
+           else tuple(s.lower() for s in suffixes))
+    files = sorted(
+        f for f in globmod.glob(osmod.path.join(path, "**", pattern),
+                                recursive=True)
+        if osmod.path.isfile(f)
+        and (sfx is None or f.lower().endswith(sfx)))
+    if not files:
+        raise FileNotFoundError(f"no matching files under {path!r}")
+    return files
+
+
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
 
@@ -681,20 +701,9 @@ def read_images(path: str, *, size: Optional[Tuple[int, int]] = None,
     TPU. Without `size`, images keep their native resolutions as an
     object column (stack later with a map_batches resize).
     """
-    import glob as globmod
-    import os as osmod
-
     from PIL import Image
 
-    if osmod.path.isdir(path):
-        files = sorted(
-            f for f in globmod.glob(osmod.path.join(path, "**", "*"),
-                                    recursive=True)
-            if f.lower().endswith(_IMAGE_EXTS))
-        if not files:
-            raise FileNotFoundError(f"no image files under {path!r}")
-    else:
-        files = [path]
+    files = _list_files(path, suffixes=_IMAGE_EXTS)
 
     def decode(fp: str) -> np.ndarray:
         with Image.open(fp) as im:
@@ -729,21 +738,7 @@ def read_binary_files(path: str, *, include_paths: bool = True,
     object column (+ "path"). Reference: read_api.py
     read_binary_files — the escape hatch for formats without a
     dedicated reader."""
-    import glob as globmod
-    import os as osmod
-
-    if osmod.path.isdir(path):
-        sfx = (None if suffixes is None
-               else tuple(s.lower() for s in suffixes))
-        files = sorted(
-            f for f in globmod.glob(osmod.path.join(path, "**", "*"),
-                                    recursive=True)
-            if osmod.path.isfile(f)
-            and (sfx is None or f.lower().endswith(sfx)))
-        if not files:
-            raise FileNotFoundError(f"no files under {path!r}")
-    else:
-        files = [path]
+    files = _list_files(path, suffixes=suffixes)
 
     def make_blocks():
         for i in range(0, len(files), block_rows):
@@ -796,16 +791,7 @@ def read_tfrecords(path: str, *, parse_fn: Optional[Callable] = None,
     Default rows are {"bytes": record} — pass parse_fn(record_bytes) ->
     dict to decode (e.g. a tf.train.Example parser via the protobuf
     runtime); its dicts become columnar blocks."""
-    import glob as globmod
-    import os as osmod
-
-    if osmod.path.isdir(path):
-        files = sorted(
-            globmod.glob(osmod.path.join(path, "*.tfrecord*")))
-        if not files:
-            raise FileNotFoundError(f"no *.tfrecord files in {path!r}")
-    else:
-        files = [path]
+    files = _list_files(path, pattern="*.tfrecord*")
 
     def make_blocks():
         rows: List[Dict[str, Any]] = []

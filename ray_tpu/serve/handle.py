@@ -109,15 +109,24 @@ class DeploymentResponseGenerator:
         raise StopIteration
 
     def close(self):
-        """Release routing accounting for an abandoned stream (client
-        cancelled before draining). Idempotent; a fully-drained stream
-        already fired on_done. Without this, a proxy whose client hangs
-        up mid-stream would leak the replica's manual in-flight count
-        forever (handles persist across route refreshes)."""
-        if not self._finished:
-            self._finished = True
-            if self._on_done is not None:
-                self._on_done()
+        """Abandoned stream (client cancelled before draining): release
+        the router's manual in-flight count AND cancel the replica-side
+        drain task — otherwise the replica keeps pumping until its
+        bounded buffer fills, parks forever, and its _ongoing count
+        stays elevated (hanging graceful shutdown). Idempotent; a
+        fully-drained stream already fired on_done."""
+        if self._finished:
+            return
+        self._finished = True
+        try:
+            import ray_tpu
+            if self._stream_id is None:
+                self._stream_id = ray_tpu.get(self._stream_id_ref)
+            self._replica.stream_cancel.remote(self._stream_id)
+        except Exception:  # noqa: BLE001  replica already gone
+            pass
+        if self._on_done is not None:
+            self._on_done()
 
     def __aiter__(self):
         return self

@@ -18,6 +18,11 @@ from typing import Any, Dict, Optional
 _STREAM_END = "__ray_tpu_stream_end__"
 
 
+class _StreamCancelled(BaseException):
+    """Internal: consumer abandoned the stream; stop the drain task.
+    BaseException so a handler's own `except Exception` can't eat it."""
+
+
 class Replica:
     """The actor class the controller instantiates per replica.
 
@@ -41,6 +46,10 @@ class Replica:
         self._lock = threading.Lock()
         self._streams: Dict[str, queue_mod.Queue] = {}
         self._stream_counter = itertools.count()
+        # stream ids whose consumer hung up: _drain stops pumping (and
+        # the parked _put unblocks) instead of leaking the queue and a
+        # permanently-elevated _ongoing count
+        self._cancelled_streams: set = set()
 
         target = serialization.loads_call(callable_bytes)
         if inspect.isclass(target):
@@ -154,6 +163,8 @@ class Replica:
             # never block the event loop: the queue is bounded, so park
             # in short async sleeps when a slow consumer falls behind.
             while True:
+                if stream_id in self._cancelled_streams:
+                    raise _StreamCancelled()
                 try:
                     q.put_nowait(item)
                     return
@@ -188,15 +199,31 @@ class Replica:
                 else:  # unary result streamed as a single chunk
                     await _put(("chunk", result))
                 await _put(("end", None))
+            except _StreamCancelled:
+                pass               # consumer gone: just stop pumping
             except BaseException as e:  # noqa: BLE001
-                await _put(("error", e))
+                try:
+                    await _put(("error", e))
+                except _StreamCancelled:
+                    pass
             finally:
+                self._cancelled_streams.discard(stream_id)
                 with self._lock:
                     self._ongoing -= 1
                     self._total_served += 1
 
         asyncio.ensure_future(_drain())
         return stream_id
+
+    def stream_cancel(self, stream_id: str) -> bool:
+        """Consumer abandoned the stream (client hung up): stop the
+        drain task and drop the buffer. Idempotent; unknown/finished
+        ids are a no-op."""
+        if stream_id in self._streams:
+            self._cancelled_streams.add(stream_id)
+            self._streams.pop(stream_id, None)
+            return True
+        return False
 
     def stream_next(self, stream_id: str, batch: int = 64,
                     timeout_s: float = 30.0):

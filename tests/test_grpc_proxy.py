@@ -18,8 +18,9 @@ def grpc_port(rt):
     class Echo:
         def __call__(self, body):
             if isinstance(body, dict) and body.get("stream"):
+                n = int(body.get("n", 3))
                 def gen():
-                    for i in range(3):
+                    for i in range(n):
                         yield f"part{i}"
                 return gen()
             return {"echo": body, "app": "echo-app"}
@@ -116,10 +117,20 @@ def test_abandoned_stream_releases_replica_capacity(grpc_port, rt):
     would otherwise saturate routing forever."""
     from ray_tpu.serve import get_app_handle
     h = get_app_handle("echo-app")
+    # stream LONGER than one stream_next batch (64) so the first pull
+    # leaves it genuinely mid-stream, and longer than the replica's
+    # 1024-item buffer so an un-cancelled drain would park forever
     for _ in range(12):          # > max_ongoing_requests default
-        gen = h.options(stream=True).remote({"stream": True})
+        gen = h.options(stream=True).remote({"stream": True,
+                                             "n": 5000})
         next(iter(gen))          # take one chunk, then abandon
         gen.close()
-    # functional check: unary traffic still flows after the abandonment
+    # functional check: unary traffic still flows after 12 abandoned
+    # long streams (leaked counts would saturate max_ongoing_requests;
+    # un-cancelled replica drains would park on their full buffers)
     out = h.remote({"ping": 1}).result(timeout_s=30)
     assert out["app"] == "echo-app"
+    # a fresh full stream still works end-to-end after the cancels
+    full = list(h.options(stream=True).remote({"stream": True,
+                                               "n": 5}))
+    assert full == [f"part{i}" for i in range(5)]
