@@ -1,0 +1,48 @@
+"""Submit work to a driver over HTTP (dashboard job API).
+
+One process runs the dashboard (the "cluster"); any other process —
+or `ray_tpu job submit --remote` from a shell — submits scripts to it
+and streams their logs back.
+
+Run:  python examples/job_submission.py
+"""
+import shlex
+import sys
+import textwrap
+
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+from ray_tpu.observability import start_dashboard, stop_dashboard
+
+
+def main():
+    dash = start_dashboard(port=0)
+    print("dashboard:", dash.url)
+
+    client = JobSubmissionClient(address=dash.url)   # HTTP mode
+    script = textwrap.dedent("""
+        import ray_tpu
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        print("sum of squares:",
+              sum(ray_tpu.get([square.remote(i) for i in range(10)])))
+        ray_tpu.shutdown()
+    """)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c {shlex.quote(script)}",
+        metadata={"example": "job_submission"})
+    print("submitted:", sid)
+
+    for piece in client.tail_job_logs(sid):       # streams over HTTP
+        print(piece, end="")
+    status = client.get_job_status(sid)
+    print("final status:", status)
+    assert status == JobStatus.SUCCEEDED
+    stop_dashboard()
+
+
+if __name__ == "__main__":
+    main()
