@@ -78,6 +78,12 @@ class LLMEngineConfig:
     # (blocking) so the first real request never pays a jit compile —
     # the dominant term in cold TTFT (seconds even for toy models).
     precompile: bool = False
+    # Prefix caching (vLLM's automatic-prefix-caching, made explicit
+    # and static-shape for TPU): register_prefix() prefills a shared
+    # prompt prefix ONCE into a dedicated KV buffer; submits carrying
+    # prefix_id adopt it with one on-device copy and prefill only
+    # their suffix. 0 disables (no buffer allocated).
+    max_prefixes: int = 0
 
 
 @dataclass
@@ -93,6 +99,7 @@ class _Request:
     slot: int = -1
     generated: int = 0
     aborted: bool = False
+    prefix_id: int = -1             # registered-prefix KV to adopt
     prefill_pos: int = 0            # next prompt index (chunked prefill)
     submit_ts: float = field(default_factory=time.time)
     admit_ts: Optional[float] = None       # slot assigned
@@ -188,7 +195,7 @@ class LLMEngine:
         # prompt+budget at admission, so mid-stream KV eviction (vLLM's
         # preemption trigger) cannot occur by construction
         self.stats = {"prefills": 0, "decode_steps": 0,
-                      "tokens_generated": 0}
+                      "tokens_generated": 0, "prefix_tokens_saved": 0}
         # TTFT breakdown (VERDICT r4 ask): queue wait vs prefill
         # dispatch (compile on a bucket's first use) vs emit lag.
         self._ttft_samples: collections.deque = collections.deque(
@@ -198,6 +205,27 @@ class LLMEngine:
         # one labeled series per engine instance
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
         self._m_tokens, self._m_active, self._m_waiting = _engine_metrics()
+
+        # prefix cache: per layer (n_prefixes, L, Hkv, D) k/v + host-side
+        # token records; written by register_prefix, read (copied into a
+        # slot) at admission of prefix-carrying requests
+        self._prefix_cache = None
+        self._prefixes: Dict[int, np.ndarray] = {}   # pid -> tokens
+        self._prefix_counter = itertools.count()
+        if cfg.max_prefixes > 0:
+            # +1 scratch row: precompile() warms fill/adopt/chunk paths
+            # by EXECUTING a dummy prefix'd request against it (AOT
+            # lower().compile() does not populate the jit call cache)
+            self._prefix_cache = [
+                (jnp.zeros((cfg.max_prefixes + 1, L, mcfg.n_kv_heads,
+                            mcfg.head_dim), mcfg.dtype),
+                 jnp.zeros((cfg.max_prefixes + 1, L, mcfg.n_kv_heads,
+                            mcfg.head_dim), mcfg.dtype))
+                for _ in range(mcfg.n_layers)]
+            self._prefix_fill_jit = jax.jit(
+                self._prefix_fill_impl, static_argnames=("pad_len",))
+            self._adopt_prefix_jit = jax.jit(
+                self._adopt_prefix_impl, donate_argnums=(0,))
 
         self._prefilling: collections.deque = collections.deque()
         self._prefill_jit = jax.jit(
@@ -361,6 +389,46 @@ class LLMEngine:
         toks, logps = self._sample_tokens(last, temps, top_ps, rng_key)
         return toks, logps, out_cache
 
+    def _prefix_fill_impl(self, params, prefix_cache, tokens, pid,
+                          pad_len: int):
+        """Prefill a registered prefix into row `pid` of the prefix KV
+        buffers. tokens: (1, pad_len). NOT donated: concurrent adopts
+        of other prefixes keep reading the old buffer safely."""
+        jnp = self._jnp
+        mcfg = self.model.cfg
+        small = [(jnp.zeros((1, pad_len, mcfg.n_kv_heads,
+                             mcfg.head_dim), mcfg.dtype),
+                  jnp.zeros((1, pad_len, mcfg.n_kv_heads,
+                             mcfg.head_dim), mcfg.dtype),
+                  jnp.zeros((1,), jnp.int32))
+                 for _ in range(mcfg.n_layers)]
+        positions = jnp.arange(pad_len)[None, :]
+        _logits, new_small = self.model.apply(
+            {"params": params}, tokens, cache=small,
+            positions=positions)
+        out = []
+        for (pk, pv), (k1, v1, _l) in zip(prefix_cache, new_small):
+            pk = pk.at[pid, :pad_len].set(k1[0])
+            pv = pv.at[pid, :pad_len].set(v1[0])
+            out.append((pk, pv))
+        return out
+
+    def _adopt_prefix_impl(self, cache, prefix_cache, slot, pid, plen):
+        """Copy prefix `pid`'s KV into `slot` and set its length to
+        `plen` — the whole point: a shared system prompt costs ONE
+        on-device copy per request instead of a re-prefill."""
+        jax = self._jax
+        lax = jax.lax
+        out = []
+        for (ck, cv, lens), (pk, pv) in zip(cache, prefix_cache):
+            row_k = lax.dynamic_slice_in_dim(pk, pid, 1, axis=0)
+            row_v = lax.dynamic_slice_in_dim(pv, pid, 1, axis=0)
+            ck = lax.dynamic_update_slice_in_dim(ck, row_k, slot, axis=0)
+            cv = lax.dynamic_update_slice_in_dim(cv, row_v, slot, axis=0)
+            lens = lens.at[slot].set(plen)
+            out.append((ck, cv, lens))
+        return out
+
     def _decode_impl(self, params, cache, last_tokens, active_mask,
                      temps, top_ps, rng_key):
         """One decode step for every slot. Returns (next_tokens (S,),
@@ -403,15 +471,62 @@ class LLMEngine:
         return toks, logps, cache, last
 
     # ---- public API -------------------------------------------------------
+    def register_prefix(self, prefix_ids) -> int:
+        """Prefill a shared prompt prefix (e.g. a system prompt) once;
+        returns a prefix_id for submit(prefix_id=...). Requires
+        cfg.max_prefixes > 0. Slots are append-only (static buffers):
+        registering more than max_prefixes raises. Thread-safe."""
+        if self._prefix_cache is None:
+            raise ValueError("engine built with max_prefixes=0")
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        if prefix.size >= self.cfg.max_seq_len - 1:
+            raise ValueError(f"prefix length {prefix.size} leaves no "
+                             f"room in max_seq_len "
+                             f"{self.cfg.max_seq_len}")
+        pid = next(self._prefix_counter)
+        if pid >= self.cfg.max_prefixes:
+            raise ValueError(
+                f"prefix slots exhausted ({self.cfg.max_prefixes})")
+        self._fill_prefix_row(pid, prefix)
+        return pid
+
+    def _fill_prefix_row(self, pid: int, prefix: np.ndarray) -> None:
+        """Fill buffer row `pid` (the scratch row included) under the
+        lock — the buffer swap is a read-modify-write; a concurrent
+        unsynchronized registration would silently drop one fill."""
+        pad = 1
+        while pad < prefix.size:
+            pad *= 2
+        pad = min(pad, self.cfg.max_seq_len)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :prefix.size] = prefix
+        with self._lock:
+            self._prefix_cache = self._prefix_fill_jit(
+                self.params, self._prefix_cache,
+                self._jnp.asarray(tokens), self._jnp.int32(pid),
+                pad_len=pad)
+            self._prefixes[pid] = prefix
+
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               stop_token_ids=None) -> str:
+               stop_token_ids=None,
+               prefix_id: Optional[int] = None) -> str:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        if not self._use_chunked(prompt.size):
+        if prefix_id is not None:
+            prefix = self._prefixes.get(prefix_id)
+            if prefix is None:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            # prompt_ids is the SUFFIX; the engine re-attaches the
+            # prefix tokens (for stop/position bookkeeping) but its KV
+            # is adopted by copy, never re-prefilled
+            prompt = np.concatenate([prefix, prompt])
+        elif not self._use_chunked(prompt.size):
             # chunked prompts bypass the buckets; all others must fit one
             self._bucket(prompt.size)  # validate in the caller, not loop
         budget = max_new_tokens or self.cfg.max_new_tokens_default
@@ -424,7 +539,8 @@ class LLMEngine:
         req = _Request(request_id=f"req-{next(self._req_counter)}",
                        prompt=prompt, max_new_tokens=budget,
                        temperature=temperature, top_p=float(top_p),
-                       stop_ids=frozenset(stop_token_ids or ()))
+                       stop_ids=frozenset(stop_token_ids or ()),
+                       prefix_id=-1 if prefix_id is None else prefix_id)
         with self._lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -508,12 +624,37 @@ class LLMEngine:
         for rid in rids:
             for _ in self.stream(rid):
                 pass
+        if self.cfg.max_prefixes > 0:
+            # Warm fill + adopt + the per-bucket chunk kernels by
+            # EXECUTING dummy prefix'd requests against the scratch
+            # prefix row (pid == max_prefixes — never handed out), one
+            # suffix length per reachable chunk width. AOT
+            # lower().compile() would NOT populate the jit call cache.
+            scratch = self.cfg.max_prefixes
+            self._fill_prefix_row(scratch, np.ones((2,), np.int32))
+            widths = ({self.cfg.prefill_chunk}
+                      if self.cfg.prefill_chunk > 0 else
+                      {b for b in self.cfg.prefill_buckets
+                       if b <= self.cfg.max_seq_len})
+            warm = []
+            for w in sorted(widths):
+                n = max(1, min(w, self.cfg.max_seq_len - 4))
+                warm.append(self.submit(np.ones((n,), np.int32),
+                                        max_new_tokens=2,
+                                        prefix_id=scratch))
+            for rid in warm:
+                for _ in self.stream(rid):
+                    pass
+            self._prefixes.pop(scratch, None)
+            self.stats["prefix_tokens_saved"] = 0   # dummy adoptions
 
     def generate_sync(self, prompt_ids, max_new_tokens=None,
                       temperature: float = 0.0, top_p: float = 1.0,
-                      stop_token_ids=None) -> List[int]:
+                      stop_token_ids=None,
+                      prefix_id: Optional[int] = None) -> List[int]:
         rid = self.submit(prompt_ids, max_new_tokens, temperature,
-                          top_p=top_p, stop_token_ids=stop_token_ids)
+                          top_p=top_p, stop_token_ids=stop_token_ids,
+                          prefix_id=prefix_id)
         return list(self.stream(rid))
 
     def get_stats(self) -> Dict[str, Any]:
@@ -544,15 +685,32 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds largest prefill "
                          f"bucket {self.cfg.prefill_buckets[-1]}")
 
+    def _largest_bucket(self) -> int:
+        return max((b for b in self.cfg.prefill_buckets
+                    if b <= self.cfg.max_seq_len),
+                   default=self.cfg.max_seq_len)
+
+    def _chunk_for(self, remaining: int) -> int:
+        """Chunk width for one chunked-prefill dispatch. With chunking
+        on, the configured chunk. Otherwise (prefix-adoption fallback)
+        the SMALLEST bucket covering the remaining suffix — a short
+        suffix after a long prefix must not pay a largest-bucket-wide
+        model pass (that would out-cost the prefill the prefix cache
+        saved)."""
+        if self.cfg.prefill_chunk > 0:
+            return self.cfg.prefill_chunk
+        for b in sorted(self.cfg.prefill_buckets):
+            if remaining <= b <= self.cfg.max_seq_len:
+                return b
+        return self._largest_bucket()
+
     def _use_chunked(self, n: int) -> bool:
         """Chunked prefill serves prompts longer than prefill_chunk AND
         any prompt that overflows the largest bucket (so bucket coverage
         never rejects what the chunked path could handle)."""
         if self.cfg.prefill_chunk <= 0:
             return False
-        largest = max((b for b in self.cfg.prefill_buckets
-                       if b <= self.cfg.max_seq_len), default=0)
-        return n > self.cfg.prefill_chunk or n > largest
+        return n > self.cfg.prefill_chunk or n > self._largest_bucket()
 
     def _admit_all(self, inflight) -> None:
         """Dispatch prefills for every waiting request that can get a
@@ -574,6 +732,20 @@ class LLMEngine:
             slot = self._free_slots.pop()
             req.slot = slot
             req.admit_ts = time.time()
+            if req.prefix_id >= 0:
+                # adopt the registered prefix's KV with ONE on-device
+                # copy, then chunk-prefill only the suffix
+                plen = int(self._prefixes[req.prefix_id].size)
+                self._cache = self._adopt_prefix_jit(
+                    self._cache, self._prefix_cache,
+                    self._jnp.int32(slot),
+                    self._jnp.int32(req.prefix_id),
+                    self._jnp.int32(plen))
+                req.prefill_pos = plen
+                self.stats["prefix_tokens_saved"] = (
+                    self.stats.get("prefix_tokens_saved", 0) + plen)
+                self._prefilling.append(req)
+                continue
             if self._use_chunked(req.prompt.size):
                 # long prompt: prefill in chunks interleaved with decode
                 # steps (one chunk per loop iteration)
@@ -668,8 +840,8 @@ class LLMEngine:
             self._prefilling.popleft()
             self._release(req)
             return
-        C = self.cfg.prefill_chunk
         start = req.prefill_pos
+        C = self._chunk_for(req.prompt.size - start)
         true = min(C, req.prompt.size - start)
         is_last = start + true >= req.prompt.size
         tokens = np.zeros((1, C), np.int32)

@@ -701,3 +701,109 @@ def test_prompt_beyond_largest_bucket_uses_chunked_path(tiny_llm):
         assert eng.get_stats()["prefills"] == 1
     finally:
         eng.shutdown()
+
+
+def test_prefix_cache_matches_full_prefill():
+    """register_prefix + adopt-by-copy must be token-identical to
+    prefilling the full prompt, across reuse and mixed traffic
+    (reference: vLLM automatic prefix caching, made explicit and
+    static-shape for TPU)."""
+    import jax.numpy as jnp
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                      n_heads=4, n_kv_heads=2, d_ff=64,
+                      max_seq_len=128, remat=False, dtype=jnp.float32)
+    import jax
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prefix = list(np.arange(1, 21))
+    suffix = [33, 7, 99]
+
+    ref_eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(8, 16, 32)))
+    try:
+        ref = ref_eng.generate_sync(prefix + suffix, max_new_tokens=6)
+        plain = ref_eng.generate_sync([9, 8, 7], max_new_tokens=4)
+    finally:
+        ref_eng.shutdown()
+
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(8, 16, 32),
+        max_prefixes=2))
+    try:
+        pid = eng.register_prefix(prefix)
+        # interleave prefix'd and plain requests on shared slots
+        r1 = eng.submit(suffix, max_new_tokens=6, prefix_id=pid)
+        r2 = eng.submit([9, 8, 7], max_new_tokens=4)
+        r3 = eng.submit(suffix, max_new_tokens=6, prefix_id=pid)
+        assert list(eng.stream(r1)) == ref
+        assert list(eng.stream(r2)) == plain
+        assert list(eng.stream(r3)) == ref       # reused slot + prefix
+        st = eng.get_stats()
+        assert st["prefix_tokens_saved"] == 2 * len(prefix)
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_long_suffix_chunks():
+    """A suffix longer than prefill_chunk still chunk-prefills on top
+    of the adopted prefix KV."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                      n_heads=4, n_kv_heads=2, d_ff=64,
+                      max_seq_len=128, remat=False, dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prefix = list((np.arange(1, 18) * 5) % 128)
+    suffix = list((np.arange(1, 41) * 3) % 128)    # 40 > chunk 16
+
+    ref_eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(64,)))
+    try:
+        ref = ref_eng.generate_sync(prefix + suffix, max_new_tokens=5)
+    finally:
+        ref_eng.shutdown()
+
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16,),
+        prefill_chunk=16, max_prefixes=1))
+    try:
+        pid = eng.register_prefix(prefix)
+        got = list(eng.stream(eng.submit(suffix, max_new_tokens=5,
+                                         prefix_id=pid)))
+    finally:
+        eng.shutdown()
+    assert got == ref, (got, ref)
+
+
+def test_prefix_cache_validation(tiny_llm):
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    model, params = tiny_llm
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16,),
+        max_prefixes=1))
+    try:
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], prefix_id=0)       # not registered
+        pid = eng.register_prefix([1, 2, 3])
+        with pytest.raises(ValueError):
+            eng.register_prefix([4, 5])           # slots exhausted
+        with pytest.raises(ValueError):
+            eng.submit([1], prefix_id=pid + 7)
+        toks = eng.generate_sync([7, 8], max_new_tokens=3,
+                                 prefix_id=pid)
+        assert len(toks) == 3
+    finally:
+        eng.shutdown()
+    # disabled engine refuses registration
+    eng2 = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16,)))
+    try:
+        with pytest.raises(ValueError):
+            eng2.register_prefix([1, 2])
+    finally:
+        eng2.shutdown()
