@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+import numpy as np
+
 from ..deployment import Application, deployment_decorator
 from .engine import LLMEngine, LLMEngineConfig
 
@@ -18,14 +20,49 @@ class LLMServer:
     `model_factory` is a zero-arg callable returning (model, params) —
     kept as a factory so weights load inside the replica process (on the
     TPU host), not in the driver.
+
+    `cached_prefixes`: shared prompt prefixes (strings or token lists,
+    e.g. the system prompt) registered on the engine at startup; any
+    request whose prompt starts with one adopts its KV instead of
+    re-prefilling it (engine prefix caching).
+
+    Matching is TOKEN-level (correctness is never at risk — a miss
+    just pays the normal full prefill). For STRING prefixes under a
+    BPE tokenizer, prefer passing token ids that align with how full
+    prompts tokenize: a merge across the prefix/suffix boundary (or a
+    chat template) makes encode(prefix) not a token-prefix of
+    encode(prefix + suffix) and the cache silently never matches —
+    watch the engine's `prefix_tokens_saved` stat to confirm hits.
     """
 
     def __init__(self, model_factory, engine_config: Optional[dict] = None,
-                 tokenizer: Optional[Any] = None):
+                 tokenizer: Optional[Any] = None,
+                 cached_prefixes: Optional[list] = None):
         model, params = model_factory()
-        cfg = LLMEngineConfig(**(engine_config or {}))
+        engine_config = dict(engine_config or {})
+        if cached_prefixes:
+            engine_config.setdefault("max_prefixes",
+                                     len(cached_prefixes))
+        cfg = LLMEngineConfig(**engine_config)
         self.engine = LLMEngine(model, params, cfg)
         self.tokenizer = tokenizer
+        self._cached_prefixes = []      # (tokens, pid), longest first
+        for p in cached_prefixes or []:
+            ids = np.asarray(self._encode(p), np.int32).reshape(-1)
+            pid = self.engine.register_prefix(ids)
+            self._cached_prefixes.append((ids, pid))
+        self._cached_prefixes.sort(key=lambda t: -t[0].size)
+
+    def _match_prefix(self, prompt):
+        """(submit_prompt, prefix_id): strip the longest registered
+        prefix the prompt starts with; the engine re-attaches its
+        tokens but adopts its KV by copy."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        for ids, pid in self._cached_prefixes:
+            if prompt.size > ids.size and np.array_equal(
+                    prompt[:ids.size], ids):
+                return prompt[ids.size:], pid
+        return prompt, None
 
     def _encode(self, prompt):
         if isinstance(prompt, str):
@@ -45,13 +82,15 @@ class LLMServer:
         """Unary or streaming generate. body: {"prompt": [ids] | str,
         "max_tokens": int, "temperature": float, "top_p": float,
         "stop_token_ids": [ids], "stream": bool}."""
-        prompt = self._encode(body["prompt"])
+        prompt, prefix_id = self._match_prefix(
+            self._encode(body["prompt"]))
         max_tokens = body.get("max_tokens")
         temperature = float(body.get("temperature", 0.0))
         rid = self.engine.submit(
             prompt, max_tokens, temperature,
             top_p=float(body.get("top_p", 1.0)),
-            stop_token_ids=body.get("stop_token_ids"))
+            stop_token_ids=body.get("stop_token_ids"),
+            prefix_id=prefix_id)
         if body.get("stream"):
             def gen():
                 for tok in self.engine.stream(rid):
@@ -77,17 +116,21 @@ def build_llm_deployment(model_factory, *, engine_config=None,
                          tokenizer=None, name: str = "LLMServer",
                          num_replicas: int = 1,
                          max_ongoing_requests: int = 32,
+                         cached_prefixes=None,
                          server_cls=None, server_kwargs=None,
                          route_prefix: str = "/") -> Application:
     """Build a ready-to-run LLM serving app:
     `serve.run(build_llm_deployment(factory))`. `server_cls` swaps the
-    deployment class (e.g. openai_api.OpenAIServer)."""
+    deployment class (e.g. openai_api.OpenAIServer); `cached_prefixes`
+    registers shared prompt prefixes for engine prefix caching."""
     dep = deployment_decorator(
         server_cls or LLMServer, name=name, num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
         route_prefix=route_prefix)
     return dep.bind(model_factory, engine_config=engine_config,
-                    tokenizer=tokenizer, **(server_kwargs or {}))
+                    tokenizer=tokenizer,
+                    cached_prefixes=cached_prefixes,
+                    **(server_kwargs or {}))
 
 
 def __getattr__(name):

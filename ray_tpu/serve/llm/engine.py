@@ -636,9 +636,17 @@ class LLMEngine:
                       if self.cfg.prefill_chunk > 0 else
                       {b for b in self.cfg.prefill_buckets
                        if b <= self.cfg.max_seq_len})
+            lens = {max(1, min(w, self.cfg.max_seq_len - 4))
+                    for w in widths}
+            if self.cfg.prefill_chunk <= 0 and widths:
+                # a suffix LONGER than the largest bucket dispatches the
+                # (largest, sample=False) multi-chunk variant — the one
+                # width-suffix pairs above can never reach (per-dispatch
+                # widths always cover the remaining suffix)
+                lens.add(max(1, min(max(widths) + 1,
+                                    self.cfg.max_seq_len - 4)))
             warm = []
-            for w in sorted(widths):
-                n = max(1, min(w, self.cfg.max_seq_len - 4))
+            for n in sorted(lens):
                 warm.append(self.submit(np.ones((n,), np.int32),
                                         max_new_tokens=2,
                                         prefix_id=scratch))
@@ -686,9 +694,11 @@ class LLMEngine:
                          f"bucket {self.cfg.prefill_buckets[-1]}")
 
     def _largest_bucket(self) -> int:
+        """0 when NO bucket fits max_seq_len — callers that need a
+        usable width must supply their own fallback (a non-zero default
+        here would flip _use_chunked's always-chunk invariant)."""
         return max((b for b in self.cfg.prefill_buckets
-                    if b <= self.cfg.max_seq_len),
-                   default=self.cfg.max_seq_len)
+                    if b <= self.cfg.max_seq_len), default=0)
 
     def _chunk_for(self, remaining: int) -> int:
         """Chunk width for one chunked-prefill dispatch. With chunking
@@ -702,7 +712,7 @@ class LLMEngine:
         for b in sorted(self.cfg.prefill_buckets):
             if remaining <= b <= self.cfg.max_seq_len:
                 return b
-        return self._largest_bucket()
+        return self._largest_bucket() or self.cfg.max_seq_len
 
     def _use_chunked(self, n: int) -> bool:
         """Chunked prefill serves prompts longer than prefill_chunk AND
@@ -736,11 +746,20 @@ class LLMEngine:
                 # adopt the registered prefix's KV with ONE on-device
                 # copy, then chunk-prefill only the suffix
                 plen = int(self._prefixes[req.prefix_id].size)
-                self._cache = self._adopt_prefix_jit(
-                    self._cache, self._prefix_cache,
-                    self._jnp.int32(slot),
-                    self._jnp.int32(req.prefix_id),
-                    self._jnp.int32(plen))
+                try:
+                    self._cache = self._adopt_prefix_jit(
+                        self._cache, self._prefix_cache,
+                        self._jnp.int32(slot),
+                        self._jnp.int32(req.prefix_id),
+                        self._jnp.int32(plen))
+                except BaseException as e:  # noqa: BLE001
+                    # same per-request containment as the sibling
+                    # dispatch paths: free the slot, error the stream
+                    self._free_slots.append(slot)
+                    req.slot = -1
+                    req.out_queue.put(("error", e))
+                    req.out_queue.put(_END)
+                    continue
                 req.prefill_pos = plen
                 self.stats["prefix_tokens_saved"] = (
                     self.stats.get("prefix_tokens_saved", 0) + plen)

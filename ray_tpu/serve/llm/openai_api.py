@@ -33,8 +33,10 @@ class OpenAIServer(LLMServer):
 
     def __init__(self, model_factory, engine_config: Optional[dict] = None,
                  tokenizer: Optional[Any] = None,
+                 cached_prefixes: Optional[list] = None,
                  model_name: str = "ray-tpu-llm"):
-        super().__init__(model_factory, engine_config, tokenizer)
+        super().__init__(model_factory, engine_config, tokenizer,
+                         cached_prefixes=cached_prefixes)
         self.model_name = model_name
 
     # ---- request plumbing -------------------------------------------------
@@ -150,7 +152,8 @@ class OpenAIServer(LLMServer):
     def _completions(self, body: Dict[str, Any]):
         prompt = self._encode(body["prompt"])
         sp, stops, effective = self._sampling(body, len(prompt))
-        rid = self.engine.submit(prompt, **sp)
+        suffix, prefix_id = self._match_prefix(prompt)
+        rid = self.engine.submit(suffix, prefix_id=prefix_id, **sp)
         oid = f"cmpl-{next(_req_ids)}"
         if body.get("stream"):
             return self._stream_events(
@@ -181,7 +184,8 @@ class OpenAIServer(LLMServer):
     def _chat(self, body: Dict[str, Any]):
         prompt = self._chat_prompt(body["messages"])
         sp, stops, effective = self._sampling(body, len(prompt))
-        rid = self.engine.submit(prompt, **sp)
+        suffix, prefix_id = self._match_prefix(prompt)
+        rid = self.engine.submit(suffix, prefix_id=prefix_id, **sp)
         oid = f"chatcmpl-{next(_req_ids)}"
         if body.get("stream"):
             return self._stream_events(
@@ -274,8 +278,13 @@ def build_openai_deployment(model_factory, *, engine_config=None,
                             name: str = "OpenAIServer",
                             num_replicas: int = 1,
                             route_prefix: str = "/v1",
+                            cached_prefixes=None,
                             max_ongoing_requests: int = 64) -> Application:
-    """An Application serving /v1/completions + /v1/chat/completions."""
+    """An Application serving /v1/completions + /v1/chat/completions.
+
+    cached_prefixes: shared prompt prefixes (e.g. the system prompt's
+    token ids or text) prefilled once at startup; any request starting
+    with one adopts its KV instead of re-prefilling (prefix caching)."""
     engine_config = dict(engine_config or {})
     # the completions `logprobs` field needs the engine to fetch them
     engine_config.setdefault("logprobs", True)
@@ -283,6 +292,7 @@ def build_openai_deployment(model_factory, *, engine_config=None,
         model_factory, engine_config=engine_config, tokenizer=tokenizer,
         name=name, num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
+        cached_prefixes=cached_prefixes,
         server_cls=OpenAIServer,
         server_kwargs={"model_name": model_name},
         route_prefix=route_prefix)

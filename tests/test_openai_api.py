@@ -234,3 +234,52 @@ def test_stream_flushes_withheld_tail_on_length_finish(openai_app):
     streamed = "".join(c["choices"][0].get("text") or "" for c in chunks)
     assert streamed == full, (streamed, full)
     assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_cached_prefix_served_identically(rt):
+    """A deployment with cached_prefixes serves prompts starting with
+    the prefix token-identically to a PLAIN deployment, while skipping
+    its prefill (engine prefix caching through the OpenAI surface)."""
+    from ray_tpu.serve.llm import build_openai_deployment
+    from ray_tpu.serve.http_proxy import start_proxy
+
+    system = "system: be terse\n"
+    tok = DummyTok()
+    common = dict(
+        tokenizer=tok,
+        engine_config={"max_slots": 4, "max_seq_len": 128,
+                       "prefill_buckets": (16, 32),
+                       "max_new_tokens_default": 8})
+    serve.run(build_openai_deployment(
+        _factory, cached_prefixes=[system], model_name="tiny-prefix",
+        **common), name="prefix-app", route_prefix="/v2")
+    serve.run(build_openai_deployment(
+        _factory, model_name="tiny-plain", **common),
+        name="plain-app", route_prefix="/v3")
+    _proxy, port = start_proxy(port=0)
+    time.sleep(1.0)
+
+    def post(route, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{route}/completions",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    full_prompt = system + "hi there"
+    body = {"prompt": full_prompt, "max_tokens": 6, "temperature": 0}
+    with_prefix = post("/v2", body)
+    plain = post("/v3", body)
+    # the cached deployment's output equals the uncached oracle's
+    assert with_prefix["choices"][0]["text"] == \
+        plain["choices"][0]["text"]
+    # non-matching prompt still served (no prefix adoption)
+    other = post("/v2", {"prompt": "different", "max_tokens": 4,
+                         "temperature": 0})
+    assert other["usage"]["completion_tokens"] == 4
+    # usage counts the FULL prompt (prefix included)
+    assert with_prefix["usage"]["prompt_tokens"] == \
+        len(tok.encode(full_prompt))
+    serve.delete("prefix-app")
+    serve.delete("plain-app")
