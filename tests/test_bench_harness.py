@@ -1,0 +1,110 @@
+"""bench.py parent-harness hardening (VERDICT r4 weak #2).
+
+Round 4's driver run ended rc=124/parsed=null: a wedged tunnel made every
+phase re-pay the 300 s TPU probe and the only JSON print sat after the
+last phase. These tests pin the two fixes — the wedge determination is
+sticky across phases, and partial results hit disk/stdout incrementally —
+without ever importing jax (the parent process never does).
+"""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "PARTIAL_PATH",
+                        str(tmp_path / "BENCH_PARTIAL.json"))
+    monkeypatch.setattr(mod, "SNAPSHOT_PATH",
+                        str(tmp_path / "BENCH_TPU.json"))
+    return mod
+
+
+def test_wedge_is_sticky_across_phases(bench, monkeypatch):
+    """First rc=47 flips every later phase straight to CPU mode: only
+    the first phase may run without the CPU env."""
+    calls = []
+
+    def fake_spawn(phase, timeout_s, env):
+        forced = bool(env and env.get("RAY_TPU_BENCH_FORCE_CPU"))
+        calls.append(forced)
+        if not forced:
+            return bench.TPU_INIT_TIMEOUT_RC, b""  # wedged probe
+        return 0, json.dumps({"platform": "cpu"}).encode()
+
+    monkeypatch.setattr(bench, "_spawn_phase_child", fake_spawn)
+    r1, e1 = bench._run_phase("kernels", 60)
+    assert r1 == {"platform": "cpu"}
+    assert calls == [False, True]  # probe once, then CPU fallback
+    r2, _ = bench._run_phase("train", 60)
+    assert r2 == {"platform": "cpu"}
+    # second phase never re-paid the probe: started forced-CPU
+    assert calls == [False, True, True]
+    assert bench._STICKY_CPU is True
+
+
+def test_generic_timeout_falls_back_but_is_not_sticky(bench, monkeypatch):
+    """A wall-clock timeout (could be a long-but-healthy TPU compile)
+    retries THIS phase on CPU but must not poison later phases — only
+    the child watchdog's positive rc=47 wedge diagnosis is sticky."""
+    def fake_spawn(phase, timeout_s, env):
+        if not (env or {}).get("RAY_TPU_BENCH_FORCE_CPU"):
+            raise subprocess.TimeoutExpired(phase, 1)
+        return 0, json.dumps({"platform": "cpu"}).encode()
+
+    monkeypatch.setattr(bench, "_spawn_phase_child", fake_spawn)
+    r, _ = bench._run_phase("serve", 60)
+    assert r == {"platform": "cpu"}
+    assert bench._STICKY_CPU is False
+
+
+def test_merge_partial_is_always_parseable(bench):
+    """_merge with zero / partial phase results still yields the full
+    headline schema (value may be null, never malformed)."""
+    out = bench._merge({}, {}, t_start=0.0)
+    assert out["value"] is None and "unit" in out
+    out = bench._merge(
+        {"train": {"tokens_per_s": 100.0, "step_ms": 10.0,
+                   "compile_s": 1.0, "mfu": 0.1, "platform": "cpu",
+                   "batch": 2, "seq": 256, "final_loss": 5.0}},
+        {"kernels": "wedged"}, t_start=0.0)
+    assert out["value"] == 100.0
+    assert out["extra"]["kernels_error"] == "wedged"
+    json.dumps(out)  # round-trippable
+
+
+@pytest.mark.slow
+def test_sigterm_mid_run_emits_partial_json(tmp_path):
+    """Driver-style TERM mid-phase must leave (a) a parseable last stdout
+    line and (b) BENCH_PARTIAL.json on disk. Uses a phase child that
+    blocks forever via an env-forced tiny sleep-loop stand-in: we TERM
+    the parent while its first real phase child is still starting."""
+    env = dict(os.environ, RAY_TPU_BENCH_ATTEMPTS="1",
+               RAY_TPU_BENCH_TOTAL_BUDGET="300",
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=REPO, env=env)
+    try:
+        import time
+        time.sleep(8)  # parent is inside phase 1 (child compiling)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    last = out.decode().strip().splitlines()[-1]
+    parsed = json.loads(last)
+    assert parsed["extra"].get("killed_mid_phase") is True
+    assert "unit" in parsed

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""TPU evidence watcher: probe the tunnel, capture + COMMIT on revival.
+
+VERDICT r4 #1: four rounds produced zero driver-captured on-chip numbers
+because evidence capture waited for a human (or round-end bench) while the
+tunnel was only intermittently alive. This watcher makes capture automatic
+and un-losable:
+
+  1. Probe `jax.devices()` in a subprocess on a loop (the tunnel either
+     comes up in ~1-3 min or hangs ~25 min then raises UNAVAILABLE;
+     observed 2026-07-30). Every attempt is appended to TPU_WATCH.jsonl.
+  2. The moment a probe sees platform=="tpu", run the full evidence
+     sweep, each step in its own subprocess with a hard timeout:
+        tests_tpu/  -> TESTS_TPU_r05.json
+        bench.py --phase train-llama | flash-ab | serve | data | probe-8b
+     Phase children already persist on-chip results to BENCH_TPU.json
+     (bench.py:_snapshot_write) and FLASH_AB.json the moment they finish.
+  3. After EVERY completed step, `git add <evidence> && git commit`
+     immediately (with index.lock retry) — a later wedge, kill, or round
+     end can no longer erase captured evidence.
+
+If the tunnel never revives, the committed TPU_WATCH.jsonl log itself is
+the proof of continuous capture-readiness.
+
+Run detached:  nohup python tools/tpu_watcher.py > /tmp/tpu_watcher.log 2>&1 &
+Only ONE process may hold the tunnel — do not run bench/tests on the TPU
+while this is mid-sweep (CPU-forced runs are fine).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCH_LOG = os.path.join(REPO, "TPU_WATCH.jsonl")
+DONE_MARK = os.path.join(REPO, ".tpu_watcher_done")
+PROBE_TIMEOUT_S = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT", 1800))
+PROBE_SLEEP_S = float(os.environ.get("TPU_WATCH_SLEEP", 120))
+DEADLINE_S = float(os.environ.get("TPU_WATCH_DEADLINE", 11 * 3600))
+
+PROBE_SRC = """
+import time, json
+t0 = time.time()
+import jax
+devs = jax.devices()
+print(json.dumps({"platform": devs[0].platform, "n": len(devs),
+                  "init_s": round(time.time() - t0, 1)}))
+"""
+
+# (name, argv, timeout_s, evidence files to commit afterwards)
+SWEEP = [
+    ("tests_tpu",
+     [sys.executable, "-m", "pytest", "tests_tpu/", "-q", "--tb=line"],
+     2400, ["TESTS_TPU_r05.json", "BENCH_TPU.json"]),
+    ("train-llama",
+     [sys.executable, "bench.py", "--phase", "train-llama"],
+     2400, ["BENCH_TPU.json"]),
+    ("flash-ab",
+     [sys.executable, "bench.py", "--phase", "flash-ab"],
+     1800, ["BENCH_TPU.json", "FLASH_AB.json"]),
+    ("serve",
+     [sys.executable, "bench.py", "--phase", "serve"],
+     1500, ["BENCH_TPU.json"]),
+    ("data",
+     [sys.executable, "bench.py", "--phase", "data"],
+     900, ["BENCH_TPU.json"]),
+    ("probe-8b",
+     [sys.executable, "bench.py", "--phase", "probe-8b"],
+     2400, ["BENCH_TPU.json"]),
+]
+
+
+def log(event: dict) -> None:
+    event = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **event}
+    print(json.dumps(event), flush=True)
+    with open(WATCH_LOG, "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def git_commit(paths: list[str], msg: str) -> bool:
+    """add+commit with retries: the builder session commits concurrently,
+    so index.lock contention is expected and transient."""
+    existing = [p for p in paths + ["TPU_WATCH.jsonl"]
+                if os.path.exists(os.path.join(REPO, p))]
+    if not existing:
+        return False
+    for attempt in range(6):
+        try:
+            subprocess.run(["git", "add", "--"] + existing, cwd=REPO,
+                           check=True, capture_output=True, timeout=60)
+            diff = subprocess.run(["git", "diff", "--cached", "--quiet"],
+                                  cwd=REPO, timeout=60)
+            if diff.returncode == 0:
+                return True  # nothing new staged
+            subprocess.run(["git", "commit", "-m", msg], cwd=REPO,
+                           check=True, capture_output=True, timeout=60)
+            return True
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+            time.sleep(5 + 5 * attempt)
+    return False
+
+
+def probe() -> dict:
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                             capture_output=True, timeout=PROBE_TIMEOUT_S,
+                             cwd=REPO)
+        lines = out.stdout.decode(errors="replace").strip().splitlines()
+        if out.returncode == 0 and lines:
+            info = json.loads(lines[-1])
+            return {"ok": info.get("platform") == "tpu", **info,
+                    "wall_s": round(time.time() - t0)}
+        return {"ok": False, "rc": out.returncode,
+                "err": out.stderr.decode(errors="replace")[-500:],
+                "wall_s": round(time.time() - t0)}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "err": f"probe timeout {PROBE_TIMEOUT_S:.0f}s",
+                "wall_s": round(time.time() - t0)}
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "err": repr(e)[:500],
+                "wall_s": round(time.time() - t0)}
+
+
+def run_step(name: str, argv: list[str], timeout_s: float) -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(argv, cwd=REPO, timeout=timeout_s,
+                              capture_output=True)
+        tail = (proc.stdout.decode(errors="replace")[-2000:]
+                + proc.stderr.decode(errors="replace")[-1000:])
+        entry = {"step": name, "rc": proc.returncode,
+                 "wall_s": round(time.time() - t0), "tail": tail[-1500:]}
+    except subprocess.TimeoutExpired:
+        entry = {"step": name, "rc": "timeout",
+                 "wall_s": round(time.time() - t0)}
+    if name == "tests_tpu":
+        # pytest summary line is the committed record for VERDICT #9
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "rc": entry["rc"], "wall_s": entry["wall_s"],
+               "summary": [ln for ln in entry.get("tail", "").splitlines()
+                           if "passed" in ln or "failed" in ln
+                           or "error" in ln][-3:]}
+        with open(os.path.join(REPO, "TESTS_TPU_r05.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+    return entry
+
+
+def main() -> None:
+    t_start = time.time()
+    pp = os.environ.get("PYTHONPATH", "")
+    if "/root/.axon_site" not in pp.split(":"):
+        os.environ["PYTHONPATH"] = (pp + ":" if pp else "") + \
+            "/root/.axon_site"
+    log({"event": "watcher_start", "pid": os.getpid(),
+         "probe_timeout_s": PROBE_TIMEOUT_S})
+    swept = set()
+    last_log_commit = 0.0
+    while time.time() - t_start < DEADLINE_S:
+        r = probe()
+        log({"event": "probe", **{k: v for k, v in r.items()
+                                  if k != "tail"}})
+        if not r["ok"]:
+            # periodic readiness-log commit (throttled) so a dead round
+            # still shows the watcher was alive the whole time
+            if time.time() - last_log_commit > 1800:
+                git_commit([], "TPU watcher: probe log update")
+                last_log_commit = time.time()
+            time.sleep(PROBE_SLEEP_S)
+            continue
+        log({"event": "tunnel_up", "init_s": r.get("init_s")})
+        for name, argv, timeout_s, evidence in SWEEP:
+            if name in swept:
+                continue
+            log({"event": "step_start", "step": name})
+            entry = run_step(name, argv, timeout_s)
+            log({"event": "step_done", **entry})
+            ok = entry["rc"] == 0
+            if ok:
+                swept.add(name)
+            committed = git_commit(
+                evidence, f"On-chip evidence: {name} "
+                          f"({'ok' if ok else entry['rc']}) via TPU watcher")
+            log({"event": "committed", "step": name, "ok": committed})
+            if not ok:
+                break  # tunnel likely wedged again; back to probing
+        if len(swept) == len(SWEEP):
+            log({"event": "sweep_complete"})
+            git_commit([], "TPU watcher: full on-chip sweep complete")
+            with open(DONE_MARK, "w") as f:
+                f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
+            return
+        time.sleep(PROBE_SLEEP_S)
+    log({"event": "watcher_deadline", "swept": sorted(swept)})
+    git_commit([], "TPU watcher: deadline reached, final probe log")
+
+
+if __name__ == "__main__":
+    main()
