@@ -22,6 +22,9 @@ class DatasetStats:
     def __init__(self):
         self.stage_wall: Dict[str, float] = {}
         self.stage_blocks: Dict[str, int] = {}
+        # per-exchange instrumentation: map/reduce task counts and the
+        # max bytes any single reduce task held (the ~1/N guarantee)
+        self.exchange: Dict[str, Dict[str, int]] = {}
 
     def record(self, name: str, dt: float, nblocks: int = 1):
         self.stage_wall[name] = self.stage_wall.get(name, 0.0) + dt
@@ -32,6 +35,11 @@ class DatasetStats:
         for name, wall in self.stage_wall.items():
             lines.append(f"  {name}: {wall*1000:.1f} ms over "
                          f"{self.stage_blocks.get(name, 0)} blocks")
+        for name, ex in self.exchange.items():
+            lines.append(
+                f"  {name}: {ex['map_tasks']} map + {ex['reduce_tasks']} "
+                f"reduce tasks, max reduce input "
+                f"{ex['max_reduce_in_bytes']} B")
         return "\n".join(lines)
 
 
@@ -84,7 +92,27 @@ def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
             stats.record(stage.name, time.time() - t0, len(out))
             yield from out
         return shuffled()
+    if stage.kind == "exchange":
+        return _apply_exchange(stream, stage, stats, parallelism)
     raise ValueError(f"unknown stage kind {stage.kind}")
+
+
+def _apply_exchange(stream: Iterator[Block], stage: Stage,
+                    stats: DatasetStats,
+                    parallelism: int) -> Iterator[Block]:
+    """Distributed two-round shuffle (map-partition + reduce-merge) over
+    the core runtime; inline two-round fallback without it."""
+    from .exchange import run_exchange_distributed, run_exchange_local
+    if _runtime() is not None:
+        return run_exchange_distributed(stream, stage.exchange, stats,
+                                        parallelism)
+
+    def local() -> Iterator[Block]:
+        t0 = time.time()
+        out = run_exchange_local(list(stream), stage.exchange)
+        stats.record(stage.name, time.time() - t0, len(out))
+        yield from out
+    return local()
 
 
 def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,

