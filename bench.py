@@ -566,7 +566,12 @@ def phase_serve() -> dict:
                            pipeline_depth=int(os.environ.get(
                                "RAY_TPU_BENCH_ENGINE_DEPTH", "10")),
                            decode_block=int(os.environ.get(
-                               "RAY_TPU_BENCH_DECODE_BLOCK", "1")))
+                               "RAY_TPU_BENCH_DECODE_BLOCK", "1")),
+                           # paged KV pool (r5): 8 slots' worth of
+                           # budget in 64-token pages; stats surface in
+                           # the phase result
+                           kv_page_size=int(os.environ.get(
+                               "RAY_TPU_BENCH_KV_PAGE", "64")))
     engine = LLMEngine(model, params, ecfg)
     rng = np.random.RandomState(0)
 
@@ -617,6 +622,7 @@ def phase_serve() -> dict:
             "serve_tokens_s": tokens_measured / wall,
             "ttft_breakdown_p50_ms": stats.get("ttft_breakdown_p50_ms"),
             "prefill_compile_ms": stats.get("prefill_compile_ms"),
+            "kv_pages": stats.get("kv_pages"),
             "platform": devs[0].platform}
 
 

@@ -106,7 +106,13 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
     lengths) with ck/cv (B, L, Hkv, D) and lengths (B,). Writes k/v at
     `positions` (B, S), attends causally over the written prefix, and
     returns (out (B, S, Hq, D), new_cache). Shared by every decoder in
-    the zoo (llama.py, gpt2.py) — the engine's serving contract."""
+    the zoo (llama.py, gpt2.py) — the engine's serving contract.
+
+    A PagedKV cache entry routes to paged_cached_attention — same
+    semantics over a shared page pool."""
+    if isinstance(cache, PagedKV):
+        return paged_cached_attention(q, k, v, cache, positions,
+                                      scale=scale)
     b, s, hq, d = q.shape
     hkv = k.shape[2]
     if scale is None:
@@ -116,9 +122,20 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
     ck = ck.at[idx[:, None], positions].set(k.astype(ck.dtype))
     cv = cv.at[idx[:, None], positions].set(v.astype(cv.dtype))
     new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+    out = _attend_cached(q, ck, cv, positions, new_lengths, scale)
+    return out, (ck, cv, new_lengths)
+
+
+def _attend_cached(q, ck, cv, positions, new_lengths, scale):
+    """Shared attention tail for the contiguous and paged cached paths:
+    length-valid mask + causal mask + GQA repeat + softmax(QK)V. ONE
+    implementation so the paged engine can never drift numerically from
+    the contiguous one (their token-identical contract is tested)."""
+    hq = q.shape[2]
     L = ck.shape[1]
     valid = jnp.arange(L)[None, :] < new_lengths[:, None]
     logits_mask = jnp.where(valid, 0.0, jnp.finfo(jnp.float32).min)
+    hkv = ck.shape[2]
     rep = hq // hkv
     kk = jnp.repeat(ck, rep, axis=2) if rep > 1 else ck
     vv = jnp.repeat(cv, rep, axis=2) if rep > 1 else cv
@@ -129,8 +146,81 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
     pos_q = positions[:, None, :, None]
     att = jnp.where(pos_k <= pos_q, att, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(att, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
-    return out, (ck, cv, new_lengths)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedKV:
+    """Per-layer paged KV cache entry (vLLM-style, TPU-first).
+
+    k_flat/v_flat: (N_flat, Hkv, D) — the shared page pool, flattened to
+      token rows; N_flat = (n_pages [+ trash]) * page_size. Every
+      sequence in the batch reads/writes the SAME pool.
+    page_table: (B, P) int32 — page ids backing each sequence, in order;
+      logical position p of row b lives at flat row
+      page_table[b, p // page_size] * page_size + p % page_size.
+      Unallocated entries point at a trash page: writes there are
+      discarded by construction, reads are masked by `lengths`.
+    lengths: (B,) int32 — tokens currently valid per sequence.
+    page_size is STATIC pytree metadata, so jitted callers keep
+    `jnp.arange(page_size)` and friends shape-static.
+    """
+
+    def __init__(self, k_flat, v_flat, page_table, lengths,
+                 page_size: int):
+        self.k_flat = k_flat
+        self.v_flat = v_flat
+        self.page_table = page_table
+        self.lengths = lengths
+        self.page_size = page_size
+
+    def tree_flatten(self):
+        return ((self.k_flat, self.v_flat, self.page_table,
+                 self.lengths), self.page_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux)
+
+
+def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           cache: "PagedKV", positions: jax.Array,
+                           scale: Optional[float] = None):
+    """cached_attention semantics over a PagedKV pool.
+
+    Static shapes throughout (gather width = P * page_size), so the
+    decode step still compiles exactly once; the page indirection is one
+    take + one scatter per layer. Storage win vs the slot cache: the
+    pool is sized to the real token budget, not B * max_seq_len.
+    """
+    b, s, hq, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    k_flat, v_flat = cache.k_flat, cache.v_flat
+    page_table, lengths = cache.page_table, cache.lengths
+    page_size = cache.page_size
+    n_pages_per_seq = page_table.shape[1]
+    L = n_pages_per_seq * page_size
+
+    # scatter the new tokens' k/v into their flat pool rows
+    flat_pos = (jnp.take_along_axis(page_table,
+                                    positions // page_size, axis=1)
+                * page_size + positions % page_size)          # (B, S)
+    k_flat = k_flat.at[flat_pos.reshape(-1)].set(
+        k.astype(k_flat.dtype).reshape(b * s, *k.shape[2:]))
+    v_flat = v_flat.at[flat_pos.reshape(-1)].set(
+        v.astype(v_flat.dtype).reshape(b * s, *v.shape[2:]))
+    new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+
+    # gather each sequence's contiguous KV view from its pages
+    gather_idx = (page_table[:, :, None] * page_size
+                  + jnp.arange(page_size)[None, None, :]
+                  ).reshape(b, L)                             # (B, L)
+    ck = k_flat[gather_idx]                                   # (B,L,Hkv,D)
+    cv = v_flat[gather_idx]
+    out = _attend_cached(q, ck, cv, positions, new_lengths, scale)
+    return out, PagedKV(k_flat, v_flat, page_table, new_lengths,
+                        page_size)
 
 
 def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -83,7 +83,23 @@ class LLMEngineConfig:
     # prompt prefix ONCE into a dedicated KV buffer; submits carrying
     # prefix_id adopt it with one on-device copy and prefill only
     # their suffix. 0 disables (no buffer allocated).
+    # With kv_page_size > 0 there is NO dedicated buffer: a registered
+    # prefix is pinned shared pages in the pool; adoption shares its
+    # full pages by page-table reference (zero copy) and copies only
+    # the final partial page.
     max_prefixes: int = 0
+    # Paged KV cache (VERDICT r4 #4; vLLM's PagedAttention, TPU-first).
+    # 0 = legacy contiguous per-slot (max_slots x max_seq_len) buffers.
+    # >0 = a shared page pool: per-layer flat (n_pages * page_size)
+    # token rows + per-slot page tables (static shapes — decode still
+    # compiles once; see ops/attention.py:paged_cached_attention).
+    # Slots reserve ceil((prompt+budget)/page_size) pages at admission,
+    # so short requests no longer strand max_seq_len of HBM each and
+    # concurrency is bounded by the real token budget, not slot count.
+    kv_page_size: int = 0
+    # Total pool budget in KV tokens (rounded up to whole pages).
+    # 0 = max_slots * max_seq_len (same HBM as the legacy layout).
+    kv_pool_tokens: int = 0
 
 
 @dataclass
@@ -165,19 +181,54 @@ class LLMEngine:
                 f"engine max_seq_len {cfg.max_seq_len} exceeds the "
                 f"model's max_seq_len {model_max}")
         S, L = cfg.max_slots, cfg.max_seq_len
+        self._paged = cfg.kv_page_size > 0
         # +1 scratch slot when prefill batching is on: padding rows of a
         # batched prefill write their KV there; it is never admitted, so
         # its garbage never decodes. With batching off there is no
-        # scratch row (decode pays no extra-slot work).
-        self._n_slots = S + 1 if cfg.max_prefill_batch > 1 else S
+        # scratch row (decode pays no extra-slot work). Paged engines
+        # always keep it (costs one page-table row, not a KV row): it
+        # anchors batch-padding writes AND prefix registration prefills.
+        self._n_slots = (S + 1 if (cfg.max_prefill_batch > 1
+                                   or self._paged) else S)
         self._scratch_slot = S
-        self._cache = [
-            (jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
-                        mcfg.head_dim), mcfg.dtype),
-             jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
-                        mcfg.head_dim), mcfg.dtype),
-             jnp.zeros((self._n_slots,), jnp.int32))
-            for _ in range(mcfg.n_layers)]
+        if self._paged:
+            ps = cfg.kv_page_size
+            # per-slot gather width: whole pages covering max_seq_len
+            self._pages_per_slot = -(-L // ps)
+            pool_tokens = cfg.kv_pool_tokens or S * L
+            # the configured budget is honored exactly (rounded up to a
+            # page): oversized requests fail fast at submit() instead of
+            # silently inflating the pool
+            self._n_pages = max(1, -(-pool_tokens // ps))
+            self._trash_page = self._n_pages  # extra page: writes by
+            # released/padding slots land here and are never read valid
+            n_flat = (self._n_pages + 1) * ps
+            self._pools = [
+                (jnp.zeros((n_flat, mcfg.n_kv_heads, mcfg.head_dim),
+                           mcfg.dtype),
+                 jnp.zeros((n_flat, mcfg.n_kv_heads, mcfg.head_dim),
+                           mcfg.dtype))
+                for _ in range(mcfg.n_layers)]
+            self._page_table = jnp.full(
+                (self._n_slots, self._pages_per_slot),
+                self._trash_page, jnp.int32)
+            self._lengths = jnp.zeros((self._n_slots,), jnp.int32)
+            # host-side allocator
+            self._free_pages: List[int] = list(range(self._n_pages))
+            # slot -> (n_shared_prefix_pages, [all pages in table order])
+            self._slot_pages: Dict[int, tuple] = {}
+            self._prefix_pages: Dict[int, List[int]] = {}
+            self._pending_head: Optional[_Request] = None
+            self._page_hwm = 0      # peak pages in use (stats)
+            self._cache = None
+        else:
+            self._cache = [
+                (jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
+                            mcfg.head_dim), mcfg.dtype),
+                 jnp.zeros((self._n_slots, L, mcfg.n_kv_heads,
+                            mcfg.head_dim), mcfg.dtype),
+                 jnp.zeros((self._n_slots,), jnp.int32))
+                for _ in range(mcfg.n_layers)]
         self._last_tokens = jnp.zeros((self._n_slots,), jnp.int32)
         self._free_slots = list(range(S))
         self._active: Dict[int, _Request] = {}
@@ -212,7 +263,7 @@ class LLMEngine:
         self._prefix_cache = None
         self._prefixes: Dict[int, np.ndarray] = {}   # pid -> tokens
         self._prefix_counter = itertools.count()
-        if cfg.max_prefixes > 0:
+        if cfg.max_prefixes > 0 and not self._paged:
             # +1 scratch row: precompile() warms fill/adopt/chunk paths
             # by EXECUTING a dummy prefix'd request against it (AOT
             # lower().compile() does not populate the jit call cache)
@@ -241,6 +292,26 @@ class LLMEngine:
         self._decode_block_jit = (
             jax.jit(self._decode_block_impl, donate_argnums=(1,))
             if cfg.decode_block > 1 else None)
+        if self._paged:
+            self._prefill_paged_jit = jax.jit(
+                self._prefill_paged_impl, static_argnames=("pad_len",),
+                donate_argnums=(1, 3))
+            self._chunk_paged_jit = jax.jit(
+                self._chunk_paged_impl,
+                static_argnames=("chunk", "sample"), donate_argnums=(1, 3))
+            self._decode_paged_jit = jax.jit(
+                self._decode_paged_impl, donate_argnums=(1, 3))
+            self._decode_block_paged_jit = (
+                jax.jit(self._decode_block_paged_impl,
+                        donate_argnums=(1, 3))
+                if cfg.decode_block > 1 else None)
+            self._copy_page_jit = jax.jit(self._copy_page_impl,
+                                          donate_argnums=(0,))
+        # register_prefix (paged) must mutate the pools on the engine
+        # loop thread — its dispatches donate them, so a concurrent
+        # public-API mutation would race a stale buffer. Commands queue
+        # here and the loop executes them between steps.
+        self._control_q: "queue_mod.Queue" = queue_mod.Queue()
         self._loop_thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
         self._loop_thread.start()
@@ -429,6 +500,123 @@ class LLMEngine:
             out.append((ck, cv, lens))
         return out
 
+    # ---- paged-KV kernels (cfg.kv_page_size > 0) --------------------------
+    def _paged_entries(self, pools, page_table, lengths):
+        """Per-layer PagedKV cache entries over the shared pool. The
+        gather/scatter happens INSIDE each layer's attention, so only
+        one layer's contiguous view is ever live at a time."""
+        from ...ops.attention import PagedKV  # noqa: PLC0415
+        return [PagedKV(k, v, page_table, lengths, self.cfg.kv_page_size)
+                for (k, v) in pools]
+
+    def _prefill_paged_impl(self, params, pools, page_table, lengths,
+                            tokens, slots, true_lens, temps, top_ps,
+                            rng_key, pad_len: int):
+        """Prefill G prompts (single and batched unified): KV streams
+        straight into each slot's pages — no small-cache copy-back.
+        tokens: (G, pad_len); slots/true_lens/temps/top_ps: (G,).
+        Padding rows target the scratch slot, whose page-table row is
+        all-trash, so their writes vanish by construction."""
+        jnp = self._jnp
+        ps = self.cfg.kv_page_size
+        g = tokens.shape[0]
+        rows = page_table[slots]                   # (G, P)
+        rows_p = rows[:, :-(-pad_len // ps)]       # pages covering pad
+        from ...ops.attention import PagedKV  # noqa: PLC0415
+        entries = [PagedKV(k, v, rows_p, jnp.zeros((g,), jnp.int32), ps)
+                   for (k, v) in pools]
+        positions = jnp.broadcast_to(jnp.arange(pad_len)[None, :],
+                                     (g, pad_len))
+        logits, new_entries = self.model.apply(
+            {"params": params}, tokens, cache=entries,
+            positions=positions)
+        new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
+        lengths = lengths.at[slots].set(true_lens)
+        last = logits[jnp.arange(g), true_lens - 1]
+        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key)
+        return toks, logps, new_pools, lengths
+
+    def _chunk_paged_impl(self, params, pools, page_table, lengths,
+                          tokens, slot, start, new_len, temp, top_p,
+                          rng_key, chunk: int, sample: bool):
+        """One chunk of a long prompt (paged): gathers the slot's full
+        page row (start is dynamic, so the attention window cannot be
+        statically narrowed the way bucketed prefill narrows it)."""
+        jnp = self._jnp
+        jax = self._jax
+        ps = self.cfg.kv_page_size
+        row = jax.lax.dynamic_slice_in_dim(page_table, slot, 1, axis=0)
+        from ...ops.attention import PagedKV  # noqa: PLC0415
+        l1 = jnp.reshape(start, (1,)).astype(jnp.int32)
+        entries = [PagedKV(k, v, row, l1, ps) for (k, v) in pools]
+        positions = start + jnp.arange(chunk)[None, :]
+        logits, new_entries = self.model.apply(
+            {"params": params}, tokens, cache=entries,
+            positions=positions)
+        new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
+        lengths = lengths.at[slot].set(new_len)
+        if not sample:
+            return jnp.int32(0), jnp.float32(0), new_pools, lengths
+        last = logits[0, new_len - start - 1]
+        toks, logps = self._sample_tokens(last[None, :], temp[None],
+                                          top_p[None], rng_key)
+        return toks[0], logps[0], new_pools, lengths
+
+    def _decode_paged_impl(self, params, pools, page_table, lengths,
+                           last_tokens, active_mask, temps, top_ps,
+                           rng_key):
+        """One decode step for every slot over the page pool. Released
+        slots' page-table rows point at the trash page, so their writes
+        are inert; inactive lengths are restored so state never
+        drifts."""
+        jnp = self._jnp
+        entries = self._paged_entries(pools, page_table, lengths)
+        positions = lengths[:, None]
+        logits, new_entries = self.model.apply(
+            {"params": params}, last_tokens[:, None], cache=entries,
+            positions=positions)
+        logits = logits[:, 0, :]
+        new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
+        new_lengths = jnp.where(active_mask, new_entries[0].lengths,
+                                lengths)
+        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key)
+        nxt = jnp.where(active_mask, nxt, last_tokens)
+        return nxt, logps, new_pools, new_lengths
+
+    def _decode_block_paged_impl(self, params, pools, page_table,
+                                 lengths, last_tokens, active_mask,
+                                 temps, top_ps, rng_key):
+        jax = self._jax
+        keys = jax.random.split(rng_key, self.cfg.decode_block)
+
+        def body(carry, key):
+            pools, lengths, last = carry
+            nxt, logps, pools, lengths = self._decode_paged_impl(
+                params, pools, page_table, lengths, last, active_mask,
+                temps, top_ps, key)
+            return (pools, lengths, nxt), (nxt, logps)
+
+        (pools, lengths, last), (toks, logps) = jax.lax.scan(
+            body, (pools, lengths, last_tokens), keys)
+        return toks, logps, pools, lengths, last
+
+    def _copy_page_impl(self, pools, src_page, dst_page):
+        """Copy one page's k/v rows in every layer — the only device
+        copy prefix adoption pays (its final PARTIAL page; full pages
+        are shared by page-table reference)."""
+        lax = self._jax.lax
+        ps = self.cfg.kv_page_size
+        out = []
+        for (k, v) in pools:
+            rk = lax.dynamic_slice_in_dim(k, src_page * ps, ps, axis=0)
+            rv = lax.dynamic_slice_in_dim(v, src_page * ps, ps, axis=0)
+            k = lax.dynamic_update_slice_in_dim(k, rk, dst_page * ps,
+                                                axis=0)
+            v = lax.dynamic_update_slice_in_dim(v, rv, dst_page * ps,
+                                                axis=0)
+            out.append((k, v))
+        return out
+
     def _decode_impl(self, params, cache, last_tokens, active_mask,
                      temps, top_ps, rng_key):
         """One decode step for every slot. Returns (next_tokens (S,),
@@ -476,7 +664,8 @@ class LLMEngine:
         returns a prefix_id for submit(prefix_id=...). Requires
         cfg.max_prefixes > 0. Slots are append-only (static buffers):
         registering more than max_prefixes raises. Thread-safe."""
-        if self._prefix_cache is None:
+        if self._prefix_cache is None and not (
+                self._paged and self.cfg.max_prefixes > 0):
             raise ValueError("engine built with max_prefixes=0")
         prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
         if prefix.size == 0:
@@ -489,8 +678,79 @@ class LLMEngine:
         if pid >= self.cfg.max_prefixes:
             raise ValueError(
                 f"prefix slots exhausted ({self.cfg.max_prefixes})")
-        self._fill_prefix_row(pid, prefix)
+        if self._paged:
+            self._run_on_loop(
+                lambda: self._register_prefix_paged(pid, prefix))
+        else:
+            self._fill_prefix_row(pid, prefix)
         return pid
+
+    def _run_on_loop(self, fn) -> None:
+        """Execute `fn` on the engine loop thread (pool mutations must
+        not race dispatches that donate the pool buffers); blocks until
+        done and re-raises its exception. Shutdown-safe: the wait polls
+        the shutdown event so a command the exiting loop never drains
+        raises instead of hanging the caller forever."""
+        from concurrent.futures import Future  # noqa: PLC0415
+        from concurrent.futures import TimeoutError as FutTimeout
+        if self._shutdown.is_set():
+            raise RuntimeError("engine is shut down")
+        fut: Future = Future()
+        self._control_q.put((fn, fut))
+        while True:
+            try:
+                fut.result(timeout=0.1)
+                return
+            except FutTimeout:
+                if self._shutdown.is_set() and not fut.done():
+                    raise RuntimeError(
+                        "engine shut down before command ran") from None
+
+    def _register_prefix_paged(self, pid: int, prefix: np.ndarray
+                               ) -> None:
+        """Prefill a prefix into freshly-allocated PINNED pages (loop
+        thread only). No dedicated buffers: the prefix lives in the
+        pool; adopters share its full pages by reference."""
+        jnp = self._jnp
+        ps = self.cfg.kv_page_size
+        pages = self._alloc_pages(-(-prefix.size // ps))
+        if pages is None:
+            raise ValueError("page pool exhausted registering prefix")
+        scratch = self._scratch_slot
+        pad = 1
+        while pad < prefix.size:
+            pad *= 2
+        pad = min(pad, self.cfg.max_seq_len)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :prefix.size] = prefix
+        self._set_page_row(scratch, pages)
+        try:
+            self._rng_key, sub = self._jax.random.split(self._rng_key)
+            _t, _l, self._pools, self._lengths = self._prefill_paged_jit(
+                self.params, self._pools, self._page_table,
+                self._lengths, jnp.asarray(tokens),
+                jnp.asarray(np.asarray([scratch], np.int32)),
+                jnp.asarray(np.asarray([prefix.size], np.int32)),
+                jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.float32),
+                sub, pad_len=pad)
+        except BaseException:
+            self._free_pages.extend(pages)
+            raise
+        finally:
+            # scratch row back to all-trash: batch-padding rows write
+            # through it and must never touch the pinned prefix pages
+            self._set_page_row(scratch, [])
+        self._prefix_pages[pid] = pages
+        self._prefixes[pid] = prefix
+
+    def _unregister_prefix_paged(self, pid: int) -> None:
+        """Free a prefix's pinned pages (loop thread; internal — only
+        safe once no active slot shares them, e.g. precompile's warm
+        prefix after its streams drain)."""
+        pages = self._prefix_pages.pop(pid, None)
+        self._prefixes.pop(pid, None)
+        if pages:
+            self._free_pages.extend(pages)
 
     def _fill_prefix_row(self, pid: int, prefix: np.ndarray) -> None:
         """Fill buffer row `pid` (the scratch row included) under the
@@ -536,6 +796,13 @@ class LLMEngine:
                 raise ValueError(
                     f"prompt length {prompt.size} exceeds max_seq_len "
                     f"{self.cfg.max_seq_len}")
+        if self._paged:
+            ps = self.cfg.kv_page_size
+            if -(-(prompt.size + budget) // ps) > self._n_pages:
+                raise ValueError(
+                    f"request needs {-(-(prompt.size + budget) // ps)} "
+                    f"KV pages; pool has {self._n_pages} total — it "
+                    f"could never be admitted")
         req = _Request(request_id=f"req-{next(self._req_counter)}",
                        prompt=prompt, max_new_tokens=budget,
                        temperature=temperature, top_p=float(top_p),
@@ -631,7 +898,11 @@ class LLMEngine:
             # suffix length per reachable chunk width. AOT
             # lower().compile() would NOT populate the jit call cache.
             scratch = self.cfg.max_prefixes
-            self._fill_prefix_row(scratch, np.ones((2,), np.int32))
+            if self._paged:
+                self._run_on_loop(lambda: self._register_prefix_paged(
+                    scratch, np.ones((2,), np.int32)))
+            else:
+                self._fill_prefix_row(scratch, np.ones((2,), np.int32))
             widths = ({self.cfg.prefill_chunk}
                       if self.cfg.prefill_chunk > 0 else
                       {b for b in self.cfg.prefill_buckets
@@ -653,7 +924,11 @@ class LLMEngine:
             for rid in warm:
                 for _ in self.stream(rid):
                     pass
-            self._prefixes.pop(scratch, None)
+            if self._paged:
+                self._run_on_loop(
+                    lambda: self._unregister_prefix_paged(scratch))
+            else:
+                self._prefixes.pop(scratch, None)
             self.stats["prefix_tokens_saved"] = 0   # dummy adoptions
 
     def generate_sync(self, prompt_ids, max_new_tokens=None,
@@ -671,6 +946,16 @@ class LLMEngine:
                    "waiting": self._waiting.qsize(),
                    "prefilling": len(self._prefilling),
                    "free_slots": len(self._free_slots)}
+            if self._paged:
+                pinned = sum(len(p) for p in self._prefix_pages.values())
+                out["kv_pages"] = {
+                    "page_size": self.cfg.kv_page_size,
+                    "total": self._n_pages,
+                    "free": len(self._free_pages),
+                    "in_use": self._n_pages - len(self._free_pages),
+                    "pinned_prefix": pinned,
+                    "peak_in_use": self._page_hwm,
+                }
             samples = list(self._ttft_samples)
         if samples:
             def p50(key):
@@ -722,6 +1007,69 @@ class LLMEngine:
             return False
         return n > self.cfg.prefill_chunk or n > self._largest_bucket()
 
+    def _admit_paged(self, req: _Request) -> str:
+        """Paged admission: reserve pages + a slot. Returns "ok",
+        "nopages" (hold the request), or "failed" (stream errored).
+        Prefix-carrying requests share the prefix's full pages by
+        page-table reference and copy only its partial last page."""
+        jnp = self._jnp
+        ps = self.cfg.kv_page_size
+        need_total = self._pages_needed(req)
+        # Unservable guard: pinned prefix pages never return to the
+        # pool, so a request needing more than (total - pinned [- shared
+        # pages it adopts]) could park in _pending_head FOREVER and
+        # head-of-line-block every later request. Error it instead —
+        # submit()'s static check can't see pins made after submit.
+        pinned = sum(len(p) for p in self._prefix_pages.values())
+        n_shared_adopt = (int(self._prefixes[req.prefix_id].size) // ps
+                          if req.prefix_id >= 0 else 0)
+        if need_total - n_shared_adopt > self._n_pages - pinned:
+            req.out_queue.put(("error", ValueError(
+                f"request needs {need_total - n_shared_adopt} exclusive "
+                f"KV pages but only {self._n_pages - pinned} can ever "
+                f"be free ({pinned} pinned by prefixes)")))
+            req.out_queue.put(_END)
+            return "failed"
+        if req.prefix_id >= 0:
+            prefix_pages = self._prefix_pages[req.prefix_id]
+            plen = int(self._prefixes[req.prefix_id].size)
+            n_shared = plen // ps
+            excl = self._alloc_pages(need_total - n_shared)
+            if excl is None:
+                return "nopages"
+            slot = self._free_slots.pop()
+            req.slot = slot
+            req.admit_ts = time.time()
+            if plen % ps:
+                try:
+                    self._pools = self._copy_page_jit(
+                        self._pools, jnp.int32(prefix_pages[n_shared]),
+                        jnp.int32(excl[0]))
+                except BaseException as e:  # noqa: BLE001
+                    self._free_pages.extend(excl)
+                    self._free_slots.append(slot)
+                    req.slot = -1
+                    req.out_queue.put(("error", e))
+                    req.out_queue.put(_END)
+                    return "failed"
+            all_pages = prefix_pages[:n_shared] + excl
+            self._slot_pages[slot] = (n_shared, all_pages)
+            self._set_page_row(slot, all_pages)
+            self._lengths = self._lengths.at[slot].set(plen)
+            req.prefill_pos = plen
+            self.stats["prefix_tokens_saved"] = (
+                self.stats.get("prefix_tokens_saved", 0) + plen)
+            return "ok"
+        pages = self._alloc_pages(need_total)
+        if pages is None:
+            return "nopages"
+        slot = self._free_slots.pop()
+        req.slot = slot
+        req.admit_ts = time.time()
+        self._slot_pages[slot] = (0, pages)
+        self._set_page_row(slot, pages)
+        return "ok"
+
     def _admit_all(self, inflight) -> None:
         """Dispatch prefills for every waiting request that can get a
         slot — back to back, NO host syncs. Requests sharing a length
@@ -730,14 +1078,33 @@ class LLMEngine:
         steps, preserving per-request emission order."""
         taken: List[tuple] = []
         while self._free_slots:
-            try:
-                req = self._waiting.get_nowait()
-            except queue_mod.Empty:
-                break
+            if self._paged and self._pending_head is not None:
+                req, self._pending_head = self._pending_head, None
+            else:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue_mod.Empty:
+                    break
             if req.aborted:
                 # cancelled before admission: abort() already unblocked
                 # the consumer; never take a slot or prefill
                 self._requests.pop(req.request_id, None)
+                continue
+            if self._paged:
+                outcome = self._admit_paged(req)
+                if outcome == "nopages":
+                    # hold the head request (FIFO — Queue has no
+                    # push-front) until releases replenish the pool
+                    self._pending_head = req
+                    break
+                if outcome == "failed":
+                    continue
+                if req.prefix_id >= 0 or self._use_chunked(
+                        req.prompt.size):
+                    self._prefilling.append(req)
+                else:
+                    taken.append((self._bucket(req.prompt.size), req,
+                                  req.slot))
                 continue
             slot = self._free_slots.pop()
             req.slot = slot
@@ -791,7 +1158,34 @@ class LLMEngine:
         t_dispatch = time.time()
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
-            if g_real == 1 and self.cfg.max_prefill_batch <= 1:
+            if self._paged:
+                # unified single/batched paged prefill: pad group size
+                # to a power of two; padding rows hit the scratch slot
+                # whose page row is all-trash
+                g = 1
+                while g < g_real:
+                    g *= 2
+                tokens = np.zeros((g, pad_len), np.int32)
+                slots = np.full((g,), self._scratch_slot, np.int32)
+                lens = np.ones((g,), np.int32)
+                temps = np.zeros((g,), np.float32)
+                top_ps = np.ones((g,), np.float32)
+                for i, (req, slot) in enumerate(members):
+                    tokens[i, :req.prompt.size] = req.prompt
+                    slots[i] = slot
+                    lens[i] = req.prompt.size
+                    temps[i] = req.temperature
+                    top_ps[i] = req.top_p
+                toks_dev, lps_dev, self._pools, self._lengths = \
+                    self._prefill_paged_jit(
+                        self.params, self._pools, self._page_table,
+                        self._lengths, jnp.asarray(tokens),
+                        jnp.asarray(slots), jnp.asarray(lens),
+                        jnp.asarray(temps), jnp.asarray(top_ps), sub,
+                        pad_len=pad_len)
+                toks_dev = toks_dev[:g_real]
+                lps_dev = lps_dev[:g_real]
+            elif g_real == 1 and self.cfg.max_prefill_batch <= 1:
                 req, slot = members[0]
                 tokens = np.zeros((1, pad_len), np.int32)
                 tokens[0, :req.prompt.size] = req.prompt
@@ -829,6 +1223,7 @@ class LLMEngine:
                 toks_dev)
         except BaseException as e:  # noqa: BLE001
             for req, slot in members:
+                self._free_slot_pages(slot)
                 self._free_slots.append(slot)
                 req.slot = -1
                 req.out_queue.put(("error", e))
@@ -868,13 +1263,27 @@ class LLMEngine:
         t_dispatch = time.time()
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
-            tok_dev, lp_dev, self._cache = self._prefill_chunk_jit(
-                self.params, self._cache, jnp.asarray(tokens),
-                jnp.int32(req.slot), jnp.int32(start),
-                jnp.int32(start + true), jnp.float32(req.temperature),
-                jnp.float32(req.top_p), sub, chunk=C, sample=is_last)
+            if self._paged:
+                tok_dev, lp_dev, self._pools, self._lengths = \
+                    self._chunk_paged_jit(
+                        self.params, self._pools, self._page_table,
+                        self._lengths, jnp.asarray(tokens),
+                        jnp.int32(req.slot), jnp.int32(start),
+                        jnp.int32(start + true),
+                        jnp.float32(req.temperature),
+                        jnp.float32(req.top_p), sub, chunk=C,
+                        sample=is_last)
+            else:
+                tok_dev, lp_dev, self._cache = self._prefill_chunk_jit(
+                    self.params, self._cache, jnp.asarray(tokens),
+                    jnp.int32(req.slot), jnp.int32(start),
+                    jnp.int32(start + true),
+                    jnp.float32(req.temperature),
+                    jnp.float32(req.top_p), sub, chunk=C,
+                    sample=is_last)
         except BaseException as e:  # noqa: BLE001
             self._prefilling.popleft()
+            self._free_slot_pages(req.slot)
             self._free_slots.append(req.slot)
             req.slot = -1
             req.out_queue.put(("error", e))
@@ -923,9 +1332,46 @@ class LLMEngine:
                 or tok in req.stop_ids):
             req.max_new_tokens = req.generated  # finish after EOS/stop
 
+    # ---- page allocator (host side) ---------------------------------------
+    def _pages_needed(self, req: _Request) -> int:
+        """Whole pages reserved at admission: prompt + generation budget.
+        Full reservation means decode can never hit page exhaustion
+        mid-stream (no preemption machinery needed)."""
+        ps = self.cfg.kv_page_size
+        return -(-(req.prompt.size + req.max_new_tokens) // ps)
+
+    def _alloc_pages(self, n: int) -> "Optional[List[int]]":
+        if len(self._free_pages) < n:
+            return None
+        pages = [self._free_pages.pop() for _ in range(n)]
+        in_use = self._n_pages - len(self._free_pages)
+        self._page_hwm = max(self._page_hwm, in_use)
+        return pages
+
+    def _set_page_row(self, slot: int, pages: "List[int]") -> None:
+        """Write a slot's page-table row (unused entries -> trash)."""
+        row = np.full((self._pages_per_slot,), self._trash_page, np.int32)
+        row[:len(pages)] = pages
+        self._page_table = self._page_table.at[slot].set(
+            self._jnp.asarray(row))
+
+    def _free_slot_pages(self, slot: int) -> None:
+        """Return the slot's exclusive pages to the pool (shared prefix
+        pages stay pinned) and point its row at the trash page so lagged
+        decode writes can't corrupt a reused page."""
+        if not self._paged:
+            return
+        entry = self._slot_pages.pop(slot, None)
+        if entry is None:
+            return
+        n_shared, pages = entry
+        self._free_pages.extend(pages[n_shared:])
+        self._set_page_row(slot, [])
+
     def _release(self, req: _Request):
         req.out_queue.put(_END)
         if req.slot >= 0:
+            self._free_slot_pages(req.slot)
             self._free_slots.append(req.slot)
             self._active.pop(req.slot, None)
             self._mask_dirty = True
@@ -1013,6 +1459,18 @@ class LLMEngine:
         inflight = collections.deque()
         while not self._shutdown.is_set():
             try:
+                while True:
+                    # control commands (paged prefix registration) run
+                    # HERE so pool mutations never race a donated buffer
+                    try:
+                        fn, done = self._control_q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    try:
+                        fn()
+                        done.set_result(None)
+                    except BaseException as e:  # noqa: BLE001
+                        done.set_exception(e)
                 self._admit_all(inflight)
                 if self._prefilling:
                     self._dispatch_chunk(inflight)
@@ -1021,7 +1479,23 @@ class LLMEngine:
                     self._rng_key, sub = self._jax.random.split(
                         self._rng_key)
                     snapshot = list(self._active.items())
-                    if self._decode_block_jit is not None:
+                    if self._paged:
+                        if self._decode_block_paged_jit is not None:
+                            toks, logps, self._pools, self._lengths, \
+                                last = self._decode_block_paged_jit(
+                                    self.params, self._pools,
+                                    self._page_table, self._lengths,
+                                    self._last_tokens, mask, temps,
+                                    top_ps, sub)
+                        else:
+                            toks, logps, self._pools, self._lengths = \
+                                self._decode_paged_jit(
+                                    self.params, self._pools,
+                                    self._page_table, self._lengths,
+                                    self._last_tokens, mask, temps,
+                                    top_ps, sub)
+                            last = toks
+                    elif self._decode_block_jit is not None:
                         toks, logps, self._cache, last = \
                             self._decode_block_jit(
                                 self.params, self._cache,
