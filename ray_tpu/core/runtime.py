@@ -149,12 +149,19 @@ class Waiter:
     _ids = iter(range(1, 1 << 62))
 
     def __init__(self, oids: List[str], num_returns: Optional[int],
-                 callback: Callable[[Dict[str, Tuple[str, Any]], List[str]], None]):
+                 callback: Callable[[Dict[str, Tuple[str, Any]], List[str]], None],
+                 needs_bytes: bool = True):
         self.waiter_id = next(Waiter._ids)
         self.oids = oids
         self.num_returns = len(oids) if num_returns is None else num_returns
         self.callback = callback
         self.done = False
+        # get-style waiters need the PAYLOAD (a device-resident object
+        # must materialize first); wait-style waiters only need
+        # readiness — a device loc counts as ready and must NOT trigger
+        # a D2H materialization (that would also destroy the device-
+        # locality scheduling the object exists for)
+        self.needs_bytes = needs_bytes
 
 
 class PlacementGroupState:
@@ -275,6 +282,9 @@ class DriverRuntime:
         # finished non-actor task specs for lineage reconstruction
         # (insertion-ordered; bounded)
         self._lineage_specs: Dict[str, TaskSpec] = {}
+        # device-resident objects with an in-flight materialize request
+        # (core/device_store.py); cleared when the holder's re-seal lands
+        self._materializing: set = set()
         self._wid_counter = 0
         self._shutdown = threading.Event()
         self._conn_by_wid: Dict[str, Connection] = {}
@@ -471,6 +481,23 @@ class DriverRuntime:
             self._on_actor_exit(m[1])
         elif mtype == "put":
             self._seal(m[1], m[2])
+        elif mtype == "materialized":
+            oid, loc = m[1], m[2]
+            self._materializing.discard(oid)
+            if oid in self.gcs.objects:
+                self._seal(oid, loc)
+            else:
+                # freed while the holder was serializing: reclaim the
+                # fresh shm copy instead of resurrecting a ghost entry
+                if loc.kind in ("shm", "native") and \
+                        (loc.node_id or self.node_id) == self.node_id:
+                    self.store.delete_segment(loc.name, loc.size)
+        elif mtype == "materialize_failed":
+            e = self.gcs.objects.get(m[1])
+            self._materializing.discard(m[1])
+            if e is not None and e.state == "ready" \
+                    and getattr(e.loc, "kind", None) == "device":
+                self._device_object_lost(m[1], e)
         elif mtype == "submit":
             self._register_task(m[1])
         elif mtype == "submit_actor":
@@ -690,6 +717,7 @@ class DriverRuntime:
     # ---------------- objects ----------------
     def _seal(self, oid: str, loc) -> None:
         e = self.gcs.seal_object(oid, loc)
+        self._materializing.discard(oid)
         self._spill.on_seal(oid, e.loc)
         self._notify_object(oid)
 
@@ -898,10 +926,72 @@ class DriverRuntime:
             w = self.waiters.get(waiter_id)
             if w and not w.done:
                 self._check_waiter(w)
+                if not w.done and not self._object_settled(
+                        oid, w.needs_bytes):
+                    # still unsettled for this waiter — e.g. the seal
+                    # carried a DEVICE location and the bytes only land
+                    # with the holder's materialize re-seal: stay
+                    # subscribed or that re-seal would notify nobody
+                    self.object_waiters.setdefault(oid, []).append(
+                        waiter_id)
 
-    def _object_settled(self, oid: str) -> bool:
+    def _object_settled(self, oid: str, needs_bytes: bool = True) -> bool:
         e = self.gcs.objects.get(oid)
-        return e is not None and e.state in ("ready", "error")
+        if e is None:
+            return False
+        if (needs_bytes and e.state == "ready"
+                and getattr(e.loc, "kind", None) == "device"):
+            # the waiter needs BYTES but the value lives device-resident
+            # in its producing worker (core/device_store.py): ask the
+            # holder to materialize; the re-seal settles the waiter.
+            # (Same-worker consumers never reach here — they hit the
+            # worker-local table before sending a get_request.)
+            self._request_materialize(oid, e)
+            return False
+        return e.state in ("ready", "error")
+
+    def _request_materialize(self, oid: str, e) -> None:
+        if oid in self._materializing:
+            return
+        w = self.workers.get(e.loc.name)
+        if w is None or w.state == "dead" or w.conn is None:
+            self._device_object_lost(oid, e)
+            return
+        self._materializing.add(oid)
+        try:
+            w.conn.send(("materialize", oid))
+        except ConnectionClosed:
+            self._materializing.discard(oid)
+            self._device_object_lost(oid, e)
+
+    def _device_object_lost(self, oid: str, e) -> None:
+        """A device-resident object's holder is gone (or refused):
+        re-run the producing task from the lineage log, or fail the
+        object — the single-object analog of _reconstruct_lost_objects."""
+        self._materializing.discard(oid)
+        task_id = e.owner_task
+        spec = self._lineage_specs.get(task_id) if task_id else None
+        if (spec is not None and spec.actor_id is None
+                and not getattr(spec, "streaming", False)
+                # every dep must still exist: a freed dep would leave
+                # the resubmitted task pending forever (_deps_ready
+                # treats a missing entry as not-yet-ready)
+                and all(d in self.gcs.objects
+                        for d in spec.dep_object_ids)):
+            e.state, e.loc, e.error = "pending", None, None
+            te = self.gcs.tasks.get(task_id)
+            if te is not None and te.state != "PENDING":
+                te.state = "PENDING"
+                te.finished_at = None
+                self._respawnable_specs[task_id] = spec
+                self.pending_tasks.append(spec)
+                sys.stderr.write(
+                    f"[ray_tpu] device object {oid} lost its holder; "
+                    f"reconstructing {spec.name} ({task_id})\n")
+        else:
+            self._fail_object(oid, ObjectLostError(
+                f"device-resident object {oid} lost its holding worker "
+                "and its producing task is not re-executable"))
 
     def _add_waiter(self, w: Waiter, timeout: Optional[float] = None):
         self.waiters[w.waiter_id] = w
@@ -909,7 +999,7 @@ class DriverRuntime:
         for oid in w.oids:
             if oid not in self.gcs.objects:
                 self.gcs.add_pending_object(oid)
-            if not self._object_settled(oid):
+            if not self._object_settled(oid, w.needs_bytes):
                 self.object_waiters.setdefault(oid, []).append(w.waiter_id)
                 pending = True
         self._check_waiter(w)
@@ -920,7 +1010,8 @@ class DriverRuntime:
             t.start()
 
     def _check_waiter(self, w: Waiter):
-        settled = [oid for oid in w.oids if self._object_settled(oid)]
+        settled = [oid for oid in w.oids
+                   if self._object_settled(oid, w.needs_bytes)]
         if len(settled) >= w.num_returns:
             self._fire_waiter(w.waiter_id, timed_out=False)
 
@@ -935,6 +1026,9 @@ class DriverRuntime:
             e = self.gcs.objects.get(oid)
             if e is None or e.state == "pending":
                 continue
+            if (w.needs_bytes and e.state == "ready"
+                    and getattr(e.loc, "kind", None) == "device"):
+                continue  # bytes not host-side yet (timed-out fire)
             ready.append(oid)
             if e.state == "ready":
                 results[oid] = ("loc", e.loc)
@@ -1279,7 +1373,16 @@ class DriverRuntime:
             tries, spread = sched_mod.strategy_plan(
                 spec.scheduling_strategy, allowed)
             w = None
-            if spread:
+            if (not spread and hard is None
+                    and spec.placement_group_id is None):
+                # device-object locality: a task consuming a device-
+                # resident dep runs on its holding worker when that
+                # worker is free — the dep is then served from the
+                # in-process table with zero D2H/serialization
+                w = self._device_locality_worker(
+                    spec, need, task_needs_tpu, allowed,
+                    allow_tpu_fallback=not tpu_demand)
+            if w is None and spread:
                 # SPREAD is node-first round-robin: assign the task a
                 # target node once (sticky across scheduling passes —
                 # re-rolling every pass would collapse onto whichever
@@ -1456,6 +1559,41 @@ class DriverRuntime:
             self._spread_rr += 1
             return candidates[self._spread_rr % len(candidates)]
         return candidates[0]
+
+    def _device_locality_worker(self, spec, need, needs_tpu: bool,
+                                allowed_nodes,
+                                allow_tpu_fallback: bool = True
+                                ) -> "Optional[WorkerState]":
+        """The idle worker holding this task's device-resident deps, if
+        eligible — else None (normal placement takes over; the dep then
+        materializes through the shm store on first remote read)."""
+        holder = None
+        for oid in spec.dep_object_ids:
+            e = self.gcs.objects.get(oid)
+            if (e is not None and e.state == "ready"
+                    and getattr(e.loc, "kind", None) == "device"):
+                holder = e.loc.name
+                break
+        if holder is None:
+            return None
+        w = self.workers.get(holder)
+        if w is None or w.state != "idle" or w.conn is None:
+            return None
+        if allowed_nodes and w.node_id not in allowed_nodes:
+            return None
+        node = self.cluster_nodes.get(w.node_id)
+        if node is None or not node.alive:
+            return None
+        if need and not res_mod.fits(node.avail, need):
+            return None
+        if needs_tpu and not w.tpu_capable:
+            return None
+        if (not needs_tpu and w.tpu_capable and not allow_tpu_fallback):
+            # queued TPU demand reserves TPU-capable workers — locality
+            # must not let a CPU consumer starve them (same rule as
+            # _find_idle_worker's allow_tpu_fallback)
+            return None
+        return w
 
     def _find_idle_worker(self, needs_tpu: bool = False,
                           allow_tpu_fallback: bool = True,
@@ -1680,6 +1818,13 @@ class DriverRuntime:
                     for oid in self._return_ids_of(w.current_task):
                         self._fail_object(oid, err)
                     self._gen_settle(w.current_task, err)
+        # device-resident objects held by this worker are gone:
+        # reconstruct from lineage or fail (mirrors node-death handling)
+        for oid, e in list(self.gcs.objects.items()):
+            if (e.state == "ready"
+                    and getattr(e.loc, "kind", None) == "device"
+                    and e.loc.name == wid):
+                self._device_object_lost(oid, e)
         # actor hosted here -> restart or mark dead
         if w.actor_id:
             self._on_actor_worker_dead(w.actor_id, wid)
@@ -1818,7 +1963,7 @@ class DriverRuntime:
                     w.conn.send(("get_reply", rid, ready))
                 except ConnectionClosed:
                     pass
-        waiter = Waiter(oids, num_returns, cb)
+        waiter = Waiter(oids, num_returns, cb, needs_bytes=False)
         self._add_waiter(waiter, timeout=timeout)
 
     # ---------------- control ----------------
@@ -1896,6 +2041,14 @@ class DriverRuntime:
             if e is None or e.loc is None:
                 continue
             for loc in [e.loc, *e.copies]:
+                if loc.kind == "device":
+                    holder = self.workers.get(loc.name)
+                    if holder is not None and holder.conn is not None:
+                        try:
+                            holder.conn.send(("drop_device", oid))
+                        except ConnectionClosed:
+                            pass
+                    continue
                 holder = loc.node_id or self.node_id
                 if holder == self.node_id:
                     if loc.kind in ("shm", "native"):
@@ -2020,7 +2173,8 @@ class DriverRuntime:
             box["ready"] = ready
             ev.set()
 
-        waiter = Waiter([r.id for r in refs], num_returns, cb)
+        waiter = Waiter([r.id for r in refs], num_returns, cb,
+                        needs_bytes=False)
         self.inbox.put(("api_waiter", waiter))
         # emulate timeout by a timer event so the dispatcher fires partial
         if timeout is not None:

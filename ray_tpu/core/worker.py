@@ -90,11 +90,27 @@ class WorkerRuntime:
     # ---- core verbs -------------------------------------------------------
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         oids = [r.id for r in refs]
+        # device-resident fast path: objects THIS worker produced are
+        # served from the in-process table — no driver round-trip, no
+        # D2H, no deserialization (core/device_store.py)
+        from . import device_store  # noqa: PLC0415
+        local = {}
+        for oid in oids:
+            try:
+                local[oid] = device_store.get(oid)
+            except KeyError:
+                pass
+        if len(local) == len(oids):
+            return [local[oid] for oid in oids]
+        remote_oids = [oid for oid in oids if oid not in local]
         rid = self._new_req()
-        self.conn.send(("get_request", rid, oids, timeout))
+        self.conn.send(("get_request", rid, remote_oids, timeout))
         results = self._take_reply(rid, timeout)  # {oid: (kind, payload)}
         out = []
         for oid in oids:
+            if oid in local:
+                out.append(local[oid])
+                continue
             kind, payload = results[oid]
             if kind == "error":
                 raise payload if isinstance(payload, BaseException) else TaskError(str(payload))
@@ -132,9 +148,19 @@ class WorkerRuntime:
         return self.store.get_value(payload)
 
     def put(self, value: Any) -> ObjectRef:
+        from . import device_store  # noqa: PLC0415
+        from .object_store import current_node_id  # noqa: PLC0415
         from .spilling import put_value_or_spill  # noqa: PLC0415
         oid = new_object_id()
-        loc = put_value_or_spill(self.store, oid, value)
+        if device_store.should_keep(value):
+            # jax.Arrays stay device-resident here; the driver pulls a
+            # materialized copy only if a consumer elsewhere needs it
+            device_store.put(oid, value)
+            loc = ObjectLocation(kind="device", size=0,
+                                 name=self.worker_id,
+                                 node_id=current_node_id())
+        else:
+            loc = put_value_or_spill(self.store, oid, value)
         self.conn.send(("put", oid, loc))
         return ObjectRef(oid)
 
@@ -298,24 +324,70 @@ class WorkerLoop:
                                           msg[5])
             elif mtype == "cancel":
                 self._cancelled.add(msg[1])
+            elif mtype == "materialize":
+                self._materialize(msg[1])
+            elif mtype == "drop_device":
+                from . import device_store  # noqa: PLC0415
+                device_store.drop(msg[1])
             elif mtype == "shutdown":
                 self._shutdown.set()
 
     # ---- execution --------------------------------------------------------
     def _seal_returns(self, spec: TaskSpec, result: Any):
-        """Pack return values; small ones ride inline in task_done."""
+        """Pack return values; small ones ride inline in task_done.
+
+        Values holding live jax.Arrays stay DEVICE-RESIDENT in this
+        process (core/device_store.py): the sealed location is a device
+        handle; same-worker consumers read the live value with no D2H,
+        and the driver asks us to materialize only when a consumer
+        elsewhere needs the bytes."""
         n = spec.num_returns
         values = (result,) if n == 1 else tuple(result)
         if n > 1 and len(values) != n:
             raise ValueError(
                 f"task {spec.name} declared num_returns={n} but returned "
                 f"{len(values)} values")
+        from . import device_store  # noqa: PLC0415
+        from .object_store import ObjectLocation, current_node_id  # noqa: PLC0415
         from .spilling import put_value_or_spill  # noqa: PLC0415
         sealed = []
         for oid, val in zip(spec.return_ids, values):
-            loc = put_value_or_spill(self.store, oid, val)
+            if device_store.should_keep(val):
+                device_store.put(oid, val)
+                loc = ObjectLocation(kind="device", size=0,
+                                     name=self.worker_id,
+                                     node_id=current_node_id())
+            else:
+                loc = put_value_or_spill(self.store, oid, val)
             sealed.append((oid, loc))
         return sealed
+
+    def _materialize(self, oid: str) -> None:
+        """Driver asked for a device-resident object's bytes (a consumer
+        is elsewhere): serialize to the shm store and re-seal. Runs on
+        the reader thread (Connection.send is locked; the shm arena is
+        process-shared-mutex guarded), so a long-running task here can't
+        stall a remote consumer."""
+        from . import device_store  # noqa: PLC0415
+        from .spilling import put_value_or_spill  # noqa: PLC0415
+        val = device_store.peek(oid)
+        if val is None:
+            self.conn.send(("materialize_failed", oid,
+                            "not resident on this worker"))
+            return
+        try:
+            loc = put_value_or_spill(self.store, oid, val)
+        except BaseException as e:  # noqa: BLE001
+            self.conn.send(("materialize_failed", oid, repr(e)))
+            return
+        device_store.COUNTERS["materialized"] += 1
+        # the host copy now serves every consumer (local ones included):
+        # drop the device entry so HBM is reclaimed and the table never
+        # pins long-dead values. A distinct message type (not "put")
+        # lets the driver detect an object freed mid-materialize and
+        # reclaim the fresh shm copy instead of resurrecting a ghost.
+        device_store.drop(oid)
+        self.conn.send(("materialized", oid, loc))
 
     def _run_task(self, spec: TaskSpec) -> None:
         if spec.task_id in self._cancelled:

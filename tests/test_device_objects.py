@@ -1,0 +1,184 @@
+"""Device-resident object path (VERDICT r4 missing #2 / next #5).
+
+Task/actor returns containing jax.Arrays stay device-resident in the
+producing worker (core/device_store.py); ObjectRefs carry a device
+handle. Same-worker edges (actor chains, locality-scheduled task chains,
+compiled-DAG stages on one actor) read the live value — zero D2H, zero
+serialization. Only a consumer elsewhere (driver get, another worker)
+triggers materialization through the shm store.
+
+Reference parity: python/ray/experimental/channel/
+shared_memory_channel.py + torch_tensor_nccl_channel.py (accelerated-DAG
+channels).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    handle = ray_tpu.init(num_cpus=4)
+    yield handle
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class JaxActor:
+    """Chain stages on ONE actor — the compiled-DAG actor-reuse shape."""
+
+    def make(self, n):
+        import jax.numpy as jnp
+        return jnp.arange(n, dtype=jnp.float32)
+
+    def double(self, x):
+        return x * 2
+
+    def total(self, x):
+        return float(x.sum())
+
+    def counters(self):
+        from ray_tpu.core import device_store
+        return dict(device_store.COUNTERS)
+
+    def reset_counters(self):
+        from ray_tpu.core import device_store
+        device_store.COUNTERS.update(
+            {"kept_device": 0, "device_hits": 0, "materialized": 0})
+
+
+def test_actor_chain_no_host_roundtrip(rt):
+    """Intermediate edges of an actor-method chain are served from the
+    in-process device table: device_hits == #edges, materialized == 0
+    until the driver reads the final value."""
+    a = JaxActor.remote()
+    a.reset_counters.remote()
+    r1 = a.make.remote(1024)
+    r2 = a.double.remote(r1)      # edge 1: same-worker, no D2H
+    r3 = a.double.remote(r2)      # edge 2: same-worker, no D2H
+    r4 = a.total.remote(r3)       # edge 3 (+ float return: not kept)
+    assert ray_tpu.get(r4) == float(np.arange(1024).sum() * 4)
+    c = ray_tpu.get(a.counters.remote())
+    assert c["kept_device"] == 3       # r1, r2, r3 stayed on device
+    assert c["device_hits"] == 3       # each edge read the live value
+    assert c["materialized"] == 0      # nothing ever crossed to host
+    ray_tpu.kill(a)
+
+
+def test_driver_get_materializes_on_demand(rt):
+    a = JaxActor.remote()
+    a.reset_counters.remote()
+    r1 = a.make.remote(64)
+    got = ray_tpu.get(r1)              # driver needs bytes -> D2H now
+    assert np.asarray(got).tolist() == list(range(64))
+    c = ray_tpu.get(a.counters.remote())
+    assert c["materialized"] == 1
+    # after materialization the host copy is the source of truth (the
+    # device entry was dropped to reclaim HBM); consumers still work
+    assert ray_tpu.get(a.total.remote(r1)) == float(sum(range(64)))
+    ray_tpu.kill(a)
+
+
+def test_wait_reports_ready_without_materializing(rt):
+    """ray_tpu.wait needs READINESS, not bytes: a finished device-
+    resident object is ready, and waiting must not trigger the D2H the
+    feature exists to avoid (nor destroy device locality)."""
+    import time
+    a = JaxActor.remote()
+    a.reset_counters.remote()
+    r1 = a.make.remote(256)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        ready, pending = ray_tpu.wait([r1], timeout=0.2)
+        if ready:
+            break
+    assert ready == [r1]
+    c = ray_tpu.get(a.counters.remote())
+    assert c["materialized"] == 0      # wait() alone caused no D2H
+    # the value is still device-resident for same-worker consumers
+    assert ray_tpu.get(a.total.remote(r1)) == float(sum(range(256)))
+    c = ray_tpu.get(a.counters.remote())
+    assert c["device_hits"] >= 1
+    ray_tpu.kill(a)
+
+
+def test_cross_actor_edge_materializes_and_is_correct(rt):
+    a = JaxActor.remote()
+    b = JaxActor.remote()
+    r1 = a.make.remote(128)
+    out = ray_tpu.get(b.total.remote(r1))   # b lives elsewhere
+    assert out == float(sum(range(128)))
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+
+
+def test_compiled_dag_chain_device_edges(rt):
+    """The compiled-DAG chain the VERDICT asks for: intermediate edges
+    stay device-resident (transfer counters prove no D2H), results
+    unchanged vs eager execution."""
+    from ray_tpu.dag import InputNode
+    actor = JaxActor.bind()
+    with InputNode() as inp:
+        n1 = actor.make.bind(inp)
+        n2 = actor.double.bind(n1)
+        n3 = actor.total.bind(n2)
+    dag = n3.experimental_compile()
+    out = ray_tpu.get(dag.execute(256))
+    assert out == float(np.arange(256).sum() * 2)
+    handle = actor._handle      # materialized at first execute
+    c = ray_tpu.get(handle.counters.remote())
+    assert c["device_hits"] == 2       # make->double, double->total
+    assert c["materialized"] == 0      # final value is a float (host)
+    # second execute reuses the compiled plan and stays device-resident
+    out2 = ray_tpu.get(dag.execute(8))
+    assert out2 == float(np.arange(8).sum() * 2)
+    ray_tpu.kill(handle)
+
+
+def test_task_chain_locality_prefers_holder_worker(rt):
+    """Plain (stateless) task chains: the scheduler places the consumer
+    on the worker holding its device-resident dep when it's idle, so
+    the edge is a local table hit."""
+
+    @ray_tpu.remote
+    def produce(n):
+        import jax.numpy as jnp
+        return jnp.ones((n,), jnp.float32)
+
+    @ray_tpu.remote
+    def consume(x):
+        from ray_tpu.core import device_store
+        return float(x.sum()), device_store.COUNTERS["device_hits"]
+
+    total, hits = ray_tpu.get(consume.remote(produce.remote(512)))
+    assert total == 512.0
+    assert hits >= 1, "consumer did not read the dep from the device table"
+
+
+@ray_tpu.remote
+class TableProbe:
+    def resident(self, oid):
+        from ray_tpu.core import device_store
+        return device_store.contains(oid)
+
+    def make(self, n):
+        import jax.numpy as jnp
+        return jnp.arange(n, dtype=jnp.float32)
+
+
+def test_free_drops_device_entry(rt):
+    """free() on a device-resident ref tells the holder to drop the
+    live value — device memory is reclaimed, not leaked."""
+    import time
+    a = TableProbe.remote()
+    r1 = a.make.remote(32)
+    assert ray_tpu.get(a.resident.remote(r1.id)) is True
+    ray_tpu.free([r1])
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if ray_tpu.get(a.resident.remote(r1.id)) is False:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.get(a.resident.remote(r1.id)) is False
+    ray_tpu.kill(a)
