@@ -36,19 +36,18 @@ from __future__ import annotations
 import os
 import sys
 import threading
-from collections import OrderedDict
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 # kept-resident returns / local-table dep reads / D2H serializations
 COUNTERS = {"kept_device": 0, "device_hits": 0, "materialized": 0}
 
-_TABLE: "OrderedDict[str, Any]" = OrderedDict()
+_TABLE: Dict[str, Any] = {}
 _LOCK = threading.Lock()
 
 # Bound the number of live device values a worker pins (each holds HBM
-# until consumed/freed); beyond this the OLDEST is dropped from the
-# table after materializing would lose it — so overflow instead refuses
-# residency for the NEW value (caller serializes it normally).
+# until consumed/freed/materialized). A full table does NOT evict —
+# new values simply refuse residency and serialize through the normal
+# shm path until frees/materializations make room.
 MAX_ENTRIES = int(os.environ.get("RAY_TPU_DEVICE_OBJECTS_MAX", "256"))
 
 
@@ -79,6 +78,19 @@ def put(oid: str, value: Any) -> None:
     with _LOCK:
         _TABLE[oid] = value
     COUNTERS["kept_device"] += 1
+
+
+def try_keep(store, worker_id: str, oid: str, value: Any):
+    """The ONE seal-or-keep decision shared by task returns and
+    worker-side api.put: keep device-resident when policy allows,
+    else serialize into the shm store. Returns the ObjectLocation."""
+    from .object_store import ObjectLocation, current_node_id  # noqa: PLC0415
+    from .spilling import put_value_or_spill  # noqa: PLC0415
+    if should_keep(value):
+        put(oid, value)
+        return ObjectLocation(kind="device", size=0, name=worker_id,
+                              node_id=current_node_id())
+    return put_value_or_spill(store, oid, value)
 
 
 def get(oid: str) -> Any:

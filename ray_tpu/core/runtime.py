@@ -493,11 +493,17 @@ class DriverRuntime:
                         (loc.node_id or self.node_id) == self.node_id:
                     self.store.delete_segment(loc.name, loc.size)
         elif mtype == "materialize_failed":
+            # The holder is ALIVE but the value won't serialize (e.g. an
+            # unpicklable leaf next to the jax arrays). Reconstruction
+            # would re-produce the same unserializable value forever —
+            # surface the error to the waiters instead.
             e = self.gcs.objects.get(m[1])
             self._materializing.discard(m[1])
             if e is not None and e.state == "ready" \
                     and getattr(e.loc, "kind", None) == "device":
-                self._device_object_lost(m[1], e)
+                self._fail_object(m[1], ObjectLostError(
+                    f"device-resident object {m[1]} failed to "
+                    f"materialize: {m[2]}"))
         elif mtype == "submit":
             self._register_task(m[1])
         elif mtype == "submit_actor":

@@ -149,18 +149,11 @@ class WorkerRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         from . import device_store  # noqa: PLC0415
-        from .object_store import current_node_id  # noqa: PLC0415
-        from .spilling import put_value_or_spill  # noqa: PLC0415
         oid = new_object_id()
-        if device_store.should_keep(value):
-            # jax.Arrays stay device-resident here; the driver pulls a
-            # materialized copy only if a consumer elsewhere needs it
-            device_store.put(oid, value)
-            loc = ObjectLocation(kind="device", size=0,
-                                 name=self.worker_id,
-                                 node_id=current_node_id())
-        else:
-            loc = put_value_or_spill(self.store, oid, value)
+        # jax.Arrays stay device-resident here; the driver pulls a
+        # materialized copy only if a consumer elsewhere needs it
+        loc = device_store.try_keep(self.store, self.worker_id, oid,
+                                    value)
         self.conn.send(("put", oid, loc))
         return ObjectRef(oid)
 
@@ -348,18 +341,10 @@ class WorkerLoop:
                 f"task {spec.name} declared num_returns={n} but returned "
                 f"{len(values)} values")
         from . import device_store  # noqa: PLC0415
-        from .object_store import ObjectLocation, current_node_id  # noqa: PLC0415
-        from .spilling import put_value_or_spill  # noqa: PLC0415
         sealed = []
         for oid, val in zip(spec.return_ids, values):
-            if device_store.should_keep(val):
-                device_store.put(oid, val)
-                loc = ObjectLocation(kind="device", size=0,
-                                     name=self.worker_id,
-                                     node_id=current_node_id())
-            else:
-                loc = put_value_or_spill(self.store, oid, val)
-            sealed.append((oid, loc))
+            sealed.append((oid, device_store.try_keep(
+                self.store, self.worker_id, oid, val)))
         return sealed
 
     def _materialize(self, oid: str) -> None:

@@ -12,6 +12,7 @@ import importlib
 
 from .api import (run, start, status, delete, shutdown, get_app_handle,
                   get_deployment_handle)
+from .asgi import ingress
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .deployment import Application, Deployment, deployment_decorator
@@ -32,7 +33,7 @@ def __getattr__(name):
 
 __all__ = [
     "run", "start", "status", "delete", "shutdown", "get_app_handle",
-    "get_deployment_handle", "batch", "AutoscalingConfig",
+    "get_deployment_handle", "ingress", "batch", "AutoscalingConfig",
     "DeploymentConfig", "HTTPOptions", "Application", "Deployment",
     "deployment", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "BackPressureError",

@@ -36,11 +36,12 @@ def refresh_routes_forever(fetch: Callable, apply: Callable,
 def rebuild_handles(old: Dict[str, DeploymentHandle],
                     wanted: Dict[str, tuple]
                     ) -> Dict[str, DeploymentHandle]:
-    """wanted: key -> (app_name, deployment_name). Reuses existing
-    handles whose target is unchanged; builds fresh ones only for
-    added/retargeted keys."""
+    """wanted: key -> (app_name, deployment_name[, extra...]). Reuses
+    existing handles whose target is unchanged; builds fresh ones only
+    for added/retargeted keys."""
     new = {}
-    for key, (app, dep) in wanted.items():
+    for key, target in wanted.items():
+        app, dep = target[0], target[1]
         cur = old.get(key)
         if (cur is not None and cur._deployment == dep
                 and cur._app == app):

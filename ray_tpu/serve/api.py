@@ -70,6 +70,7 @@ def _flatten_app(app: Application, app_name: str,
             "version": d.version_hash(),
             "route_prefix": d.route_prefix if is_ingress else None,
             "is_ingress": is_ingress,
+            "is_asgi": d.is_asgi,
         }
     return DeploymentHandle(d.name, app_name)
 
@@ -149,9 +150,9 @@ def get_app_handle(name: str = _DEFAULT_APP) -> DeploymentHandle:
     import ray_tpu
     ctrl = _get_or_start_controller()
     routes = ray_tpu.get(ctrl.get_routes.remote())
-    for _prefix, (app, dep) in routes.items():
-        if app == name:
-            return DeploymentHandle(dep, app)
+    for _prefix, target in routes.items():
+        if target[0] == name:
+            return DeploymentHandle(target[1], target[0])
     apps = ray_tpu.get(ctrl.list_applications.remote())
     if name in apps and apps[name]:
         return DeploymentHandle(apps[name][0], name)

@@ -21,7 +21,8 @@ _LOOP_PERIOD_S = 0.25
 class _DeploymentState:
     def __init__(self, app_name: str, name: str, callable_bytes: bytes,
                  init_args, init_kwargs, config: DeploymentConfig,
-                 version: str, route_prefix: Optional[str], is_ingress: bool):
+                 version: str, route_prefix: Optional[str],
+                 is_ingress: bool, is_asgi: bool = False):
         self.app_name = app_name
         self.name = name
         self.callable_bytes = callable_bytes
@@ -31,6 +32,7 @@ class _DeploymentState:
         self.version = version
         self.route_prefix = route_prefix
         self.is_ingress = is_ingress
+        self.is_asgi = is_asgi
         self.replicas: List[ReplicaInfo] = []
         self.target_num: int = self._initial_target()
         self._replica_seq = 0
@@ -81,7 +83,8 @@ class ServeController:
                     self._deployments[key] = _DeploymentState(
                         app_name, d["name"], d["callable_bytes"],
                         d["init_args"], d["init_kwargs"], cfg, d["version"],
-                        d.get("route_prefix"), d.get("is_ingress", False))
+                        d.get("route_prefix"), d.get("is_ingress", False),
+                        d.get("is_asgi", False))
                 else:
                     existing.callable_bytes = d["callable_bytes"]
                     existing.init_args = d["init_args"]
@@ -89,6 +92,7 @@ class ServeController:
                     existing.config = cfg
                     existing.route_prefix = d.get("route_prefix")
                     existing.is_ingress = d.get("is_ingress", False)
+                    existing.is_asgi = d.get("is_asgi", False)
                     if existing.version != d["version"]:
                         existing.version = d["version"]
                         existing.status = "UPDATING"
@@ -161,12 +165,13 @@ class ServeController:
         return dict(self._http_options)
 
     def get_routes(self) -> Dict[str, tuple]:
-        """route_prefix -> (app_name, ingress deployment name)."""
+        """route_prefix -> (app_name, ingress name, is_asgi)."""
         with self._lock:
             routes = {}
             for key, st in self._deployments.items():
                 if st.is_ingress and st.route_prefix is not None:
-                    routes[st.route_prefix] = (st.app_name, st.name)
+                    routes[st.route_prefix] = (st.app_name, st.name,
+                                               st.is_asgi)
             return routes
 
     def get_ingress_targets(self) -> Dict[str, str]:

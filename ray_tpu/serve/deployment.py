@@ -52,6 +52,13 @@ class Deployment:
     def route_prefix(self) -> Optional[str]:
         return self._route_prefix
 
+    @property
+    def is_asgi(self) -> bool:
+        """True when the callable was wrapped by serve.ingress(app) —
+        the HTTP proxy then ships raw requests instead of JSON bodies."""
+        from .asgi import ASGI_ATTR  # noqa: PLC0415
+        return bool(getattr(self._target, ASGI_ATTR, False))
+
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[Any] = None,
                 max_ongoing_requests: Optional[int] = None,

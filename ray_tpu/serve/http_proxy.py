@@ -25,6 +25,7 @@ class HTTPProxy:
         self._host = host
         self._port = port
         self._routes = {}           # prefix -> DeploymentHandle
+        self._asgi = {}             # prefix -> bool (serve.ingress app)
         self._routes_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         proxy = self
@@ -36,17 +37,18 @@ class HTTPProxy:
                 pass
 
             def _match(self):
+                """(handle, prefix, is_asgi) for the longest prefix."""
                 with proxy._routes_lock:
                     routes = dict(proxy._routes)
+                    asgi = dict(proxy._asgi)
                 path = self.path.split("?", 1)[0]
-                best = None
                 for prefix in sorted(routes, key=len, reverse=True):
                     norm = prefix.rstrip("/") or "/"
                     if path == norm or path.startswith(
                             norm if norm == "/" else norm + "/"):
-                        best = routes[prefix]
-                        break
-                return best
+                        return (routes[prefix], norm,
+                                asgi.get(prefix, False))
+                return None, None, False
 
             def _body(self):
                 n = int(self.headers.get("Content-Length") or 0)
@@ -71,11 +73,83 @@ class HTTPProxy:
                     return result, "text/plain"
                 return json.dumps(result), "application/json"
 
+            def _handle_asgi(self, handle, prefix):
+                """serve.ingress(app) route: ship the RAW request to the
+                replica (the ASGI wrapper drives the app there) and
+                relay its streamed response — start item first, then
+                body chunks — as a chunked HTTP response. SSE and plain
+                responses flow through the same path."""
+                path = self.path.split("?", 1)[0]
+                query = (self.path.split("?", 1)[1]
+                         if "?" in self.path else "")
+                n = int(self.headers.get("Content-Length") or 0)
+                request = {
+                    "method": self.command,
+                    "path": path,
+                    "query": query,
+                    "root_path": "" if prefix == "/" else prefix,
+                    "headers": list(self.headers.items()),
+                    "body": self.rfile.read(n) if n else b"",
+                }
+                headers_sent = False
+                bodiless = False   # 1xx/204/304: no body, no chunking
+                gen = None
+                try:
+                    gen = handle.options(stream=True).remote(request)
+                    for item in gen:
+                        if isinstance(item, dict) and item.get(
+                                "__asgi_start__"):
+                            status = item["status"]
+                            bodiless = (status in (204, 304)
+                                        or 100 <= status < 200)
+                            self.send_response(status)
+                            for k, v in item["headers"]:
+                                if k.lower() in ("content-length",
+                                                 "transfer-encoding"):
+                                    continue  # we re-frame as chunked
+                                self.send_header(k, v)
+                            if not bodiless:
+                                self.send_header("Transfer-Encoding",
+                                                 "chunked")
+                            self.end_headers()
+                            headers_sent = True
+                            continue
+                        if bodiless:
+                            continue  # RFC: such responses have no body
+                        chunk = (item if isinstance(item, bytes)
+                                 else bytes(item))
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode()
+                                         + chunk + b"\r\n")
+                        self.wfile.flush()
+                    if not headers_sent:
+                        raise RuntimeError("ASGI app sent no response")
+                    if not bodiless:
+                        self.wfile.write(b"0\r\n\r\n")
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        if headers_sent:
+                            # mid-stream failure: closing WITHOUT the
+                            # chunked terminator signals truncation —
+                            # a clean terminator would make the partial
+                            # body indistinguishable from success
+                            self.close_connection = True
+                        else:
+                            self._respond(500, json.dumps(
+                                {"error": repr(e)}))
+                    except Exception:  # noqa: BLE001  client went away
+                        pass
+                finally:
+                    if gen is not None:
+                        gen.close()
+
             def _handle(self):
-                handle = self._match()
+                handle, prefix, is_asgi = self._match()
                 if handle is None:
                     self._respond(404, json.dumps(
                         {"error": f"no route for {self.path}"}))
+                    return
+                if is_asgi:
+                    self._handle_asgi(handle, prefix)
                     return
                 try:
                     body = self._body()
@@ -154,6 +228,8 @@ class HTTPProxy:
         def apply(routes):
             with self._routes_lock:
                 self._routes = rebuild_handles(self._routes, routes)
+                self._asgi = {k: bool(len(v) > 2 and v[2])
+                              for k, v in routes.items()}
 
         refresh_routes_forever(lambda ctrl: ctrl.get_routes.remote(),
                                apply)

@@ -156,6 +156,23 @@ def test_task_chain_locality_prefers_holder_worker(rt):
     assert hits >= 1, "consumer did not read the dep from the device table"
 
 
+def test_unserializable_device_value_errors_not_loops(rt):
+    """A device-kept value that won't pickle (e.g. a lock next to the
+    arrays) must surface an error on get — not trigger an infinite
+    lineage-reconstruction loop while the caller hangs."""
+    from ray_tpu.exceptions import ObjectLostError
+
+    @ray_tpu.remote
+    def bad():
+        import threading
+        import jax.numpy as jnp
+        return {"x": jnp.ones((4,)), "lock": threading.Lock()}
+
+    ref = bad.remote()
+    with pytest.raises(ObjectLostError, match="failed to materialize"):
+        ray_tpu.get(ref, timeout=30)
+
+
 @ray_tpu.remote
 class TableProbe:
     def resident(self, oid):
