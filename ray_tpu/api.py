@@ -179,8 +179,9 @@ def remote(*args, **kwargs):
                     "max_calls is not supported for actors (reference "
                     "semantics); use max_restarts or actor_exit()")
             allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
-                       "max_concurrency", "name", "namespace", "lifetime",
-                       "runtime_env", "placement_group", "bundle_index",
+                       "max_concurrency", "concurrency_groups", "name",
+                       "namespace", "lifetime", "runtime_env",
+                       "placement_group", "bundle_index",
                        "scheduling_strategy", "get_if_exists")
             return ActorClass(target,
                               **{k: v for k, v in opts.items()
@@ -287,9 +288,11 @@ def method(**opts):
 
     Reference parity: ray.method (python/ray/actor.py) — the declared
     options become the defaults every time the method is invoked through
-    an ActorHandle (still overridable per call with `.options(...)`).
+    an ActorHandle. num_returns is overridable per call with
+    `.options(...)`; concurrency_group is declaration-only (a method
+    belongs to one group for the actor's lifetime).
     """
-    allowed = {"num_returns"}
+    allowed = {"num_returns", "concurrency_group"}
     bad = set(opts) - allowed
     if bad:
         raise ValueError(f"unsupported @method option(s): {sorted(bad)}")

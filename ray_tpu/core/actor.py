@@ -54,7 +54,9 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         opts = self.__dict__.get("_method_opts", {}).get(name, {})
-        return ActorMethod(self, name, **opts)
+        # concurrency_group is applied at TaskSpec build, not here
+        return ActorMethod(self, name,
+                           num_returns=opts.get("num_returns", 1))
 
     def _make_task_spec(self, method_name: str, args, kwargs,
                         num_returns=1):
@@ -75,6 +77,8 @@ class ActorHandle:
             resources={},
             actor_id=self._actor_id,
             method_name=method_name,
+            concurrency_group=(self._method_opts.get(method_name)
+                               or {}).get("concurrency_group"),
             streaming=streaming,
             dep_object_ids=extract_arg_deps(args, kwargs),
         )
@@ -113,16 +117,20 @@ def _collect_method_opts(cls) -> Dict[str, Dict[str, Any]]:
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, max_concurrency=1, name=None,
-                 namespace=None, lifetime=None, runtime_env=None,
+                 max_restarts=0, max_concurrency=1, concurrency_groups=None,
+                 name=None, namespace=None, lifetime=None, runtime_env=None,
                  placement_group=None, bundle_index=-1,
                  scheduling_strategy=None, get_if_exists=False):
         from . import runtime_env as renv_mod
         runtime_env = renv_mod.validate(runtime_env) or None
         self._cls = cls
+        if concurrency_groups and any(
+                n < 1 for n in concurrency_groups.values()):
+            raise ValueError("concurrency_groups limits must be >= 1")
         self._default_opts = dict(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
+            concurrency_groups=dict(concurrency_groups or {}),
             name=name, namespace=namespace, lifetime=lifetime,
             runtime_env=runtime_env, placement_group=placement_group,
             bundle_index=bundle_index,
@@ -179,6 +187,7 @@ class ActorClass:
             resources={} if pg is not None else req,
             max_restarts=opts["max_restarts"] or 0,
             max_concurrency=opts["max_concurrency"] or 1,
+            concurrency_groups=dict(opts.get("concurrency_groups") or {}),
             name=opts["name"],
             namespace=opts["namespace"] or getattr(rt, "namespace", "default"),
             placement_group_id=getattr(pg, "pg_id", None),

@@ -53,6 +53,9 @@ class TaskEntry:
     started_at: float = 0.0
     finished_at: float = 0.0
     retries_left: int = 0
+    # actor-method concurrency group this task dispatched under (None =
+    # the default lane); read back to decrement the right counter
+    concurrency_group: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -270,8 +270,12 @@ class DriverRuntime:
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
         self.actor_queues: Dict[str, collections.deque] = {}
-        self.actor_inflight: Dict[str, int] = {}
         self.actor_max_conc: Dict[str, int] = {}
+        # concurrency groups: per-actor {group: limit} and per
+        # (actor_id, group|None) in-flight counts (None = the default
+        # max_concurrency lane; this map is THE in-flight gate)
+        self.actor_group_conc: Dict[str, Dict[str, int]] = {}
+        self.actor_group_inflight: Dict[tuple, int] = {}
         self.waiters: Dict[int, Waiter] = {}
         self.object_waiters: Dict[str, List[int]] = {}
         self.report_handlers: Dict[str, Callable] = {}
@@ -1087,6 +1091,8 @@ class DriverRuntime:
                 ae.death_cause = f"name {acspec.name!r} already taken"
                 return
         self.actor_max_conc[acspec.actor_id] = acspec.max_concurrency
+        self.actor_group_conc[acspec.actor_id] = dict(
+            getattr(acspec, "concurrency_groups", None) or {})
         self.pending_actors.append(acspec)
 
     # ---------------- scheduling ----------------
@@ -1476,31 +1482,71 @@ class DriverRuntime:
             if w is None or w.conn is None:
                 continue
             maxc = self.actor_max_conc.get(aid, 1)
-            while q and self.actor_inflight.get(aid, 0) < maxc:
-                spec = q[0]
-                dr = self._deps_ready(spec.dep_object_ids)
-                if dr is False:
-                    break
-                q.popleft()
-                if dr is None:
-                    err = TaskError("upstream dependency failed", "", spec.name)
+            group_limits = self.actor_group_conc.get(aid) or {}
+
+            def dispatch(spec, group) -> "Optional[bool]":
+                """Send one spec. True = dispatched, False = consumed
+                without dispatch (failed/cancelled), None = conn died."""
+                if self._deps_ready(spec.dep_object_ids) is None:
+                    err = TaskError("upstream dependency failed", "",
+                                    spec.name)
                     self.gcs.tasks[spec.task_id].state = "FAILED"
                     for oid in spec.return_ids:
                         self._fail_object(oid, err)
                     self._gen_settle(spec.task_id, err)
-                    continue
+                    return False
                 te = self.gcs.tasks[spec.task_id]
                 if te.state == "CANCELLED":
-                    continue
+                    return False
                 try:
                     w.conn.send(("exec_actor_task", spec))
                 except ConnectionClosed:
-                    q.appendleft(spec)
-                    break
-                self.actor_inflight[aid] = self.actor_inflight.get(aid, 0) + 1
+                    return None
+                self.actor_group_inflight[(aid, group)] = \
+                    self.actor_group_inflight.get((aid, group), 0) + 1
+                te.concurrency_group = group
                 te.state, te.worker_id, te.started_at = ("RUNNING",
                                                          w.worker_id,
                                                          time.time())
+                return True
+
+            if not group_limits:
+                # fast path (no concurrency groups): strict-FIFO
+                # popleft, O(1) per dispatch
+                while q and self.actor_group_inflight.get(
+                        (aid, None), 0) < maxc:
+                    dr = self._deps_ready(q[0].dep_object_ids)
+                    if dr is False:
+                        break
+                    if dispatch(q.popleft(), None) is None:
+                        break
+                continue
+            # Group-aware dispatch (reference: python/ray/actor.py
+            # concurrency_groups): each named group has an independent
+            # in-flight limit, so a saturated/dep-blocked group is
+            # skipped while OTHER groups' tasks behind it still run —
+            # a health-check method never starves behind a long call.
+            # One rotation pass of the deque (O(n), no remove scans);
+            # order WITHIN a group stays strictly FIFO (blocked set).
+            blocked: set = set()
+            conn_dead = False
+            for _ in range(len(q)):
+                spec = q.popleft()
+                group = (spec.concurrency_group
+                         if spec.concurrency_group in group_limits
+                         else None)   # None = the default maxc lane
+                limit = group_limits[group] if group else maxc
+                if (conn_dead or group in blocked
+                        or self.actor_group_inflight.get(
+                            (aid, group), 0) >= limit
+                        or self._deps_ready(spec.dep_object_ids)
+                        is False):
+                    blocked.add(group)
+                    q.append(spec)   # rotate to the back, order kept
+                    continue
+                if dispatch(spec, group) is None:
+                    q.append(spec)
+                    conn_dead = True
 
     def _pg_tpu_ids(self, pg_id: Optional[str], bundle_index: int,
                     node_id: str) -> List[int]:
@@ -1746,9 +1792,9 @@ class DriverRuntime:
             while len(self._lineage_specs) > self._LINEAGE_RETAIN:
                 self._lineage_specs.pop(next(iter(self._lineage_specs)))
         if te.actor_id is not None:
-            aid = te.actor_id
-            self.actor_inflight[aid] = max(
-                0, self.actor_inflight.get(aid, 0) - 1)
+            gkey = (te.actor_id, getattr(te, "concurrency_group", None))
+            self.actor_group_inflight[gkey] = max(
+                0, self.actor_group_inflight.get(gkey, 0) - 1)
         elif w is not None:
             res_mod.release(self._wnode_avail(w), w.held_resources)
             self._return_tpu_ids(w)
@@ -1843,7 +1889,8 @@ class DriverRuntime:
                 for oid in self._return_ids_of(task_id):
                     self._fail_object(oid, err)
                 self._gen_settle(task_id, err)
-        self.actor_inflight[aid] = 0
+        for key in [k for k in self.actor_group_inflight if k[0] == aid]:
+            self.actor_group_inflight[key] = 0
 
     def _drain_actor_queue(self, aid: str, cause: str) -> None:
         err = ActorDiedError(f"actor {aid} {cause}")

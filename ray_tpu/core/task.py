@@ -34,6 +34,9 @@ class TaskSpec:
     # actor fields
     actor_id: Optional[str] = None
     method_name: Optional[str] = None
+    # named concurrency group (@ray_tpu.method(concurrency_group=...));
+    # None = the actor's default max_concurrency lane
+    concurrency_group: Optional[str] = None
     # placement
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
@@ -57,6 +60,11 @@ class ActorCreationSpec:
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
     max_restarts: int = 0
     max_concurrency: int = 1
+    # named method groups with INDEPENDENT concurrency limits
+    # (reference: python/ray/actor.py concurrency_groups) — a slow
+    # group can't starve e.g. health-check methods in another group
+    concurrency_groups: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
     name: Optional[str] = None
     namespace: str = "default"
     placement_group_id: Optional[str] = None

@@ -263,6 +263,7 @@ class WorkerLoop:
         self._actor_instance: Any = None
         self._actor_spec: Optional[ActorCreationSpec] = None
         self._actor_pool: Optional[ThreadPoolExecutor] = None
+        self._group_pools: Dict[str, ThreadPoolExecutor] = {}
         self._async_loop = None
         self._cancelled: set = set()
 
@@ -413,10 +414,19 @@ class WorkerLoop:
             self.rt.current_actor_id = acspec.actor_id
             self.rt.current_tpu_ids = list(
                 getattr(acspec, "tpu_ids", []) or [])
-            if acspec.max_concurrency > 1:
+            groups = getattr(acspec, "concurrency_groups", None) or {}
+            if acspec.max_concurrency > 1 or groups:
                 self._actor_pool = ThreadPoolExecutor(
-                    max_workers=acspec.max_concurrency,
+                    max_workers=max(1, acspec.max_concurrency),
                     thread_name_prefix="actor")
+            # one executor lane per named group: a slow sync method in
+            # one group can never occupy another group's threads (the
+            # driver already gates dispatch per-group; the lanes keep
+            # the isolation inside the process too)
+            self._group_pools = {
+                g: ThreadPoolExecutor(max_workers=n,
+                                      thread_name_prefix=f"actor-{g}")
+                for g, n in groups.items()}
             self.conn.send(("actor_created", acspec.actor_id, True, None))
         except BaseException as e:  # noqa: BLE001
             err = TaskError(repr(e), traceback.format_exc(),
@@ -438,10 +448,14 @@ class WorkerLoop:
             import asyncio  # noqa: PLC0415
             asyncio.run_coroutine_threadsafe(
                 self._run_actor_task_async(spec), self._async_loop)
-        elif self._actor_pool is not None:
-            self._actor_pool.submit(self._run_actor_task, spec)
         else:
-            self._run_actor_task(spec)
+            pool = self._group_pools.get(
+                getattr(spec, "concurrency_group", None),
+                self._actor_pool)
+            if pool is not None:
+                pool.submit(self._run_actor_task, spec)
+            else:
+                self._run_actor_task(spec)
 
     def _put_gen_item(self, spec: TaskSpec, item) -> None:
         """Seal one streamed item and announce it to the driver (the

@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .asgi import START_KEY
 from .handle import DeploymentHandle
 
 PROXY_NAME = "_SERVE_PROXY"
@@ -97,8 +98,7 @@ class HTTPProxy:
                 try:
                     gen = handle.options(stream=True).remote(request)
                     for item in gen:
-                        if isinstance(item, dict) and item.get(
-                                "__asgi_start__"):
+                        if isinstance(item, dict) and item.get(START_KEY):
                             status = item["status"]
                             bodiless = (status in (204, 304)
                                         or 100 <= status < 200)

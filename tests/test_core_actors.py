@@ -201,3 +201,72 @@ def test_max_calls_rejected_for_actors():
         @ray_tpu.remote(max_calls=3)
         class Nope:
             pass
+
+
+# ---- concurrency groups (VERDICT r4 missing #4) -----------------------
+
+
+@ray_tpu.remote(concurrency_groups={"control": 2})
+class _GroupedServer:
+    """Reference parity: python/ray/actor.py concurrency_groups — named
+    method groups with independent concurrency limits."""
+
+    def __init__(self):
+        self._order = []
+
+    def slow(self, delay):
+        time.sleep(delay)
+        self._order.append("slow")
+        return "slow-done"
+
+    @ray_tpu.method(concurrency_group="control")
+    def ping(self):
+        self._order.append("ping")
+        return "pong"
+
+    @ray_tpu.method(concurrency_group="control")
+    def order(self):
+        return list(self._order)
+
+
+def test_concurrency_group_not_starved_by_slow_default(rt):
+    """A control-group call submitted BEHIND a long default-lane call
+    returns immediately — before the slow call finishes."""
+    a = _GroupedServer.remote()
+    ray_tpu.get(a.ping.remote())       # actor fully constructed
+    slow_ref = a.slow.remote(4.0)
+    t0 = time.time()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+    ping_latency = time.time() - t0
+    assert ping_latency < 2.0, (
+        f"ping took {ping_latency:.1f}s — starved behind slow()")
+    assert ray_tpu.get(slow_ref, timeout=15) == "slow-done"
+    ray_tpu.kill(a)
+
+
+def test_concurrency_group_limit_is_enforced(rt):
+    """Group limit 2: three control-lane sleeps overlap at most 2-wide,
+    while the default lane stays open."""
+
+    @ray_tpu.remote(concurrency_groups={"control": 2})
+    class S:
+        @ray_tpu.method(concurrency_group="control")
+        def nap(self, d):
+            t0 = time.time()
+            time.sleep(d)
+            return (t0, time.time())
+
+        def quick(self):
+            return "ok"
+
+    s = S.remote()
+    ray_tpu.get(s.quick.remote())
+    refs = [s.nap.remote(0.8) for _ in range(3)]
+    assert ray_tpu.get(s.quick.remote(), timeout=10) == "ok"
+    spans = ray_tpu.get(refs, timeout=20)
+    # at most 2 naps overlap at any instant
+    for probe_start, _ in spans:
+        overlapping = sum(1 for (a0, a1) in spans
+                          if a0 <= probe_start < a1)
+        assert overlapping <= 2
+    ray_tpu.kill(s)
