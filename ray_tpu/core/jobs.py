@@ -177,16 +177,25 @@ class JobSubmissionClient:
 
     def _tail_http(self, submission_id: str) -> Iterator[str]:
         """Stream the dashboard's chunked follow endpoint until EOF."""
+        import codecs
         import urllib.request
         url = (f"{self._address}/api/jobs/{submission_id}/logs"
                f"?follow=1")
+        # incremental decoder: a multi-byte UTF-8 char split across
+        # read1 chunks must not turn into replacement garbage
+        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
         try:
             with urllib.request.urlopen(url, timeout=None) as r:
                 while True:
                     piece = r.read1(65536)
                     if not piece:
+                        tail = dec.decode(b"", final=True)
+                        if tail:
+                            yield tail
                         return
-                    yield piece.decode(errors="replace")
+                    text = dec.decode(piece)
+                    if text:
+                        yield text
         except urllib.error.HTTPError as e:
             raise ValueError(f"tail failed: {e}") from None
 

@@ -56,7 +56,25 @@ class SAC(Algorithm):
         cfg = config
         space = self.local_runner.vec.envs[0].action_space
         self.act_dim = int(np.prod(space.shape))
-        self.act_scale = float(space.high)
+        # per-dimension affine map from tanh's [-1, 1] to [low, high]
+        # (r4 advice: float(space.high) raised on per-dim bounds, and a
+        # symmetric [-s, s] was silently wrong when low != -high)
+        high = np.broadcast_to(np.asarray(space.high, np.float32),
+                               space.shape).reshape(-1)
+        low = np.broadcast_to(np.asarray(space.low, np.float32),
+                              space.shape).reshape(-1)
+        if not (np.all(np.isfinite(high)) and np.all(np.isfinite(low))):
+            raise ValueError(
+                f"SAC needs finite Box bounds; got low={space.low} "
+                f"high={space.high}")
+        if np.any(high <= low):
+            # a zero-width dim would make the log|scale| Jacobian term
+            # -inf and NaN every update — reject loudly instead
+            raise ValueError(
+                f"SAC needs high > low on every action dim; got "
+                f"low={space.low} high={space.high}")
+        self.act_scale = (high - low) / 2.0     # (act_dim,)
+        self.act_offset = (high + low) / 2.0    # (act_dim,)
         obs_dim = self.module.spec.obs_dim
         hidden = tuple(cfg.model["hidden"])
         act = cfg.model["activation"]
@@ -88,22 +106,27 @@ class SAC(Algorithm):
         self._rng_key = jax.random.PRNGKey(cfg.seed + 1)
 
         pi_net, q_net = self.pi_net, self.q_net
-        scale, tgt_h, tau, gamma = (self.act_scale, self.target_entropy,
-                                    cfg.tau, cfg.gamma)
+        scale = jnp.asarray(self.act_scale)
+        offset = jnp.asarray(self.act_offset)
+        tgt_h, tau, gamma = self.target_entropy, cfg.tau, cfg.gamma
 
         def squashed(pi_params, obs, key):
-            """tanh-squashed Gaussian sample with its log-prob."""
+            """tanh-squashed Gaussian sample with its log-prob (in the
+            ENV action space: the affine a*scale+offset Jacobian is
+            part of the change of variables — r4 advice: omitting
+            sum(log scale) shifted alpha's effective entropy target)."""
             out = pi_net.apply({"params": pi_params}, obs)
             mean, log_std = jnp.split(out, 2, axis=-1)
             log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
             std = jnp.exp(log_std)
             pre = mean + std * jax.random.normal(key, mean.shape)
             a = jnp.tanh(pre)
-            # Gaussian logp minus tanh change-of-variables correction
+            # Gaussian logp minus tanh + affine change-of-variables
             logp = (-0.5 * (((pre - mean) / std) ** 2
                             + 2 * log_std + jnp.log(2 * jnp.pi))
-                    - jnp.log(1.0 - a ** 2 + 1e-6)).sum(-1)
-            return a * scale, logp
+                    - jnp.log(1.0 - a ** 2 + 1e-6)).sum(-1) \
+                - jnp.log(scale).sum()
+            return a * scale + offset, logp
 
         def q_val(qp, obs, act):
             x = jnp.concatenate([obs, act], axis=-1)
@@ -166,7 +189,7 @@ class SAC(Algorithm):
         self._mean_action = jax.jit(
             lambda pp, obs: jnp.tanh(jnp.split(
                 pi_net.apply({"params": pp}, obs), 2, axis=-1)[0])
-            * scale)
+            * scale + offset)
 
     # -- rollouts: squashed-Gaussian exploration on the vec env --
     def _collect(self):
@@ -184,7 +207,8 @@ class SAC(Algorithm):
                 # exploration
                 acts = np.random.default_rng(
                     int(k[0]) % (1 << 31)).uniform(
-                    -self.act_scale, self.act_scale,
+                    self.act_offset - self.act_scale,
+                    self.act_offset + self.act_scale,
                     size=(vec.num_envs, self.act_dim)).astype(np.float32)
             else:
                 a, _ = self._sample_action(self.pi_params, obs, k)

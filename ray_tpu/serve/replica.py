@@ -50,6 +50,11 @@ class Replica:
         # the parked _put unblocks) instead of leaking the queue and a
         # permanently-elevated _ongoing count
         self._cancelled_streams: set = set()
+        # stream ids whose drain task is still pumping: stream_cancel
+        # only flags these — flagging a FINISHED drain would leave the
+        # id in _cancelled_streams forever (its finally-discard already
+        # ran), an unbounded leak under abandon-after-completion traffic
+        self._live_drains: set = set()
 
         target = serialization.loads_call(callable_bytes)
         if inspect.isclass(target):
@@ -207,11 +212,18 @@ class Replica:
                 except _StreamCancelled:
                     pass
             finally:
-                self._cancelled_streams.discard(stream_id)
                 with self._lock:
+                    # same lock as stream_cancel's check-then-add: the
+                    # cancel path runs on a threadpool thread while this
+                    # finally runs on the asyncio loop thread — unlocked
+                    # interleaving could add the id AFTER this discard,
+                    # leaking it forever
+                    self._live_drains.discard(stream_id)
+                    self._cancelled_streams.discard(stream_id)
                     self._ongoing -= 1
                     self._total_served += 1
 
+        self._live_drains.add(stream_id)
         asyncio.ensure_future(_drain())
         return stream_id
 
@@ -220,7 +232,12 @@ class Replica:
         drain task and drop the buffer. Idempotent; unknown/finished
         ids are a no-op."""
         if stream_id in self._streams:
-            self._cancelled_streams.add(stream_id)
+            with self._lock:
+                if stream_id in self._live_drains:
+                    # only a still-running drain needs the flag (its
+                    # finally-discard cleans it up); a finished drain
+                    # would never remove it — leak
+                    self._cancelled_streams.add(stream_id)
             self._streams.pop(stream_id, None)
             return True
         return False

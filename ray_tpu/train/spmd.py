@@ -115,18 +115,50 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     lambda p: jnp.zeros(p.shape, jnp.float32),
                     state.params)
 
-                def body(gsum, mb):
+                # Each micro-batch's loss is its own masked mean, so
+                # micro-grads are weighted by TOKEN COUNT (ntokens) and
+                # normalized once by the total — exactly the full-batch
+                # masked mean even when mask counts differ across
+                # micro-batches (r4 advice: equal weighting diverges).
+                # Custom loss_fns without "ntokens" weight uniformly.
+                def body(carry, mb):
+                    gsum, toksum = carry
                     (_l, m), g = _value_and_grad(state.params, mb)
+                    nt = m.get("ntokens", jnp.float32(1.0)) \
+                        if isinstance(m, dict) else jnp.float32(1.0)
+                    nt = jnp.asarray(nt, jnp.float32)
                     gsum = jax.tree_util.tree_map(
-                        lambda a, b: a + b.astype(jnp.float32), gsum, g)
-                    return gsum, m
+                        lambda a, b: a + b.astype(jnp.float32) * nt,
+                        gsum, g)
+                    return (gsum, toksum + nt), m
 
-                gsum, ms = jax.lax.scan(body, zeros, micro)
+                (gsum, toksum), ms = jax.lax.scan(
+                    body, (zeros, jnp.float32(0.0)), micro)
                 grads = jax.tree_util.tree_map(
-                    lambda g, p: (g / accum_steps).astype(p.dtype),
+                    lambda g, p: (g / jnp.maximum(toksum, 1.0)
+                                  ).astype(p.dtype),
                     gsum, state.params)
-                metrics = jax.tree_util.tree_map(
-                    lambda x: jnp.mean(x, axis=0), ms)
+                # metrics: token-weighted means (ntokens itself sums);
+                # ppl recomputed from the aggregated loss
+                nts = ms.get("ntokens") if isinstance(ms, dict) else None
+                w = (nts / jnp.maximum(nts.sum(), 1.0)
+                     if nts is not None
+                     else jnp.full((accum_steps,), 1.0 / accum_steps))
+
+                def wmean(x):
+                    # broadcast w over trailing dims: non-scalar metric
+                    # leaves (e.g. a (C,) per-class vector) stack to
+                    # (accum_steps, C) and need w as (accum_steps, 1)
+                    wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
+                    return (x * wb).sum(axis=0)
+
+                metrics = jax.tree_util.tree_map(wmean, ms)
+                if isinstance(metrics, dict):
+                    if nts is not None:
+                        metrics["ntokens"] = nts.sum()
+                    if "ppl" in metrics and "loss" in metrics:
+                        metrics["ppl"] = jnp.exp(
+                            jnp.minimum(metrics["loss"], 20.0))
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)

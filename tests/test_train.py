@@ -168,6 +168,50 @@ def test_grad_accumulation_matches_full_batch():
     np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
 
 
+def test_grad_accumulation_matches_full_batch_nonuniform_mask():
+    """With a NON-uniform loss_mask, micro-batch grads must be weighted
+    by token count — summing per-micro masked means and dividing by K
+    diverges from the true full-batch step (r4 advice)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import make_train_step, make_optimizer
+
+    cfg = LlamaConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=64,
+                      dtype=jnp.float32)
+    model = Llama(cfg)
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    rng = np.random.RandomState(1)
+    mask = np.zeros((4, 16), np.float32)
+    mask[0, :15] = 1.0   # micro-batch 1 (rows 0-1): 18 tokens
+    mask[1, :3] = 1.0
+    mask[2, :2] = 1.0    # micro-batch 2 (rows 2-3): 3 tokens
+    mask[3, :1] = 1.0
+    batch = {"tokens": jnp.asarray(rng.randint(0, 64, (4, 17)),
+                                   jnp.int32),
+             "loss_mask": jnp.asarray(mask)}
+
+    outs = {}
+    for accum in (1, 2):
+        tx = make_optimizer("adamw", learning_rate=1e-2)
+        init_fn = make_train_step(model, tx, mesh, accum_steps=accum,
+                                  donate_state=False)
+        state, step = init_fn(jax.random.PRNGKey(0), batch)
+        state, m = step(state, batch)
+        leaf = jax.tree_util.tree_leaves(state.params)[0]
+        outs[accum] = (float(m["loss"]), float(m["ntokens"]),
+                       np.asarray(leaf))
+
+    l1, n1, p1 = outs[1]
+    l2, n2, p2 = outs[2]
+    assert n1 == n2 == mask.sum()
+    assert abs(l1 - l2) < 1e-4, (l1, l2)
+    np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
+
+
 def test_adafactor_and_bf16_params_train():
     """adafactor + bf16 param storage: the 1B-on-one-chip recipe in
     miniature — loss decreases, params stay bf16."""
