@@ -174,6 +174,14 @@ class PagedKV:
         self.lengths = lengths
         self.page_size = page_size
 
+    def flat_rows(self, positions):
+        """Flat pool row index for each (sequence, logical position) in
+        `positions` (B, S) — the single definition of the page-indexing
+        formula (debug/introspection/tests)."""
+        ps = self.page_size
+        return (jnp.take_along_axis(self.page_table, positions // ps,
+                                    axis=1) * ps + positions % ps)
+
     def tree_flatten(self):
         return ((self.k_flat, self.v_flat, self.page_table,
                  self.lengths), self.page_size)
@@ -203,14 +211,31 @@ def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     L = n_pages_per_seq * page_size
 
     # scatter the new tokens' k/v into their flat pool rows
-    flat_pos = (jnp.take_along_axis(page_table,
-                                    positions // page_size, axis=1)
-                * page_size + positions % page_size)          # (B, S)
+    flat_pos = cache.flat_rows(positions)                     # (B, S)
     k_flat = k_flat.at[flat_pos.reshape(-1)].set(
         k.astype(k_flat.dtype).reshape(b * s, *k.shape[2:]))
     v_flat = v_flat.at[flat_pos.reshape(-1)].set(
         v.astype(v_flat.dtype).reshape(b * s, *v.shape[2:]))
     new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+
+    # Single-token decode fast path: the Pallas kernel reads pages
+    # DIRECTLY via scalar-prefetched page tables — no (B, L, Hkv, D)
+    # contiguous gather temp, and work scales with real sequence
+    # lengths. RAY_TPU_PAGED_ATTN_IMPL: auto|gather|pallas.
+    impl = os.environ.get("RAY_TPU_PAGED_ATTN_IMPL", "auto")
+    if s == 1 and impl != "gather":
+        on_tpu = jax.default_backend() == "tpu"
+        if impl == "pallas" or on_tpu:
+            from .pallas.paged_attention import (  # noqa: PLC0415
+                paged_decode_attention, paged_decode_lowers)
+            if impl == "pallas" or paged_decode_lowers(
+                    q[:, 0], k_flat, page_table, page_size):
+                out = paged_decode_attention(
+                    q[:, 0], k_flat, v_flat, page_table, new_lengths,
+                    page_size, qpos=positions[:, 0], scale=scale,
+                    interpret=not on_tpu)
+                return out[:, None], PagedKV(
+                    k_flat, v_flat, page_table, new_lengths, page_size)
 
     # gather each sequence's contiguous KV view from its pages
     gather_idx = (page_table[:, :, None] * page_size
