@@ -96,14 +96,30 @@ class Dataset:
             lambda b: {mapping.get(k, k): v for k, v in b.items()}))
 
     def _rebatched(self, rows_per_block: int) -> "Dataset":
-        def shuffle_fn(blocks: List[Block]) -> List[Block]:
-            whole = block_concat(blocks)
-            n = block_num_rows(whole)
-            return [block_slice(whole, i, min(i + rows_per_block, n))
-                    for i in range(0, n, rows_per_block)]
+        """STREAMING re-chunk: holds at most (carry + one block), never
+        the concatenated dataset (pre-r5 this block_concat'd it all)."""
+        def window_fn(stream):
+            # parts accumulate as SLICES and concat once per emitted
+            # batch — concatenating the carry into every incoming block
+            # would copy O(rows_per_block^2) rows for tiny input blocks
+            parts: List[Block] = []
+            have = 0
+            for block in stream:
+                i = 0
+                n = block_num_rows(block)
+                while i < n:
+                    take = min(rows_per_block - have, n - i)
+                    parts.append(block_slice(block, i, i + take))
+                    have += take
+                    i += take
+                    if have == rows_per_block:
+                        yield block_concat(parts)
+                        parts, have = [], 0
+            if have:
+                yield block_concat(parts)
         return self._with_stage(Stage(
-            name=f"rebatch({rows_per_block})", kind="shuffle",
-            shuffle_fn=shuffle_fn))
+            name=f"rebatch({rows_per_block})", kind="window",
+            window_fn=window_fn))
 
     # ---------------- shuffles (distributed exchanges) ----------------
     # Each is a two-round map-partition + reduce-merge exchange over the
@@ -151,16 +167,41 @@ class Dataset:
         return Dataset(_Source("union", make_blocks))
 
     def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column zip, STREAMING: both sides advance block
+        by block with bounded carries — the pre-r5 version concatenated
+        BOTH datasets wholesale. Extra rows on the longer side drop
+        (reference zip semantics: truncate to the shorter)."""
         left, right = self, other
 
         def make_blocks():
-            lb = block_concat(list(left.iter_blocks()))
-            rb = block_concat(list(right.iter_blocks()))
-            n = min(block_num_rows(lb), block_num_rows(rb))
-            merged = dict(block_slice(lb, 0, n))
-            for k, v in block_slice(rb, 0, n).items():
-                merged[k if k not in merged else f"{k}_1"] = v
-            yield merged
+            rit = right.iter_blocks()
+            rcarry: Optional[Block] = None
+            right_done = False
+            for lb in left.iter_blocks():
+                need = block_num_rows(lb)
+                if need == 0:
+                    continue   # empty left block (e.g. filtered out)
+                parts: List[Block] = []
+                got = 0
+                while got < need:
+                    if rcarry is None or not block_num_rows(rcarry):
+                        rcarry = next(rit, None)
+                        if rcarry is None:
+                            right_done = True
+                            break
+                    take = min(block_num_rows(rcarry), need - got)
+                    parts.append(block_slice(rcarry, 0, take))
+                    rcarry = block_slice(rcarry, take,
+                                         block_num_rows(rcarry))
+                    got += take
+                if got:
+                    rb = block_concat(parts)
+                    merged = dict(block_slice(lb, 0, got))
+                    for k, v in rb.items():
+                        merged[k if k not in merged else f"{k}_1"] = v
+                    yield merged
+                if right_done:
+                    return   # truncate to the shorter side
         return Dataset(_Source("zip", make_blocks))
 
     def groupby(self, key: str) -> "GroupedData":

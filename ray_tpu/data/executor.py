@@ -109,6 +109,15 @@ def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
         return shuffled()
     if stage.kind == "exchange":
         return _apply_exchange(stream, stage, stats, parallelism)
+    if stage.kind == "window":
+        def windowed() -> Iterator[Block]:
+            t0 = time.time()
+            n = 0
+            for out in stage.window_fn(stream):
+                n += 1
+                yield out
+            stats.record(stage.name, time.time() - t0, n)
+        return windowed()
     raise ValueError(f"unknown stage kind {stage.kind}")
 
 

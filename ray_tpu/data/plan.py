@@ -20,13 +20,17 @@ from .block import (Block, block_concat, block_from_rows, block_num_rows,
 @dataclasses.dataclass
 class Stage:
     name: str
-    kind: str                      # "map_block" | "shuffle" | "exchange" | "source"
+    # "map_block" | "shuffle" | "exchange" | "window" | "source"
+    kind: str
     fn: Optional[Callable] = None  # map_block: Block -> Block
     shuffle_fn: Optional[Callable] = None  # shuffle: List[Block] -> List[Block]
     can_fuse: bool = True
     compute: str = "tasks"         # "tasks" | "actors"
     fn_constructor: Optional[Callable] = None  # for actor compute
     exchange: Optional[Any] = None  # ExchangeSpec for kind="exchange"
+    # window: Iterator[Block] -> Iterator[Block], streaming (holds only
+    # a bounded carry — never the whole dataset)
+    window_fn: Optional[Callable] = None
 
 
 def map_rows_stage(name: str, row_fn: Callable[[Dict], Optional[Dict]],

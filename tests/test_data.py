@@ -298,3 +298,36 @@ def test_split_proportionately_block_level():
     assert xa + xb + xc == list(range(1000))
     # interior blocks pass through whole: the first split spans >1 block
     assert len(list(a.iter_blocks())) >= 2
+
+
+def test_zip_streaming_uneven_blocks():
+    """zip aligns rows across mismatched block boundaries without
+    concatenating either dataset (r5: streaming carries)."""
+    a = rdata.range(10, block_rows=3)
+    b = rdata.range(10, block_rows=4).map_batches(
+        lambda blk: {"id": blk["id"] * 10})
+    rows = a.zip(b).take_all()
+    assert [r["id"] for r in rows] == list(range(10))
+    assert [r["id_1"] for r in rows] == [i * 10 for i in range(10)]
+    # truncation to the shorter side
+    short = rdata.range(4).zip(rdata.range(9)).take_all()
+    assert len(short) == 4
+
+
+def test_rebatch_streams_without_full_concat():
+    ds = rdata.range(25, block_rows=4).map_batches(
+        lambda b: b, batch_size=7)
+    blocks = list(ds.iter_blocks())
+    assert [len(b["id"]) for b in blocks] == [7, 7, 7, 4]
+    assert np.concatenate([b["id"] for b in blocks]).tolist() == \
+        list(range(25))
+
+
+def test_zip_with_empty_filtered_blocks():
+    """Empty blocks on the left (filter leftovers) must not truncate
+    the zip (r5 review regression test)."""
+    a = rdata.range(10, block_rows=3).filter(lambda r: r["id"] >= 3)
+    b = rdata.range(7).map_batches(lambda blk: {"v": blk["id"] + 100})
+    rows = a.zip(b).take_all()
+    assert [r["id"] for r in rows] == [3, 4, 5, 6, 7, 8, 9]
+    assert [r["v"] for r in rows] == [100 + i for i in range(7)]
