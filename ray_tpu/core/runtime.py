@@ -289,6 +289,12 @@ class DriverRuntime:
         # device-resident objects with an in-flight materialize request
         # (core/device_store.py); cleared when the holder's re-seal lands
         self._materializing: set = set()
+        # pending-placement diagnostics: first-seen ts per task/actor id
+        # and a warned set, so a workload stuck behind exhausted
+        # resources surfaces a one-time stderr warning instead of
+        # hanging silently (reference: raylet's pending-task warnings)
+        self._pending_since: Dict[str, float] = {}
+        self._pending_warned: set = set()
         self._wid_counter = 0
         self._shutdown = threading.Event()
         self._conn_by_wid: Dict[str, Connection] = {}
@@ -1096,6 +1102,36 @@ class DriverRuntime:
         self.pending_actors.append(acspec)
 
     # ---------------- scheduling ----------------
+    _PENDING_WARN_S = 10.0
+
+    def _warn_if_stuck(self, key: str, what: str,
+                       need: Dict[str, float]) -> None:
+        """One-time stderr warning when a task/actor has been pending
+        past _PENDING_WARN_S with nowhere to place it — exhausted CPU
+        slots hang silently otherwise (a Gateway+controller+replica app
+        on init(num_cpus=2) waits forever with zero feedback)."""
+        now = time.time()
+        first = self._pending_since.setdefault(key, now)
+        if key in self._pending_warned \
+                or now - first < self._PENDING_WARN_S:
+            return
+        self._pending_warned.add(key)
+        cap = {}
+        avail = {}
+        for ns in self.cluster_nodes.values():
+            if not ns.alive:
+                continue
+            for r, v in ns.total.items():
+                cap[r] = cap.get(r, 0) + v
+            for r, v in ns.avail.items():
+                avail[r] = avail.get(r, 0) + v
+        sys.stderr.write(
+            f"[ray_tpu] WARNING: {what} has been pending for "
+            f"{now - first:.0f}s: requires {need or '{}'}, cluster "
+            f"capacity {cap}, currently free {avail}. If demand exceeds "
+            f"capacity it will wait forever — raise init(num_cpus=...) "
+            f"or free resources.\n")
+
     def _deps_ready(self, dep_ids: List[str]) -> Optional[bool]:
         """True = all ready; False = still pending; None = a dep errored."""
         ok = True
@@ -1271,8 +1307,13 @@ class DriverRuntime:
                 if node is not None:
                     break
             if node is None:
+                self._warn_if_stuck(
+                    acspec.actor_id,
+                    f"actor {acspec.class_name} ({acspec.actor_id})",
+                    need)
                 still.append(acspec)
                 continue
+            self._pending_since.pop(acspec.actor_id, None)
             res_mod.acquire(node.avail, need)
             self._actor_create_specs[acspec.actor_id] = acspec
             wid = self._spawn_worker(purpose=acspec.actor_id,
@@ -1438,8 +1479,12 @@ class DriverRuntime:
                                            tpu_capable=task_needs_tpu,
                                            node_id=node.node_id)
                         break
+                else:
+                    self._warn_if_stuck(spec.task_id,
+                                        f"task {spec.name}", need)
                 still.append(spec)
                 continue
+            self._pending_since.pop(spec.task_id, None)
             node = self.cluster_nodes[w.node_id]
             if spec.placement_group_id is not None:
                 spec.tpu_ids = self._pg_tpu_ids(

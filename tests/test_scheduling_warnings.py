@@ -1,0 +1,29 @@
+"""Pending-placement diagnostics: workloads stuck behind exhausted
+resources must warn, not hang silently (r5; reference: raylet's
+pending-task resource warnings)."""
+import time
+
+import ray_tpu
+from ray_tpu.core.runtime import DriverRuntime
+
+
+def test_pending_actor_warns_when_unplaceable(capsys, monkeypatch):
+    monkeypatch.setattr(DriverRuntime, "_PENDING_WARN_S", 0.5)
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        class Hog:
+            def ping(self):
+                return "ok"
+
+        a = Hog.remote()
+        assert ray_tpu.get(a.ping.remote()) == "ok"  # holds the one CPU
+        _b = Hog.remote()                            # can never place
+        deadline = time.time() + 10
+        warned = False
+        while time.time() < deadline and not warned:
+            time.sleep(0.3)
+            warned = "has been pending" in capsys.readouterr().err
+        assert warned, "no pending-placement warning surfaced"
+    finally:
+        ray_tpu.shutdown()
