@@ -155,6 +155,16 @@ def _engine_metrics():
     return _metrics_singletons
 
 
+
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1) (bucketing helper: prefill
+    group sizes, prefix pads, decode page windows)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class LLMEngine:
     """Continuous-batching engine over a ray_tpu Llama-family model.
 
@@ -300,11 +310,17 @@ class LLMEngine:
                 self._chunk_paged_impl,
                 static_argnames=("chunk", "sample"), donate_argnums=(1, 3))
             self._decode_paged_jit = jax.jit(
-                self._decode_paged_impl, donate_argnums=(1, 3))
+                self._decode_paged_impl, donate_argnums=(1, 3),
+                static_argnames=("window_pages",))
             self._decode_block_paged_jit = (
                 jax.jit(self._decode_block_paged_impl,
-                        donate_argnums=(1, 3))
+                        donate_argnums=(1, 3),
+                        static_argnames=("window_pages",))
                 if cfg.decode_block > 1 else None)
+            # host mirror of each slot's device length: picks the
+            # power-of-2 page window covering the longest active
+            # sequence at decode-dispatch time
+            self._disp_len: Dict[int, int] = {}
             self._copy_page_jit = jax.jit(self._copy_page_impl,
                                           donate_argnums=(0,))
         # register_prefix (paged) must mutate the pools on the engine
@@ -564,12 +580,21 @@ class LLMEngine:
 
     def _decode_paged_impl(self, params, pools, page_table, lengths,
                            last_tokens, active_mask, temps, top_ps,
-                           rng_key):
+                           rng_key, window_pages: int = 0):
         """One decode step for every slot over the page pool. Released
         slots' page-table rows point at the trash page, so their writes
         are inert; inactive lengths are restored so state never
-        drifts."""
+        drifts.
+
+        window_pages > 0 statically narrows the attention window to the
+        first `window_pages` page-table columns (a power-of-2 bucket
+        covering the longest ACTIVE sequence, host-tracked): decode
+        cost then scales with real lengths, not max_seq_len — the
+        XLA-gather path's analog of the Pallas kernel's page skipping.
+        """
         jnp = self._jnp
+        if window_pages and window_pages < page_table.shape[1]:
+            page_table = page_table[:, :window_pages]
         entries = self._paged_entries(pools, page_table, lengths)
         positions = lengths[:, None]
         logits, new_entries = self.model.apply(
@@ -585,7 +610,8 @@ class LLMEngine:
 
     def _decode_block_paged_impl(self, params, pools, page_table,
                                  lengths, last_tokens, active_mask,
-                                 temps, top_ps, rng_key):
+                                 temps, top_ps, rng_key,
+                                 window_pages: int = 0):
         jax = self._jax
         keys = jax.random.split(rng_key, self.cfg.decode_block)
 
@@ -593,7 +619,7 @@ class LLMEngine:
             pools, lengths, last = carry
             nxt, logps, pools, lengths = self._decode_paged_impl(
                 params, pools, page_table, lengths, last, active_mask,
-                temps, top_ps, key)
+                temps, top_ps, key, window_pages=window_pages)
             return (pools, lengths, nxt), (nxt, logps)
 
         (pools, lengths, last), (toks, logps) = jax.lax.scan(
@@ -717,10 +743,7 @@ class LLMEngine:
         if pages is None:
             raise ValueError("page pool exhausted registering prefix")
         scratch = self._scratch_slot
-        pad = 1
-        while pad < prefix.size:
-            pad *= 2
-        pad = min(pad, self.cfg.max_seq_len)
+        pad = min(_next_pow2(prefix.size), self.cfg.max_seq_len)
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :prefix.size] = prefix
         self._set_page_row(scratch, pages)
@@ -756,10 +779,7 @@ class LLMEngine:
         """Fill buffer row `pid` (the scratch row included) under the
         lock — the buffer swap is a read-modify-write; a concurrent
         unsynchronized registration would silently drop one fill."""
-        pad = 1
-        while pad < prefix.size:
-            pad *= 2
-        pad = min(pad, self.cfg.max_seq_len)
+        pad = min(_next_pow2(prefix.size), self.cfg.max_seq_len)
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :prefix.size] = prefix
         with self._lock:
@@ -1056,6 +1076,7 @@ class LLMEngine:
             self._slot_pages[slot] = (n_shared, all_pages)
             self._set_page_row(slot, all_pages)
             self._lengths = self._lengths.at[slot].set(plen)
+            self._disp_len[slot] = plen
             req.prefill_pos = plen
             self.stats["prefix_tokens_saved"] = (
                 self.stats.get("prefix_tokens_saved", 0) + plen)
@@ -1068,6 +1089,14 @@ class LLMEngine:
         req.admit_ts = time.time()
         self._slot_pages[slot] = (0, pages)
         self._set_page_row(slot, pages)
+        # reset the slot's device length NOW: a reused slot's stale
+        # length would aim inactive decode-steps' garbage writes at an
+        # arbitrary position — under a narrowed decode window the
+        # clamped scatter could then corrupt the NEW occupant's pages.
+        # With length 0, garbage always lands exactly where the next
+        # prefill/chunk write goes (overwritten before any read).
+        self._lengths = self._lengths.at[slot].set(0)
+        self._disp_len[slot] = 0
         return "ok"
 
     def _admit_all(self, inflight) -> None:
@@ -1162,9 +1191,7 @@ class LLMEngine:
                 # unified single/batched paged prefill: pad group size
                 # to a power of two; padding rows hit the scratch slot
                 # whose page row is all-trash
-                g = 1
-                while g < g_real:
-                    g *= 2
+                g = _next_pow2(g_real)
                 tokens = np.zeros((g, pad_len), np.int32)
                 slots = np.full((g,), self._scratch_slot, np.int32)
                 lens = np.ones((g,), np.int32)
@@ -1196,9 +1223,7 @@ class LLMEngine:
                     jnp.float32(req.top_p), sub, pad_len=pad_len)
                 toks_dev, lps_dev = tok_dev[None], lp_dev[None]
             else:
-                g = 1
-                while g < g_real:
-                    g *= 2
+                g = _next_pow2(g_real)
                 tokens = np.zeros((g, pad_len), np.int32)
                 slots = np.full((g,), self._scratch_slot, np.int32)
                 lens = np.ones((g,), np.int32)
@@ -1235,6 +1260,8 @@ class LLMEngine:
         self.stats["prefills"] += g_real
         for req, slot in members:
             req.prefill_dispatch_ms = dispatch_ms
+            if self._paged:
+                self._disp_len[slot] = req.prompt.size
             self._active[slot] = req
         self._mask_dirty = True
         self._start_fetch(toks_dev)
@@ -1290,6 +1317,8 @@ class LLMEngine:
             req.out_queue.put(_END)
             return
         req.prefill_pos = start + true
+        if self._paged:
+            self._disp_len[req.slot] = req.prefill_pos
         req.prefill_dispatch_ms += (time.time() - t_dispatch) * 1000
         if is_last:
             self._prefilling.popleft()
@@ -1362,6 +1391,7 @@ class LLMEngine:
         if not self._paged:
             return
         entry = self._slot_pages.pop(slot, None)
+        self._disp_len.pop(slot, None)
         if entry is None:
             return
         n_shared, pages = entry
@@ -1376,6 +1406,19 @@ class LLMEngine:
             self._active.pop(req.slot, None)
             self._mask_dirty = True
             req.slot = -1
+
+    def _decode_window_pages(self) -> int:
+        """Power-of-2 page window covering every slot that holds KV
+        (active AND chunk-prefilling — a narrower window would let the
+        decode scatter's clamped index corrupt a prefilling slot's
+        pages) plus this dispatch's new tokens. 0 = full width. The
+        static window buckets keep compile count at O(log2 P) while
+        decode cost tracks the longest REAL sequence."""
+        ps = self.cfg.kv_page_size
+        need = (max(self._disp_len.values(), default=0)
+                + max(1, self.cfg.decode_block))
+        w = _next_pow2(-(-need // ps))
+        return 0 if w >= self._pages_per_slot else w
 
     def _device_mask_temps(self):
         """(active_mask, temps, top_ps) as device arrays, rebuilt only
@@ -1480,21 +1523,29 @@ class LLMEngine:
                         self._rng_key)
                     snapshot = list(self._active.items())
                     if self._paged:
+                        window = self._decode_window_pages()
                         if self._decode_block_paged_jit is not None:
                             toks, logps, self._pools, self._lengths, \
                                 last = self._decode_block_paged_jit(
                                     self.params, self._pools,
                                     self._page_table, self._lengths,
                                     self._last_tokens, mask, temps,
-                                    top_ps, sub)
+                                    top_ps, sub, window_pages=window)
                         else:
                             toks, logps, self._pools, self._lengths = \
                                 self._decode_paged_jit(
                                     self.params, self._pools,
                                     self._page_table, self._lengths,
                                     self._last_tokens, mask, temps,
-                                    top_ps, sub)
+                                    top_ps, sub, window_pages=window)
                             last = toks
+                        block = max(1, self.cfg.decode_block)
+                        for slot in self._active:
+                            # KeyError here = an admission path forgot
+                            # to seed _disp_len; fail loudly — a silent
+                            # 0 default would shrink the window and
+                            # corrupt KV untraceably
+                            self._disp_len[slot] += block
                     elif self._decode_block_jit is not None:
                         toks, logps, self._cache, last = \
                             self._decode_block_jit(
