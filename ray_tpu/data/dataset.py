@@ -209,14 +209,31 @@ class Dataset:
 
     # ---------------- splits ----------------
     def split(self, n: int) -> List["Dataset"]:
+        """Contiguous n-way split at BLOCK granularity: interior blocks
+        pass through by reference, only boundary blocks are sliced —
+        no whole-dataset concatenation (same approach as
+        split_proportionately)."""
         blocks = list(self.iter_blocks())
-        whole = block_concat(blocks)
-        total = block_num_rows(whole)
+        counts = [block_num_rows(b) for b in blocks]
+        total = sum(counts)
         per = math.ceil(total / n)
-        out = []
+        out: List["Dataset"] = []
+        bi, off = 0, 0
         for i in range(n):
-            part = block_slice(whole, i * per, min((i + 1) * per, total))
-            out.append(from_blocks([part], name=f"split_{i}"))
+            need = min(per, total - i * per) if total > i * per else 0
+            parts: List[Block] = []
+            while need > 0 and bi < len(blocks):
+                take = min(counts[bi] - off, need)
+                if take == counts[bi] and off == 0:
+                    parts.append(blocks[bi])
+                else:
+                    parts.append(block_slice(blocks[bi], off, off + take))
+                need -= take
+                off += take
+                if off >= counts[bi]:
+                    bi += 1
+                    off = 0
+            out.append(from_blocks(parts, name=f"split_{i}"))
         return out
 
     def split_proportionately(self, fractions: Sequence[float]
@@ -584,6 +601,16 @@ class GroupedData:
 
     def aggregate(self, *specs: Tuple[str, str]) -> Dataset:
         return self._aggregate(list(specs))
+
+    def map_groups(self, fn: Callable[[Block], Block]) -> Dataset:
+        """Apply `fn` to each group's block (reference:
+        GroupedData.map_groups); distributed like the aggregations —
+        each group lands wholly in one reduce task, output stays in
+        ascending key order."""
+        from .exchange import groupby_map_spec
+        spec = groupby_map_spec(self._key, fn)
+        return self._ds._with_stage(Stage(name=spec.name, kind="exchange",
+                                          exchange=spec))
 
 
 def _name(fn) -> str:

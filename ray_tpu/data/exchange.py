@@ -221,6 +221,30 @@ def groupby_agg_spec(key: str, aggs: List[tuple],
                         sample_fn=sample, meta_fn=meta)
 
 
+def groupby_map_spec(key: str, fn: Callable) -> ExchangeSpec:
+    """GroupedData.map_groups (reference grouped_data.py): range-
+    partition by key so each group lands wholly in one reduce task,
+    then apply `fn` to each group's block; results concatenate in
+    ascending key order."""
+    base = groupby_agg_spec(key, [], lambda *a: None)
+
+    def reduce(pieces: List[Block], part_idx: int, meta: Any) -> List[Block]:
+        merged = block_concat(pieces)
+        if not block_num_rows(merged):
+            return []
+        keys = merged[key]
+        out: List[Block] = []
+        for kval in np.unique(keys):   # sorted group order
+            mask = keys == kval
+            res = fn({c: v[mask] for c, v in merged.items()})
+            if res and block_num_rows(res):
+                out.append(res)
+        return out
+
+    return ExchangeSpec(f"map_groups({key})", base.partition_fn, reduce,
+                        sample_fn=base.sample_fn, meta_fn=base.meta_fn)
+
+
 # ---------------------------------------------------------------------------
 # execution
 

@@ -155,3 +155,24 @@ def test_abandoned_exchange_frees_store_objects(rt):
     assert len(rt_obj.gcs.objects) - before <= 2
     ex = ds.stats_object().exchange["random_shuffle"]
     assert ex["map_tasks"] == N_BLOCKS  # stats still recorded
+
+
+def test_map_groups_distributed(rt):
+    """GroupedData.map_groups: per-group transform over the exchange,
+    groups whole in one task, output in ascending key order."""
+    n = 4000
+    k = np.arange(n) % 7
+    v = np.arange(n, dtype=np.float64)
+    ds = rdata.from_numpy({"k": k, "v": v}).repartition(5)
+
+    def top2(group):
+        order = np.argsort(-group["v"])[:2]
+        return {c: arr[order] for c, arr in group.items()}
+
+    rows = ds.groupby("k").map_groups(top2).take_all()
+    assert len(rows) == 14
+    assert [r["k"] for r in rows] == sorted([r["k"] for r in rows])
+    for key in range(7):
+        got = sorted(r["v"] for r in rows if r["k"] == key)
+        expect = sorted(v[k == key])[-2:]
+        assert got == list(expect)
