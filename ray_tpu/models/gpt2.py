@@ -81,7 +81,8 @@ class GPT2Block(nn.Module):
             att = multi_head_attention(q, k, v, causal=True,
                                        impl=cfg.attn_impl)
         else:
-            att, new_cache = cached_attention(q, k, v, cache, positions)
+            att, new_cache = cached_attention(q, k, v, cache, positions,
+                                              impl=cfg.attn_impl)
         att = att.reshape(b, s, cfg.d_model)
         x = x + nn.Dense(cfg.d_model, name="attn_out", dtype=cfg.dtype)(att)
 

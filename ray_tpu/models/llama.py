@@ -136,7 +136,8 @@ class LlamaAttention(nn.Module):
         else:
             # Decode: write new k/v at `positions`, attend over prefix
             # (shared zoo-wide cached path, ops/attention.py).
-            out, new_cache = cached_attention(q, k, v, cache, positions)
+            out, new_cache = cached_attention(q, k, v, cache, positions,
+                                              impl=cfg.attn_impl)
 
         out = out.reshape(b, s, cfg.n_heads * hd)
         out = _proj(cfg, cfg.d_model, "o_proj")(out)

@@ -99,7 +99,8 @@ def _resolve_impl(impl: str, q: jax.Array, k: jax.Array, causal: bool,
 
 def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
                      positions: jax.Array,
-                     scale: Optional[float] = None):
+                     scale: Optional[float] = None,
+                     impl: str = "auto"):
     """Decode/continuation attention against a per-sequence KV cache.
 
     q/k/v: (B, S, H{q,kv}, D) for the NEW tokens; cache = (ck, cv,
@@ -109,10 +110,12 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, cache,
     the zoo (llama.py, gpt2.py) — the engine's serving contract.
 
     A PagedKV cache entry routes to paged_cached_attention — same
-    semantics over a shared page pool."""
+    semantics over a shared page pool. `impl` (the model's
+    cfg.attn_impl) governs the fresh-prefill fast path's attention
+    router so a pinned implementation holds on every code path."""
     if isinstance(cache, PagedKV):
         return paged_cached_attention(q, k, v, cache, positions,
-                                      scale=scale)
+                                      scale=scale, impl=impl)
     b, s, hq, d = q.shape
     hkv = k.shape[2]
     if scale is None:
@@ -162,17 +165,21 @@ class PagedKV:
       Unallocated entries point at a trash page: writes there are
       discarded by construction, reads are masked by `lengths`.
     lengths: (B,) int32 — tokens currently valid per sequence.
-    page_size is STATIC pytree metadata, so jitted callers keep
-    `jnp.arange(page_size)` and friends shape-static.
+    page_size and `fresh` are STATIC pytree metadata. fresh=True marks
+    a PURE PREFILL call (every sequence starts at length 0): attention
+    then runs straight over the new tokens' k/v — no page gather at
+    all, and the multi_head_attention router can pick the flash kernel
+    for long prompts — while KV still scatters into the pages.
     """
 
     def __init__(self, k_flat, v_flat, page_table, lengths,
-                 page_size: int):
+                 page_size: int, fresh: bool = False):
         self.k_flat = k_flat
         self.v_flat = v_flat
         self.page_table = page_table
         self.lengths = lengths
         self.page_size = page_size
+        self.fresh = fresh
 
     def flat_rows(self, positions):
         """Flat pool row index for each (sequence, logical position) in
@@ -184,16 +191,17 @@ class PagedKV:
 
     def tree_flatten(self):
         return ((self.k_flat, self.v_flat, self.page_table,
-                 self.lengths), self.page_size)
+                 self.lengths), (self.page_size, self.fresh))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, aux)
+        return cls(*children, *aux)
 
 
 def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            cache: "PagedKV", positions: jax.Array,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           impl: str = "auto"):
     """cached_attention semantics over a PagedKV pool.
 
     Static shapes throughout (gather width = P * page_size), so the
@@ -217,6 +225,21 @@ def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     v_flat = v_flat.at[flat_pos.reshape(-1)].set(
         v.astype(v_flat.dtype).reshape(b * s, *v.shape[2:]))
     new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
+
+    if cache.fresh and os.environ.get(
+            "RAY_TPU_PAGED_ATTN_IMPL", "auto") != "gather":
+        # pure prefill (all sequences start empty): no prior context to
+        # gather — attend directly over the new tokens via the model's
+        # configured attention impl (flash-eligible for long prompts on
+        # TPU). Padding-tail keys only influence discarded query
+        # outputs (causal mask), same as the gather path's semantics.
+        # RAY_TPU_PAGED_ATTN_IMPL=gather forces the pool-gather
+        # reference path here too (A/B-debugging contract).
+        out = multi_head_attention(q, k.astype(q.dtype),
+                                   v.astype(q.dtype), causal=True,
+                                   impl=impl, scale=scale)
+        return out, PagedKV(k_flat, v_flat, page_table, new_lengths,
+                            page_size)
 
     # Single-token decode fast path: the Pallas kernel reads pages
     # DIRECTLY via scalar-prefetched page tables — no (B, L, Hkv, D)

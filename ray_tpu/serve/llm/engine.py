@@ -539,7 +539,11 @@ class LLMEngine:
         rows = page_table[slots]                   # (G, P)
         rows_p = rows[:, :-(-pad_len // ps)]       # pages covering pad
         from ...ops.attention import PagedKV  # noqa: PLC0415
-        entries = [PagedKV(k, v, rows_p, jnp.zeros((g,), jnp.int32), ps)
+        # fresh=True: pure prefill — attention runs straight over the
+        # prompt (flash-eligible on TPU), no page gather; KV still
+        # scatters into the pages
+        entries = [PagedKV(k, v, rows_p, jnp.zeros((g,), jnp.int32),
+                           ps, fresh=True)
                    for (k, v) in pools]
         positions = jnp.broadcast_to(jnp.arange(pad_len)[None, :],
                                      (g, pad_len))
