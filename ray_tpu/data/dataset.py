@@ -18,7 +18,8 @@ from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
 import numpy as np
 
 from .block import (Block, block_concat, block_from_rows, block_num_rows,
-                    block_slice, block_to_rows,
+                    block_select, block_slice, block_take,
+                    block_to_rows,
                     block_size_bytes)
 from .executor import DatasetStats, execute_plan
 from .plan import (Stage, filter_stage, map_batches_stage, map_rows_stage)
@@ -314,7 +315,21 @@ class Dataset:
             yield from block_to_rows(block)
 
     def iter_batches(self, *, batch_size: int = 256,
-                     drop_last: bool = False) -> Iterator[Block]:
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Block]:
+        """Batches of `batch_size` rows. local_shuffle_buffer_size
+        enables reference-style windowed shuffling at iteration time: a
+        rolling buffer of at least that many rows is sampled without
+        replacement per batch — an O(buffer) approximate shuffle, no
+        full-dataset pass (reference: iter_batches
+        local_shuffle_buffer_size)."""
+        if local_shuffle_buffer_size:
+            yield from self._iter_batches_shuffled(
+                batch_size, drop_last, local_shuffle_buffer_size,
+                local_shuffle_seed)
+            return
         carry: Optional[Block] = None
         for block in self.iter_blocks():
             if carry is not None:
@@ -329,6 +344,30 @@ class Dataset:
                 carry = block_slice(block, i, n)
         if carry is not None and not drop_last:
             yield carry
+
+    def _iter_batches_shuffled(self, batch_size: int, drop_last: bool,
+                               buffer_rows: int,
+                               seed: Optional[int]) -> Iterator[Block]:
+        rng = np.random.RandomState(seed)
+        buf: Optional[Block] = None
+        for block in self.iter_blocks():
+            buf = block if buf is None else block_concat([buf, block])
+            while block_num_rows(buf) >= buffer_rows + batch_size:
+                pick = rng.choice(block_num_rows(buf), batch_size,
+                                  replace=False)
+                yield block_take(buf, pick)
+                keep = np.ones(block_num_rows(buf), bool)
+                keep[pick] = False
+                buf = block_select(buf, keep)
+        if buf is not None:
+            order = rng.permutation(block_num_rows(buf))
+            buf = block_take(buf, order)
+            n = block_num_rows(buf)
+            for i in range(0, n, batch_size):
+                if i + batch_size <= n:
+                    yield block_slice(buf, i, i + batch_size)
+                elif not drop_last:
+                    yield block_slice(buf, i, n)
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, sharding=None,

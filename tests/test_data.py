@@ -331,3 +331,22 @@ def test_zip_with_empty_filtered_blocks():
     rows = a.zip(b).take_all()
     assert [r["id"] for r in rows] == [3, 4, 5, 6, 7, 8, 9]
     assert [r["v"] for r in rows] == [100 + i for i in range(7)]
+
+
+def test_iter_batches_local_shuffle_buffer():
+    """local_shuffle_buffer_size: windowed approximate shuffle at
+    iteration — multiset preserved, order perturbed, deterministic
+    under seed (reference: iter_batches local_shuffle_buffer_size)."""
+    ds = rdata.range(500, block_rows=50)
+    out = [b["id"] for b in ds.iter_batches(
+        batch_size=32, local_shuffle_buffer_size=128,
+        local_shuffle_seed=3)]
+    flat = np.concatenate(out)
+    assert sorted(flat.tolist()) == list(range(500))
+    assert flat.tolist() != list(range(500))     # actually shuffled
+    again = np.concatenate([b["id"] for b in ds.iter_batches(
+        batch_size=32, local_shuffle_buffer_size=128,
+        local_shuffle_seed=3)])
+    assert flat.tolist() == again.tolist()       # seeded = repeatable
+    sizes = [len(arr) for arr in out]
+    assert all(s == 32 for s in sizes[:-1]) and sum(sizes) == 500
