@@ -539,12 +539,63 @@ def phase_flash_ab() -> dict:
     result = {"platform": platform, "shape": {"batch": b, "heads": h,
                                               "head_dim": d},
               "reps": reps, "rows": rows}
+    result["paged_decode"] = _paged_decode_ab(jax, platform)
     if platform == "tpu":
         with open(os.path.join(REPO, "FLASH_AB.json"), "w") as f:
             json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
                        **result}, f, indent=1)
         _progress("wrote FLASH_AB.json")
     return result
+
+
+def _paged_decode_ab(jax, platform: str) -> list:
+    """A/B the Pallas paged-decode kernel vs the XLA gather path at
+    serving decode shapes (r5): S sequences x one token over a page
+    pool, mixed lengths. On TPU this lands in FLASH_AB.json via the
+    watcher the moment the tunnel revives."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.attention import PagedKV, paged_cached_attention
+    from ray_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    S, ps, hq, hkv, d = 8, 64, 16, 8, 64
+    rows = []
+    for P in (4, 16, 32):                  # 256/1024/2048-token windows
+        rng = np.random.RandomState(P)
+        n_pages = S * P
+        k_flat = jnp.asarray(rng.randn((n_pages + 1) * ps, hkv, d),
+                             jnp.bfloat16)
+        v_flat = jnp.asarray(rng.randn((n_pages + 1) * ps, hkv, d),
+                             jnp.bfloat16)
+        table = jnp.asarray(rng.permutation(n_pages).reshape(S, P),
+                            jnp.int32)
+        lengths = jnp.asarray(
+            rng.randint(ps, P * ps, (S,)).astype(np.int32))
+        q = jnp.asarray(rng.randn(S, 1, hq, d), jnp.bfloat16)
+        kn = jnp.asarray(rng.randn(S, 1, hkv, d), jnp.bfloat16)
+        vn = jnp.asarray(rng.randn(S, 1, hkv, d), jnp.bfloat16)
+        row = {"window_tokens": P * ps}
+        for impl in (("gather",) if platform != "tpu"
+                     else ("gather", "pallas")):
+            os.environ["RAY_TPU_PAGED_ATTN_IMPL"] = impl
+            try:
+                cache = PagedKV(k_flat, v_flat, table, lengths, ps)
+                step = jax.jit(paged_cached_attention)
+                out, _ = step(q, kn, vn, cache, lengths[:, None])
+                _sync(out[0, 0, 0, 0].astype(jnp.float32))
+                t0 = time.time()
+                for _ in range(20):
+                    out, _ = step(q, kn, vn, cache, lengths[:, None])
+                _sync(out[0, 0, 0, 0].astype(jnp.float32))
+                row[f"{impl}_ms"] = round(
+                    (time.time() - t0) / 20 * 1000, 3)
+            except BaseException as e:  # noqa: BLE001
+                row[f"{impl}_error"] = repr(e)[:200]
+            finally:
+                os.environ.pop("RAY_TPU_PAGED_ATTN_IMPL", None)
+        _progress(f"paged-decode ab: {row}")
+        rows.append(row)
+    return rows
 
 
 def phase_serve() -> dict:
