@@ -450,8 +450,53 @@ def phase_probe_8b() -> dict:
         int8_result = {"ok": False, "error": repr(e)[:300],
                        "wall_s": round(time.time() - t0, 1)}
     _progress(f"8b int8 probe: {int8_result}")
+    # North-star check (BASELINE: "serve an 8B Llama, no GPU in the
+    # loop"): the int8 8B SERVING through the paged continuous-batching
+    # engine — page pool sized to fit beside ~6.6 GB of weights.
+    serve_result = {"ok": False, "skipped": "int8 forward did not fit"}
+    if int8_result.get("ok"):
+        t0 = time.time()
+        try:
+            from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+            cfg = dataclasses.replace(
+                LlamaConfig.llama3_8b(param_dtype=jnp.bfloat16),
+                max_seq_len=1024, quant="int8")
+            model = Llama(cfg)
+            params = jax.jit(
+                lambda rng: model.init(
+                    rng, jnp.zeros((1, 8), jnp.int32))["params"]
+            )(jax.random.PRNGKey(0))
+            eng = LLMEngine(model, params, LLMEngineConfig(
+                max_slots=8, max_seq_len=1024,
+                prefill_buckets=(128,),
+                kv_page_size=64, kv_pool_tokens=4096))
+            try:
+                t1 = time.time()
+                toks = eng.generate_sync(
+                    np.arange(1, 100) % cfg.vocab_size,
+                    max_new_tokens=16)
+                cold_s = time.time() - t1
+                t2 = time.time()   # second request: compiles all warm
+                toks2 = eng.generate_sync(
+                    np.arange(7, 106) % cfg.vocab_size,
+                    max_new_tokens=16)
+                warm_s = time.time() - t2
+                serve_result = {
+                    "ok": len(toks) == 16 and len(toks2) == 16,
+                    "first_request_s": round(cold_s, 1),
+                    "warm_request_s": round(warm_s, 2),
+                    "warm_tok_s": round(16 / max(warm_s, 1e-6), 1),
+                    "kv_pages": eng.get_stats().get("kv_pages"),
+                    "wall_s": round(time.time() - t0, 1)}
+            finally:
+                eng.shutdown()
+        except BaseException as e:  # noqa: BLE001
+            serve_result = {"ok": False, "error": repr(e)[:300],
+                            "wall_s": round(time.time() - t0, 1)}
+    _progress(f"8b int8 paged-serve probe: {serve_result}")
     return {"platform": platform, "attempts": attempts, "fits": best,
-            "int8_full_depth": int8_result}
+            "int8_full_depth": int8_result,
+            "int8_paged_serve": serve_result}
 
 
 def phase_flash_ab() -> dict:
