@@ -145,6 +145,21 @@ def test_paged_prefix_shares_pages(tiny_llm):
     eng.shutdown()
 
 
+def test_paged_decode_block_and_pipeline_parity(tiny_llm):
+    """decode_block>1 (lax.scan fused steps) + pipelined dispatch over
+    the paged cache with windowed decode: token-identical to the
+    contiguous engine."""
+    prompts = [np.arange(1 + i, 7 + i * 2) % 128 for i in range(4)]
+    legacy = _engine(tiny_llm)
+    want = [legacy.generate_sync(p, max_new_tokens=9) for p in prompts]
+    legacy.shutdown()
+    paged = _engine(tiny_llm, kv_page_size=16, decode_block=3,
+                    pipeline_depth=4)
+    got = [paged.generate_sync(p, max_new_tokens=9) for p in prompts]
+    paged.shutdown()
+    assert got == want
+
+
 def test_paged_chunked_prefill_parity(tiny_llm):
     """Long prompts through chunked prefill (paged) match the one-shot
     bucket prefill (contiguous) token-for-token."""
