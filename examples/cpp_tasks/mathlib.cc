@@ -1,0 +1,109 @@
+// Example cross-language C++ library for ray_tpu (SURVEY C18).
+//
+// Build:
+//   g++ -O2 -std=c++17 -shared -fPIC -I ../../ray_tpu/_native \
+//       mathlib.cc -o libmathlib.so
+//
+// Use from Python: see examples/cpp_tasks/run_cpp_tasks.py.
+#include <cmath>
+#include <numeric>
+
+#include "cross_lang.hpp"
+
+using xl::Value;
+
+// add(a, b) -> a + b  (ints)
+static Value add(const std::vector<Value>& a) {
+  return Value(a.at(0).as_int() + a.at(1).as_int());
+}
+XL_FUNC(add)
+
+// dot(x, y) -> float64 dot product of two f64 vectors
+static Value dot(const std::vector<Value>& a) {
+  const xl::NdArray& x = a.at(0).as_array();
+  const xl::NdArray& y = a.at(1).as_array();
+  if (x.dtype != xl::DType::F64 || y.dtype != xl::DType::F64)
+    throw std::runtime_error("dot: expects float64 arrays");
+  if (x.size() != y.size())
+    throw std::runtime_error("dot: length mismatch");
+  const double* xp = x.as<double>();
+  const double* yp = y.as<double>();
+  double acc = 0.0;
+  for (size_t k = 0; k < x.size(); ++k) acc += xp[k] * yp[k];
+  return Value(acc);
+}
+XL_FUNC(dot)
+
+// scale(x, s) -> x * s   (returns a new f64 array, same shape)
+static Value scale(const std::vector<Value>& a) {
+  const xl::NdArray& x = a.at(0).as_array();
+  double s = a.at(1).as_float();
+  xl::NdArray out = xl::NdArray::make<double>(xl::DType::F64, x.shape);
+  const double* xp = x.as<double>();
+  double* op = out.mutable_data<double>();
+  for (size_t k = 0; k < x.size(); ++k) op[k] = xp[k] * s;
+  return Value(std::move(out));
+}
+XL_FUNC(scale)
+
+// describe(anything...) -> {"n_args": N, "kinds": [...]} — shows maps/strs
+static Value describe(const std::vector<Value>& a) {
+  xl::List kinds;
+  for (const Value& v : a)
+    kinds.push_back(Value(static_cast<int64_t>(v.kind)));
+  xl::MapItems m;
+  m.emplace_back(Value("n_args"), Value(static_cast<int64_t>(a.size())));
+  m.emplace_back(Value("kinds"), Value(std::move(kinds)));
+  return Value(std::move(m));
+}
+XL_FUNC(describe)
+
+// fail(msg) -> always throws, to exercise error propagation
+static Value fail(const std::vector<Value>& a) {
+  throw std::runtime_error(a.empty() ? "boom" : a[0].as_str());
+}
+XL_FUNC(fail)
+
+// Stateful counter actor: inc(k=1) accumulates, get() reads.
+struct Counter : xl::Actor {
+  long long n = 0;
+  explicit Counter(const std::vector<Value>& a) {
+    if (!a.empty()) n = a[0].as_int();
+  }
+  Value call(const std::string& m, const std::vector<Value>& a) override {
+    if (m == "inc") {
+      n += a.empty() ? 1 : a[0].as_int();
+      return Value(static_cast<int64_t>(n));
+    }
+    if (m == "get") return Value(static_cast<int64_t>(n));
+    throw std::runtime_error("Counter: unknown method " + m);
+  }
+};
+XL_ACTOR(Counter)
+
+// Running mean/variance accumulator over f64 arrays (Welford) — shows
+// array state held across calls on the C++ side.
+struct Stats : xl::Actor {
+  long long count = 0;
+  double mean = 0.0, m2 = 0.0;
+  explicit Stats(const std::vector<Value>&) {}
+  Value call(const std::string& m, const std::vector<Value>& a) override {
+    if (m == "observe") {
+      const xl::NdArray& x = a.at(0).as_array();
+      const double* p = x.as<double>();
+      for (size_t k = 0; k < x.size(); ++k) {
+        ++count;
+        double delta = p[k] - mean;
+        mean += delta / count;
+        m2 += delta * (p[k] - mean);
+      }
+      return Value(static_cast<int64_t>(count));
+    }
+    if (m == "mean") return Value(mean);
+    if (m == "var") return Value(count > 1 ? m2 / (count - 1) : 0.0);
+    throw std::runtime_error("Stats: unknown method " + m);
+  }
+};
+XL_ACTOR(Stats)
+
+XL_MODULE()

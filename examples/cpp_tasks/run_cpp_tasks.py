@@ -1,0 +1,75 @@
+"""Cross-language demo: C++ tasks/actors driven from a Python driver.
+
+Builds examples/cpp_tasks/mathlib.cc with g++, then invokes its functions
+and actors through the ray_tpu runtime (SURVEY C18; reference parity:
+ray.cross_language / the Ray C++ worker API).
+
+Run:  python examples/cpp_tasks/run_cpp_tasks.py
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from ray_tpu.util.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(n_virtual_devices=1)
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu import cross_language as xl  # noqa: E402
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    lib = os.path.join(tempfile.mkdtemp(prefix="xl_"), "libmathlib.so")
+    print("building mathlib.cc ...")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+         "-I", os.path.join(here, "..", "..", "ray_tpu", "_native"),
+         os.path.join(here, "mathlib.cc"), "-o", lib],
+        check=True)
+    print("library manifest:", xl.manifest(lib))
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        add = xl.cpp_function(lib, "add")
+        print("add.remote(2, 3) ->", ray_tpu.get(add.remote(2, 3)))
+
+        dot = xl.cpp_function(lib, "dot")
+        x = np.arange(1024, dtype=np.float64)
+        print("dot(x, x) ->", ray_tpu.get(dot.remote(x, x)),
+              "(numpy:", float(x @ x), ")")
+
+        # C++ task consuming a Python task's ObjectRef, feeding Python:
+        @ray_tpu.remote
+        def make(n):
+            return np.full(n, 2.0)
+
+        scale = xl.cpp_function(lib, "scale")
+        scaled = scale.remote(make.remote(8), 3.0)
+        print("python -> C++ -> python:", ray_tpu.get(scaled))
+
+        Counter = xl.cpp_actor(lib, "Counter", methods=("inc", "get"))
+        c = Counter.remote(100)
+        for _ in range(3):
+            c.inc.remote(7)
+        print("Counter after 3x inc(7):", ray_tpu.get(c.get.remote()))
+
+        Stats = xl.cpp_actor(lib, "Stats", methods=("observe", "mean", "var"))
+        s = Stats.remote()
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            s.observe.remote(rng.standard_normal(1000))
+        print("Stats mean/var over 5000 samples:",
+              ray_tpu.get(s.mean.remote()), ray_tpu.get(s.var.remote()))
+    finally:
+        ray_tpu.shutdown()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
