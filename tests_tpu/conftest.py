@@ -11,4 +11,21 @@ bench.py also runs the same checks as its kernel-smoke phase.
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def pytest_configure(config):
+    # Persistent compilation cache (TPU-only, same dir bench.py uses):
+    # the first full tests_tpu run burned its entire 2400 s sweep budget
+    # on cold Mosaic/XLA compiles (2026-07-31); cached, a rerun is
+    # minutes. CPU is excluded — XLA:CPU AOT entries embed host CPU
+    # features and can SIGILL on a different machine.
+    import jax
+
+    if jax.default_backend() == "tpu":
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           os.path.join(_REPO, ".jax_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
