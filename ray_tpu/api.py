@@ -26,11 +26,16 @@ _init_lock = threading.Lock()
 AUTO_PUT_THRESHOLD = 256 * 1024  # large ndarray args go through the store
 
 
-def init(*, num_cpus=None, num_tpus=None, resources=None,
+def init(*, address=None, num_cpus=None, num_tpus=None, resources=None,
          object_store_memory=None, namespace="default",
          max_workers=None, ignore_reinit_error=True, log_to_driver=True,
          listen=None, **_ignored):
     """Start the ray_tpu runtime in this (driver) process.
+
+    address="ray://host:port" instead connects as a THIN CLIENT to a
+    remote driver hosting a `ray_tpu.client.server.ClientServer`
+    (reference parity: ray.init("ray://...") / python/ray/util/client);
+    every API verb then replays on the remote cluster.
 
     listen="host:port" (port 0 = ephemeral) additionally opens a TCP
     listener so remote hosts can join with
@@ -42,6 +47,26 @@ def init(*, num_cpus=None, num_tpus=None, resources=None,
             if ignore_reinit_error:
                 return runtime_mod.get_runtime()
             raise RuntimeError("ray_tpu.init() already called")
+        if address is not None:
+            if not str(address).startswith("ray://"):
+                raise ValueError(
+                    "init(address=...) expects a 'ray://host:port' client "
+                    "address (start one with ray_tpu.client.server)")
+            sizing = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                      "resources": resources,
+                      "object_store_memory": object_store_memory,
+                      "max_workers": max_workers, "listen": listen}
+            bad = [k for k, v in sizing.items() if v is not None]
+            if bad:
+                raise ValueError(
+                    f"init(address='ray://...') connects to an EXISTING "
+                    f"cluster; cluster-sizing options {bad} don't apply "
+                    f"(reference semantics: ray.init with a ray:// "
+                    f"address rejects local-cluster kwargs)")
+            from .client import ClientRuntime  # noqa: PLC0415
+            crt = ClientRuntime(address, namespace=namespace)
+            runtime_mod.set_runtime(crt)
+            return crt
         rt = DriverRuntime(num_cpus=num_cpus, num_tpus=num_tpus,
                            resources=resources,
                            object_store_memory=object_store_memory,
@@ -252,10 +277,13 @@ def get_actor(name: str, namespace: Optional[str] = None, *,
                 found = (aid, ae.class_name,
                          getattr(ae.create_spec, "method_opts", {}) or {})
         else:
-            # Workers resolve names through the driver's GCS. A worker has
-            # no namespace attribute: send the explicit namespace or None,
-            # and the driver substitutes its own default for None.
-            found = rt.report_sync("sys.lookup_actor", (namespace, name),
+            # Workers and clients resolve names through the driver's GCS.
+            # A worker has no namespace attribute (None -> the driver
+            # substitutes its own default); a ClientRuntime DOES carry the
+            # client's namespace, which must win over the host default.
+            ns_wire = namespace if namespace is not None \
+                else getattr(rt, "namespace", None)
+            found = rt.report_sync("sys.lookup_actor", (ns_wire, name),
                                    timeout=5.0)
         if found is not None:
             return ActorHandle(found[0], found[1],
