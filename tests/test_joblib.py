@@ -1,0 +1,45 @@
+"""joblib backend over the runtime (reference: ray.util.joblib)."""
+import numpy as np
+import pytest
+
+joblib = pytest.importorskip("joblib")
+
+from ray_tpu.util.joblib_backend import register_ray_tpu  # noqa: E402
+
+
+def _square(x):
+    return x * x
+
+
+def _rowsum(arr):
+    return float(arr.sum())
+
+
+def test_joblib_parallel_over_runtime(rt):
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(_square)(i) for i in range(20))
+    assert out == [i * i for i in range(20)]
+
+
+def test_joblib_arrays_and_n_jobs_cap(rt):
+    register_ray_tpu()
+    rows = [np.full(100, i, dtype=np.float64) for i in range(8)]
+    with joblib.parallel_backend("ray_tpu"):
+        # n_jobs=-1 resolves to the cluster CPU count, not local cores
+        out = joblib.Parallel(n_jobs=-1)(
+            joblib.delayed(_rowsum)(r) for r in rows)
+    assert out == [100.0 * i for i in range(8)]
+
+
+def test_joblib_error_propagates(rt):
+    register_ray_tpu()
+
+    def boom(i):
+        raise RuntimeError(f"joblib-boom-{i}")
+
+    with joblib.parallel_backend("ray_tpu"):
+        with pytest.raises(Exception, match="joblib-boom"):
+            joblib.Parallel(n_jobs=2)(
+                joblib.delayed(boom)(i) for i in range(3))
