@@ -1,0 +1,76 @@
+"""Ray-Client demo: a thin remote driver against a separate host process.
+
+Starts a standalone cluster host (`python -m ray_tpu.client.server`) in a
+subprocess, connects with `ray_tpu.init(address="ray://...")`, and drives
+tasks/actors/placement groups from the client side (reference parity:
+ray.init("ray://host:port") / python/ray/util/client).
+
+Run:  python examples/client_remote_driver.py
+"""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ray_tpu.util.jaxenv import force_cpu, subprocess_env_cpu  # noqa: E402
+
+force_cpu(n_virtual_devices=1)
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+def main():
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess_env_cpu(env)
+    host = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.client.server",
+         "--listen", "127.0.0.1:0", "--num-cpus", "4"],
+        env=env, stdout=subprocess.PIPE, text=True)
+    address = host.stdout.readline().strip()
+    print("cluster host at", address)
+
+    ray_tpu.init(address=address)
+    try:
+        @ray_tpu.remote
+        def fold(xs):
+            return float(np.sum(xs))
+
+        parts = [np.arange(i * 100, (i + 1) * 100, dtype=np.float64)
+                 for i in range(8)]
+        total = sum(ray_tpu.get([fold.remote(p) for p in parts]))
+        print("distributed sum:", total, "(expected",
+              float(np.arange(800).sum()), ")")
+
+        @ray_tpu.remote
+        class Board:
+            def __init__(self):
+                self.scores = {}
+
+            def post(self, who, score):
+                self.scores[who] = max(score, self.scores.get(who, 0))
+                return self.scores[who]
+
+            def top(self):
+                return sorted(self.scores.items(),
+                              key=lambda kv: -kv[1])[:3]
+
+        Board.options(name="board").remote()
+        board = ray_tpu.get_actor("board")
+        for who, s in [("ada", 3), ("bob", 7), ("ada", 9), ("cyd", 5)]:
+            board.post.remote(who, s)
+        print("leaderboard:", ray_tpu.get(board.top.remote()))
+        print("cluster resources:", ray_tpu.cluster_resources())
+    finally:
+        ray_tpu.shutdown()      # disconnects the client only
+        host.terminate()
+        host.wait(timeout=10)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
