@@ -188,6 +188,18 @@ def test_client_resources_and_pg(client):
     remove_placement_group(pg)
 
 
+def test_client_data_pipeline(client):
+    """ray_tpu.data pipelines run transparently through the client: map
+    stages and the distributed shuffle submit their tasks over the
+    proxied runtime."""
+    from ray_tpu import data
+
+    ds = data.range(1000).map_batches(lambda b: {"id": b["id"] * 2})
+    assert ds.sum("id") == 2 * sum(range(1000))
+    shuffled = data.range(100).random_shuffle(seed=1)
+    assert sorted(r["id"] for r in shuffled.take_all()) == list(range(100))
+
+
 def test_client_shutdown_reconnect(client):
     """shutdown() disconnects the client but leaves the host up; a new
     init(address=...) reconnects."""
