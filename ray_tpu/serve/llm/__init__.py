@@ -12,6 +12,7 @@ import numpy as np
 
 from ..deployment import Application, deployment_decorator
 from .engine import LLMEngine, LLMEngineConfig
+from .guided import GuidedSpec, TokenFSM, compile_guided
 
 
 class LLMServer:
@@ -140,6 +141,7 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
-__all__ = ["LLMEngine", "LLMEngineConfig", "LLMServer",
+__all__ = ["LLMEngine", "LLMEngineConfig", "GuidedSpec",
+           "TokenFSM", "compile_guided", "LLMServer",
            "build_llm_deployment", "OpenAIServer",
            "build_openai_deployment"]

@@ -122,6 +122,10 @@ class _Request:
     prefill_dispatch_ms: float = 0.0       # host time in the prefill
                                            # call (compile on first use)
     first_token_ts: Optional[float] = None
+    # guided decoding (serve/llm/guided.py): host-side token FSM whose
+    # per-state vocab mask constrains sampling; state advances at emit
+    fsm: Optional[object] = None
+    fsm_state: int = 0
 
 
 _END = ("__end__", None)
@@ -250,6 +254,8 @@ class LLMEngine:
         self._mask_dev = None
         self._temps_dev = None
         self._top_ps_dev = None
+        self._guided_allow_buf = None
+        self._guided_prev = None
         self._mask_dirty = True
         self._shutdown = threading.Event()
         # no "preempted" stat: slots are statically sized for
@@ -335,17 +341,24 @@ class LLMEngine:
             self.precompile()
 
     # ---- jitted kernels ---------------------------------------------------
-    def _sample_tokens(self, logits, temps, top_ps, rng_key):
+    def _sample_tokens(self, logits, temps, top_ps, rng_key, allow=None):
         """Sample per row of logits (N, V): greedy when temp==0, else
         temperature + optional global top-k + per-row nucleus top-p.
         All on device; returns (tokens (N,) int32, logprobs (N,) f32 of
-        the chosen token under the RAW model distribution)."""
+        the chosen token under the RAW model distribution).
+
+        allow (N, V) bool, optional: guided-decoding mask — tokens
+        outside it are impossible under every sampling mode (reported
+        logprobs stay raw-model). None at trace time keeps the
+        unguided compile identical."""
         jnp = self._jnp
         jax = self._jax
         # cfg.logprobs is a plain Python bool at trace time: disabled
         # engines compile WITHOUT the full-vocab log_softmax + gather
         raw_logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
                     if self.cfg.logprobs else None)
+        if allow is not None:
+            logits = jnp.where(allow, logits, -jnp.inf)
         if self.cfg.top_k and self.cfg.top_k > 0:
             kth = jnp.sort(logits, axis=-1)[:, -self.cfg.top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -380,7 +393,7 @@ class LLMEngine:
         return toks, logps
 
     def _prefill_impl(self, params, cache, tokens, slot, true_len, temp,
-                      top_p, rng_key, pad_len: int):
+                      top_p, rng_key, pad_len: int, allow=None):
         """Run the prompt through the model writing KV into `slot`, and
         sample the first generated token ON DEVICE (no host sync).
         tokens: (1, pad_len); returns (token () int32, cache')."""
@@ -404,12 +417,13 @@ class LLMEngine:
             out_cache.append((ck, cv, lens))
         last = logits[0, true_len - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
-                                          top_p[None], rng_key)
+                                          top_p[None], rng_key,
+                                          allow=allow)
         return toks[0], logps[0], out_cache
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start,
                             new_len, temp, top_p, rng_key,
-                            chunk: int, sample: bool):
+                            chunk: int, sample: bool, allow=None):
         """One chunk of a long prompt through the CACHED path: tokens
         (1, chunk) written at positions [start, start+chunk); the slot's
         length becomes `new_len` (start + true tokens in this chunk, so
@@ -442,11 +456,13 @@ class LLMEngine:
             return jnp.int32(0), jnp.float32(0), out_cache
         last = logits[0, new_len - start - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
-                                          top_p[None], rng_key)
+                                          top_p[None], rng_key,
+                                          allow=allow)
         return toks[0], logps[0], out_cache
 
     def _prefill_batch_impl(self, params, cache, tokens, slots, true_lens,
-                            temps, top_ps, rng_key, pad_len: int):
+                            temps, top_ps, rng_key, pad_len: int,
+                            allow=None):
         """Prefill G prompts of one length bucket in a single model pass.
         tokens: (G, pad_len); slots/true_lens/temps: (G,). Padding rows
         target the scratch slot. Returns (tokens (G,) int32, cache')."""
@@ -473,7 +489,8 @@ class LLMEngine:
             lens = lens.at[slots].set(true_lens)
             out_cache.append((ck, cv, lens))
         last = logits[jnp.arange(g), true_lens - 1]          # (G, V)
-        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key)
+        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key,
+                                          allow=allow)
         return toks, logps, out_cache
 
     def _prefix_fill_impl(self, params, prefix_cache, tokens, pid,
@@ -527,7 +544,7 @@ class LLMEngine:
 
     def _prefill_paged_impl(self, params, pools, page_table, lengths,
                             tokens, slots, true_lens, temps, top_ps,
-                            rng_key, pad_len: int):
+                            rng_key, pad_len: int, allow=None):
         """Prefill G prompts (single and batched unified): KV streams
         straight into each slot's pages — no small-cache copy-back.
         tokens: (G, pad_len); slots/true_lens/temps/top_ps: (G,).
@@ -553,12 +570,14 @@ class LLMEngine:
         new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
         lengths = lengths.at[slots].set(true_lens)
         last = logits[jnp.arange(g), true_lens - 1]
-        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key)
+        toks, logps = self._sample_tokens(last, temps, top_ps, rng_key,
+                                          allow=allow)
         return toks, logps, new_pools, lengths
 
     def _chunk_paged_impl(self, params, pools, page_table, lengths,
                           tokens, slot, start, new_len, temp, top_p,
-                          rng_key, chunk: int, sample: bool):
+                          rng_key, chunk: int, sample: bool,
+                          allow=None):
         """One chunk of a long prompt (paged): gathers the slot's full
         page row (start is dynamic, so the attention window cannot be
         statically narrowed the way bucketed prefill narrows it)."""
@@ -579,12 +598,13 @@ class LLMEngine:
             return jnp.int32(0), jnp.float32(0), new_pools, lengths
         last = logits[0, new_len - start - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
-                                          top_p[None], rng_key)
+                                          top_p[None], rng_key,
+                                          allow=allow)
         return toks[0], logps[0], new_pools, lengths
 
     def _decode_paged_impl(self, params, pools, page_table, lengths,
                            last_tokens, active_mask, temps, top_ps,
-                           rng_key, window_pages: int = 0):
+                           rng_key, window_pages: int = 0, allow=None):
         """One decode step for every slot over the page pool. Released
         slots' page-table rows point at the trash page, so their writes
         are inert; inactive lengths are restored so state never
@@ -608,7 +628,8 @@ class LLMEngine:
         new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
         new_lengths = jnp.where(active_mask, new_entries[0].lengths,
                                 lengths)
-        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key)
+        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key,
+                                         allow=allow)
         nxt = jnp.where(active_mask, nxt, last_tokens)
         return nxt, logps, new_pools, new_lengths
 
@@ -648,7 +669,7 @@ class LLMEngine:
         return out
 
     def _decode_impl(self, params, cache, last_tokens, active_mask,
-                     temps, top_ps, rng_key):
+                     temps, top_ps, rng_key, allow=None):
         """One decode step for every slot. Returns (next_tokens (S,),
         cache'). Inactive slots' lengths are restored so their state
         never drifts."""
@@ -664,7 +685,8 @@ class LLMEngine:
         for (ck, cv, lens) in new_cache:
             lens = jnp.where(active_mask, lens, old_lengths)
             fixed.append((ck, cv, lens))
-        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key)
+        nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key,
+                                         allow=allow)
         nxt = jnp.where(active_mask, nxt, last_tokens)
         return nxt, logps, fixed
 
@@ -796,12 +818,32 @@ class LLMEngine:
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_p: float = 1.0,
                stop_token_ids=None,
-               prefix_id: Optional[int] = None) -> str:
+               prefix_id: Optional[int] = None,
+               guided_fsm=None) -> str:
+        """guided_fsm: a serve.llm.guided.TokenFSM constraining this
+        request's output (per-step vocab masks; EOS only at accepting
+        states). Guided traffic decodes synchronously (pipeline drains
+        each step) so the mask can depend on the previous token."""
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if guided_fsm is not None:
+            vs = getattr(getattr(self.model, "cfg", None),
+                         "vocab_size", None)
+            if vs is not None and guided_fsm.vocab_size != vs:
+                raise ValueError(
+                    f"guided_fsm.vocab_size {guided_fsm.vocab_size} != "
+                    f"model vocab_size {vs}")
+            if (self.cfg.eos_token_id is not None
+                    and guided_fsm.eos_id != self.cfg.eos_token_id):
+                raise ValueError(
+                    f"guided_fsm.eos_id {guided_fsm.eos_id} != engine "
+                    f"eos_token_id {self.cfg.eos_token_id}")
+            if not guided_fsm.allowed(guided_fsm.start).any():
+                raise ValueError("guided_fsm allows no token at its "
+                                 "start state (empty language)")
         if prefix_id is not None:
             prefix = self._prefixes.get(prefix_id)
             if prefix is None:
@@ -831,7 +873,10 @@ class LLMEngine:
                        prompt=prompt, max_new_tokens=budget,
                        temperature=temperature, top_p=float(top_p),
                        stop_ids=frozenset(stop_token_ids or ()),
-                       prefix_id=-1 if prefix_id is None else prefix_id)
+                       prefix_id=-1 if prefix_id is None else prefix_id,
+                       fsm=guided_fsm,
+                       fsm_state=(guided_fsm.start
+                                  if guided_fsm is not None else 0))
         with self._lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -958,9 +1003,11 @@ class LLMEngine:
     def generate_sync(self, prompt_ids, max_new_tokens=None,
                       temperature: float = 0.0, top_p: float = 1.0,
                       stop_token_ids=None,
-                      prefix_id: Optional[int] = None) -> List[int]:
+                      prefix_id: Optional[int] = None,
+                      guided_fsm=None) -> List[int]:
         rid = self.submit(prompt_ids, max_new_tokens, temperature,
                           top_p=top_p, stop_token_ids=stop_token_ids,
+                          guided_fsm=guided_fsm,
                           prefix_id=prefix_id)
         return list(self.stream(rid))
 
@@ -1207,24 +1254,29 @@ class LLMEngine:
                     lens[i] = req.prompt.size
                     temps[i] = req.temperature
                     top_ps[i] = req.top_p
+                allow = self._guided_prefill_allow(
+                    [r for r, _ in members], g)
+                kw = {} if allow is None else {"allow": allow}
                 toks_dev, lps_dev, self._pools, self._lengths = \
                     self._prefill_paged_jit(
                         self.params, self._pools, self._page_table,
                         self._lengths, jnp.asarray(tokens),
                         jnp.asarray(slots), jnp.asarray(lens),
                         jnp.asarray(temps), jnp.asarray(top_ps), sub,
-                        pad_len=pad_len)
+                        pad_len=pad_len, **kw)
                 toks_dev = toks_dev[:g_real]
                 lps_dev = lps_dev[:g_real]
             elif g_real == 1 and self.cfg.max_prefill_batch <= 1:
                 req, slot = members[0]
                 tokens = np.zeros((1, pad_len), np.int32)
                 tokens[0, :req.prompt.size] = req.prompt
+                allow = self._guided_prefill_allow([req], 1)
+                kw = {} if allow is None else {"allow": allow}
                 tok_dev, lp_dev, self._cache = self._prefill_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.int32(slot), jnp.int32(req.prompt.size),
                     jnp.float32(req.temperature),
-                    jnp.float32(req.top_p), sub, pad_len=pad_len)
+                    jnp.float32(req.top_p), sub, pad_len=pad_len, **kw)
                 toks_dev, lps_dev = tok_dev[None], lp_dev[None]
             else:
                 g = _next_pow2(g_real)
@@ -1239,11 +1291,14 @@ class LLMEngine:
                     lens[i] = req.prompt.size
                     temps[i] = req.temperature
                     top_ps[i] = req.top_p
+                allow = self._guided_prefill_allow(
+                    [r for r, _ in members], g)
+                kw = {} if allow is None else {"allow": allow}
                 toks_dev, lps_dev, self._cache = self._prefill_batch_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.asarray(slots), jnp.asarray(lens),
                     jnp.asarray(temps), jnp.asarray(top_ps), sub,
-                    pad_len=pad_len)
+                    pad_len=pad_len, **kw)
                 toks_dev = toks_dev[:g_real]
                 lps_dev = lps_dev[:g_real]
             real_slots = jnp.asarray(
@@ -1294,6 +1349,9 @@ class LLMEngine:
         t_dispatch = time.time()
         try:
             self._rng_key, sub = self._jax.random.split(self._rng_key)
+            kw = {}
+            if is_last and req.fsm is not None:
+                kw["allow"] = self._guided_prefill_allow([req], 1)
             if self._paged:
                 tok_dev, lp_dev, self._pools, self._lengths = \
                     self._chunk_paged_jit(
@@ -1303,7 +1361,7 @@ class LLMEngine:
                         jnp.int32(start + true),
                         jnp.float32(req.temperature),
                         jnp.float32(req.top_p), sub, chunk=C,
-                        sample=is_last)
+                        sample=is_last, **kw)
             else:
                 tok_dev, lp_dev, self._cache = self._prefill_chunk_jit(
                     self.params, self._cache, jnp.asarray(tokens),
@@ -1311,7 +1369,7 @@ class LLMEngine:
                     jnp.int32(start + true),
                     jnp.float32(req.temperature),
                     jnp.float32(req.top_p), sub, chunk=C,
-                    sample=is_last)
+                    sample=is_last, **kw)
         except BaseException as e:  # noqa: BLE001
             self._prefilling.popleft()
             self._free_slot_pages(req.slot)
@@ -1364,6 +1422,15 @@ class LLMEngine:
              and tok == self.cfg.eos_token_id)
                 or tok in req.stop_ids):
             req.max_new_tokens = req.generated  # finish after EOS/stop
+        if req.fsm is not None:
+            # guided: advance the automaton; a dead state (can't happen
+            # under the mask, but belt-and-braces) or a completed match
+            # ends the request like EOS
+            req.fsm_state = req.fsm.advance(req.fsm_state, tok)
+            if (req.fsm_state < 0
+                    or req.fsm.is_complete(req.fsm_state)):
+                req.max_new_tokens = min(req.max_new_tokens,
+                                         req.generated)
 
     # ---- page allocator (host side) ---------------------------------------
     def _pages_needed(self, req: _Request) -> int:
@@ -1423,6 +1490,50 @@ class LLMEngine:
                 + max(1, self.cfg.decode_block))
         w = _next_pow2(-(-need // ps))
         return 0 if w >= self._pages_per_slot else w
+
+    def _guided_prefill_allow(self, reqs, g: int):
+        """(g, V) bool mask rows for a prefill group (padding rows all
+        True); None when no member is guided."""
+        fsms = [r.fsm for r in reqs if r.fsm is not None]
+        if not fsms:
+            return None
+        V = fsms[0].vocab_size
+        A = np.ones((g, V), dtype=bool)
+        for i, r in enumerate(reqs):
+            if r.fsm is not None:
+                A[i] = r.fsm.allowed(r.fsm_state)
+        return self._jnp.asarray(A)
+
+    def _guided_decode_allow(self):
+        """(S, V) bool mask over all slots for one decode step; None
+        when no active request is guided (the unguided decode call then
+        stays byte-identical to the ungated build). The host buffer is
+        kept across steps and only rows whose FSM state moved are
+        rewritten — per step the unavoidable cost is the H2D transfer,
+        not a fresh (S, V) allocation + full rebuild."""
+        guided = {slot: r for slot, r in self._active.items()
+                  if r.fsm is not None}
+        if not guided:
+            self._guided_prev = None
+            return None
+        V = next(iter(guided.values())).fsm.vocab_size
+        buf = self._guided_allow_buf
+        prev = self._guided_prev
+        if buf is None or buf.shape != (self._n_slots, V) \
+                or prev is None:
+            buf = self._guided_allow_buf = np.ones(
+                (self._n_slots, V), dtype=bool)
+            prev = {}
+        for slot in [sl for sl in prev if sl not in guided]:
+            buf[slot] = True
+            del prev[slot]
+        for slot, r in guided.items():
+            key = (id(r), r.fsm_state)
+            if prev.get(slot) != key:
+                buf[slot] = r.fsm.allowed(r.fsm_state)
+                prev[slot] = key
+        self._guided_prev = prev
+        return self._jnp.asarray(buf)
 
     def _device_mask_temps(self):
         """(active_mask, temps, top_ps) as device arrays, rebuilt only
@@ -1521,20 +1632,37 @@ class LLMEngine:
                 self._admit_all(inflight)
                 if self._prefilling:
                     self._dispatch_chunk(inflight)
-                if self._active:
+                allow = (self._guided_decode_allow()
+                         if self._active else None)
+                if self._active and (allow is None or not inflight):
+                    # guided traffic with results in flight waits for
+                    # the drain below: the next mask depends on tokens
+                    # the host hasn't seen yet
                     mask, temps, top_ps = self._device_mask_temps()
                     self._rng_key, sub = self._jax.random.split(
                         self._rng_key)
                     snapshot = list(self._active.items())
                     if self._paged:
                         window = self._decode_window_pages()
-                        if self._decode_block_paged_jit is not None:
+                        if self._decode_block_paged_jit is not None \
+                                and allow is None:
                             toks, logps, self._pools, self._lengths, \
                                 last = self._decode_block_paged_jit(
                                     self.params, self._pools,
                                     self._page_table, self._lengths,
                                     self._last_tokens, mask, temps,
                                     top_ps, sub, window_pages=window)
+                            block = max(1, self.cfg.decode_block)
+                        elif allow is not None:
+                            toks, logps, self._pools, self._lengths = \
+                                self._decode_paged_jit(
+                                    self.params, self._pools,
+                                    self._page_table, self._lengths,
+                                    self._last_tokens, mask, temps,
+                                    top_ps, sub, window_pages=window,
+                                    allow=allow)
+                            last = toks
+                            block = 1
                         else:
                             toks, logps, self._pools, self._lengths = \
                                 self._decode_paged_jit(
@@ -1543,19 +1671,25 @@ class LLMEngine:
                                     self._last_tokens, mask, temps,
                                     top_ps, sub, window_pages=window)
                             last = toks
-                        block = max(1, self.cfg.decode_block)
+                            block = 1
                         for slot in self._active:
                             # KeyError here = an admission path forgot
                             # to seed _disp_len; fail loudly — a silent
                             # 0 default would shrink the window and
                             # corrupt KV untraceably
                             self._disp_len[slot] += block
-                    elif self._decode_block_jit is not None:
+                    elif self._decode_block_jit is not None \
+                            and allow is None:
                         toks, logps, self._cache, last = \
                             self._decode_block_jit(
                                 self.params, self._cache,
                                 self._last_tokens, mask, temps, top_ps,
                                 sub)
+                    elif allow is not None:
+                        toks, logps, self._cache = self._decode_jit(
+                            self.params, self._cache, self._last_tokens,
+                            mask, temps, top_ps, sub, allow=allow)
+                        last = toks
                     else:
                         toks, logps, self._cache = self._decode_jit(
                             self.params, self._cache, self._last_tokens,
@@ -1578,6 +1712,8 @@ class LLMEngine:
                 # stay `pipeline_depth` steps ahead while decoding;
                 # drain fully once nothing is active
                 target = self.cfg.pipeline_depth if self._active else 0
+                if allow is not None:
+                    target = 0  # guided: masks need last step's tokens
                 while len(inflight) > target:
                     self._drain_one(inflight)
             except BaseException as e:  # noqa: BLE001  loop must survive

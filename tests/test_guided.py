@@ -1,0 +1,197 @@
+"""Guided-decoding FSM machinery (serve/llm/guided.py): tries, the
+regex->NFA->DFA engine, token-level masks, and EOS semantics."""
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm.guided import GuidedSpec, TokenFSM, compile_guided
+
+EOS = 0
+
+
+def walk(fsm, tokens):
+    s = fsm.start
+    for t in tokens:
+        assert fsm.allowed(s)[t], f"token {t} not allowed at state {s}"
+        s = fsm.advance(s, t)
+        assert s >= 0
+    return s
+
+
+# ------------------------------------------------------------- choices
+
+def test_choice_trie_exact_sequences():
+    fsm = TokenFSM.from_choices([[5, 6], [5, 7, 8], [9]],
+                                vocab_size=16, eos_id=EOS)
+    a0 = fsm.allowed(fsm.start)
+    assert set(np.flatnonzero(a0)) == {5, 9}
+    s = fsm.advance(fsm.start, 5)
+    assert set(np.flatnonzero(fsm.allowed(s))) == {6, 7}
+    s2 = fsm.advance(s, 6)
+    assert fsm.is_accepting(s2)
+    # after a complete choice only EOS remains
+    assert set(np.flatnonzero(fsm.allowed(s2))) == {EOS}
+    assert fsm.is_complete(s2)
+    # EOS at an accepting state stays; elsewhere kills
+    assert fsm.advance(s2, EOS) == s2
+    assert fsm.advance(s, EOS) == -1
+    # diverging off the trie is dead
+    assert fsm.advance(fsm.start, 3) == -1
+
+
+def test_choice_shared_prefix_and_nested_accept():
+    fsm = TokenFSM.from_choices([[1, 2], [1, 2, 3]], vocab_size=8,
+                                eos_id=EOS)
+    s = walk(fsm, [1, 2])
+    assert fsm.is_accepting(s) and not fsm.is_complete(s)
+    assert set(np.flatnonzero(fsm.allowed(s))) == {3, EOS}
+    s3 = fsm.advance(s, 3)
+    assert fsm.is_complete(s3)
+
+
+# --------------------------------------------------------------- regex
+
+def toy_vocab():
+    """token id -> string: 0=EOS(''), 1..9 digits '1'..'9', 10='0',
+    11='abc', 12='a', 13='b', 14='-', 15='.'"""
+    strs = [None, "1", "2", "3", "4", "5", "6", "7", "8", "9", "0",
+            "abc", "a", "b", "-", "."]
+    return strs
+
+
+def test_regex_digit_tokens():
+    fsm = TokenFSM.from_regex(r"[0-9]+", toy_vocab(), eos_id=EOS)
+    a0 = fsm.allowed(fsm.start)
+    assert set(np.flatnonzero(a0)) == set(range(1, 11))  # digits only
+    s = walk(fsm, [1, 10, 5])    # "105"
+    assert fsm.is_accepting(s)
+    assert fsm.allowed(s)[EOS]
+    # '-'/'.'/letters never allowed
+    assert not fsm.allowed(s)[14] and not fsm.allowed(s)[11]
+
+
+def test_regex_multichar_token():
+    fsm = TokenFSM.from_regex(r"abcab?", toy_vocab(), eos_id=EOS)
+    # token 11='abc' consumes three chars at once
+    s = fsm.advance(fsm.start, 11)
+    assert s >= 0
+    s2 = fsm.advance(s, 12)      # 'a'
+    assert fsm.is_accepting(s2)
+    s3 = fsm.advance(s2, 13)     # 'b'
+    assert fsm.is_accepting(s3)
+    assert fsm.is_complete(s3)
+    # 'abc' again would overshoot
+    assert not fsm.allowed(s2)[11]
+
+
+def test_regex_alternation_and_classes():
+    fsm = TokenFSM.from_regex(r"(-|\+)?[0-9]{1,3}(\.[0-9])?",
+                              toy_vocab(), eos_id=EOS)
+    s = walk(fsm, [14, 1, 2])            # "-12"
+    assert fsm.is_accepting(s)
+    s = fsm.advance(s, 3)                # "-123"
+    assert fsm.is_accepting(s)
+    assert not fsm.allowed(s)[4]         # 4th digit illegal
+    s = fsm.advance(s, 15)               # "-123."
+    assert not fsm.is_accepting(s)
+    assert not fsm.allowed(s)[EOS]
+    s = fsm.advance(s, 7)                # "-123.7"
+    assert fsm.is_accepting(s)
+    assert fsm.is_complete(s)
+
+
+def test_regex_star_and_dot():
+    fsm = TokenFSM.from_regex(r"a.*b", toy_vocab(), eos_id=EOS)
+    s = walk(fsm, [12, 1, 14, 13])  # a1-b
+    assert fsm.is_accepting(s)
+    # can continue: ...b again later
+    assert fsm.allowed(s)[13]
+
+
+def test_regex_repetition_lower_bound():
+    """{m} must require exactly m reps — r5 review fix (was off by one:
+    a{2} accepted 'a')."""
+    fsm = TokenFSM.from_regex(r"1{2}", toy_vocab(), eos_id=EOS)
+    s = fsm.advance(fsm.start, 1)
+    assert not fsm.is_accepting(s)          # one '1' is not enough
+    assert not fsm.allowed(s)[EOS]
+    s = fsm.advance(s, 1)
+    assert fsm.is_accepting(s) and fsm.is_complete(s)
+
+    fsm2 = TokenFSM.from_regex(r"1{2,}", toy_vocab(), eos_id=EOS)
+    s = fsm2.advance(fsm2.start, 1)
+    assert not fsm2.is_accepting(s)
+    s = fsm2.advance(s, 1)
+    assert fsm2.is_accepting(s)
+    s = fsm2.advance(s, 1)                  # {2,}: more still legal
+    assert fsm2.is_accepting(s)
+
+    fsm3 = TokenFSM.from_regex(r"1{1,2}", toy_vocab(), eos_id=EOS)
+    assert not fsm3.is_accepting(fsm3.start)  # zero reps illegal
+
+
+def test_regex_whitespace_escapes():
+    """\\n must match a newline, not the letter 'n' (r5 review fix)."""
+    strs = [None, "\n", "n", "\t", "x"]
+    fsm = TokenFSM.from_regex(r"x\nx", strs, eos_id=EOS)
+    s = fsm.advance(fsm.start, 4)       # 'x'
+    assert fsm.allowed(s)[1]            # newline token legal
+    assert not fsm.allowed(s)[2]        # letter 'n' is NOT
+    s = fsm.advance(s, 1)
+    s = fsm.advance(s, 4)
+    assert fsm.is_complete(s)
+    # negated class \D
+    fsm2 = TokenFSM.from_regex(r"\D", [None, "5", "n"], eos_id=EOS)
+    assert not fsm2.allowed(fsm2.start)[1]
+    assert fsm2.allowed(fsm2.start)[2]
+
+
+def test_regex_rejects_bad_pattern():
+    with pytest.raises(ValueError):
+        TokenFSM.from_regex(r"(unclosed", toy_vocab(), eos_id=EOS)
+    with pytest.raises(ValueError):
+        TokenFSM.from_regex(r"[unclosed", toy_vocab(), eos_id=EOS)
+
+
+def test_greedy_walk_never_leaves_language():
+    """A greedy decoder restricted by the mask always ends in the
+    language: simulate with random logits over many seeds."""
+    fsm = TokenFSM.from_regex(r"[0-9]{2,4}", toy_vocab(), eos_id=EOS)
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        s = fsm.start
+        text = []
+        for _step in range(8):
+            mask = fsm.allowed(s)
+            assert mask.any()
+            logits = rng.standard_normal(fsm.vocab_size)
+            logits[~mask] = -np.inf
+            tok = int(np.argmax(logits))
+            if tok == EOS:
+                break
+            text.append(tok)
+            s = fsm.advance(s, tok)
+        assert fsm.is_accepting(s)
+        assert 2 <= len(text) <= 4
+
+
+# --------------------------------------------------------- compile API
+
+def test_compile_guided_choices_with_tokenize():
+    spec = GuidedSpec(choices=["ab", "ba"])
+    fsm = compile_guided(spec, vocab_size=8, eos_id=EOS,
+                         tokenize=lambda s: [{"a": 1, "b": 2}[c]
+                                             for c in s])
+    assert set(np.flatnonzero(fsm.allowed(fsm.start))) == {1, 2}
+    s = walk(fsm, [1, 2])
+    assert fsm.is_complete(s)
+
+
+def test_compile_guided_validation():
+    with pytest.raises(ValueError):
+        GuidedSpec()
+    with pytest.raises(ValueError):
+        GuidedSpec(choices=["a"], regex="b")
+    with pytest.raises(ValueError, match="token_strings"):
+        compile_guided(GuidedSpec(regex="a"), vocab_size=4, eos_id=EOS)
+    with pytest.raises(ValueError, match="tokenize"):
+        compile_guided(GuidedSpec(choices=["a"]), vocab_size=4, eos_id=EOS)
