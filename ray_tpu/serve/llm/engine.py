@@ -1644,6 +1644,7 @@ class LLMEngine:
                     snapshot = list(self._active.items())
                     if self._paged:
                         window = self._decode_window_pages()
+                        akw = {} if allow is None else {"allow": allow}
                         if self._decode_block_paged_jit is not None \
                                 and allow is None:
                             toks, logps, self._pools, self._lengths, \
@@ -1653,23 +1654,14 @@ class LLMEngine:
                                     self._last_tokens, mask, temps,
                                     top_ps, sub, window_pages=window)
                             block = max(1, self.cfg.decode_block)
-                        elif allow is not None:
-                            toks, logps, self._pools, self._lengths = \
-                                self._decode_paged_jit(
-                                    self.params, self._pools,
-                                    self._page_table, self._lengths,
-                                    self._last_tokens, mask, temps,
-                                    top_ps, sub, window_pages=window,
-                                    allow=allow)
-                            last = toks
-                            block = 1
                         else:
                             toks, logps, self._pools, self._lengths = \
                                 self._decode_paged_jit(
                                     self.params, self._pools,
                                     self._page_table, self._lengths,
                                     self._last_tokens, mask, temps,
-                                    top_ps, sub, window_pages=window)
+                                    top_ps, sub, window_pages=window,
+                                    **akw)
                             last = toks
                             block = 1
                         for slot in self._active:
@@ -1685,15 +1677,12 @@ class LLMEngine:
                                 self.params, self._cache,
                                 self._last_tokens, mask, temps, top_ps,
                                 sub)
-                    elif allow is not None:
-                        toks, logps, self._cache = self._decode_jit(
-                            self.params, self._cache, self._last_tokens,
-                            mask, temps, top_ps, sub, allow=allow)
-                        last = toks
                     else:
                         toks, logps, self._cache = self._decode_jit(
                             self.params, self._cache, self._last_tokens,
-                            mask, temps, top_ps, sub)
+                            mask, temps, top_ps, sub,
+                            **({} if allow is None
+                               else {"allow": allow}))
                         last = toks
                     self._last_tokens = last
                     self._start_fetch(toks)

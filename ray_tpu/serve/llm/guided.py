@@ -64,11 +64,12 @@ def _charclass(expr: str, i: int) -> Tuple[np.ndarray, int]:
         first = False
         if expr[i] == "\\" and i + 1 < len(expr):
             nc = expr[i + 1]
-            if nc in "dws":
+            if nc in "dwsDWS":
                 mask |= _escape_set(nc)
                 i += 2
                 continue
-            lo = ord(nc)
+            # single-char escapes (\n, \t, ...) may still anchor a range
+            lo = ord(_ESCAPE_CHARS.get(nc, nc))
             i += 2
         else:
             lo = ord(expr[i])
@@ -154,8 +155,16 @@ class _RegexParser:
 
     def _repeat(self) -> Tuple[int, int]:
         frag = self._atom()
+        first = True
         while self.i < len(self.expr) and self.expr[self.i] in "*+?{":
             c = self.expr[self.i]
+            if c == "?" and not first:
+                # lazy-quantifier marker (X+?, X{m,n}?): laziness picks
+                # a different match, not a different LANGUAGE — for a
+                # fullmatch automaton it is a no-op, NOT (X+)?
+                self.i += 1
+                continue
+            first = False
             if c == "{":
                 j = self.expr.index("}", self.i)
                 body = self.expr[self.i + 1:j]
@@ -227,6 +236,12 @@ class _RegexParser:
     def _atom(self) -> Tuple[int, int]:
         expr = self.expr
         c = expr[self.i]
+        if c in "^$":
+            # the automaton always fullmatches, so anchors are no-ops
+            # (outlines/vLLM-style patterns commonly include them)
+            self.i += 1
+            st = self.nfa.new_state()
+            return st, st
         if c == "(":
             self.i += 1
             frag = self._alternation()

@@ -37,6 +37,8 @@ class OpenAIServer(LLMServer):
                  model_name: str = "ray-tpu-llm"):
         super().__init__(model_factory, engine_config, tokenizer,
                          cached_prefixes=cached_prefixes)
+        self._token_strings = None
+        self._fsm_cache: Dict[Any, Any] = {}
         self.model_name = model_name
 
     # ---- request plumbing -------------------------------------------------
@@ -69,7 +71,59 @@ class OpenAIServer(LLMServer):
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
             stop_token_ids=stop_ids or None)
+        fsm = self._guided_fsm(body)
+        if fsm is not None:
+            kwargs["guided_fsm"] = fsm
         return kwargs, stop_strings, effective
+
+    def _guided_fsm(self, body: Dict[str, Any]):
+        """vLLM-style guided output: `guided_choice` (list of strings)
+        or `guided_regex` (pattern over the detokenized output) compile
+        to a serve.llm.guided.TokenFSM using this server's tokenizer
+        (reference: the vLLM/outlines guided-output API the fork's
+        serving north star exposes)."""
+        choice = body.get("guided_choice")
+        regex = body.get("guided_regex")
+        if not choice and not regex:
+            return None
+        if choice and regex:
+            raise ValueError("use guided_choice OR guided_regex, "
+                             "not both")
+        if self.tokenizer is None:
+            raise ValueError("guided output needs a tokenizer "
+                             "(set tokenizer= on the deployment)")
+        from .guided import GuidedSpec, compile_guided
+        vs = int(self.engine.model.cfg.vocab_size)
+        eos = self.engine.cfg.eos_token_id
+        eos = vs if eos is None else int(eos)  # >=V: eos never unmasked
+        key = (("choice", tuple(choice)) if choice
+               else ("regex", regex)) + (vs, eos)
+        fsm = self._fsm_cache.get(key)
+        if fsm is not None:
+            return fsm
+        if choice:
+            def tokenize(text):
+                try:
+                    return self.tokenizer.encode(
+                        text, add_special_tokens=False)
+                except TypeError:
+                    return self.tokenizer.encode(text)
+            fsm = compile_guided(GuidedSpec(choices=list(choice)),
+                                 vocab_size=vs, eos_id=eos,
+                                 tokenize=tokenize)
+        else:
+            if self._token_strings is None:
+                # one-time: text each token id appends (decode([i]) is
+                # the standard byte-level approximation)
+                self._token_strings = [
+                    self.tokenizer.decode([i]) for i in range(vs)]
+            fsm = compile_guided(GuidedSpec(regex=regex), vocab_size=vs,
+                                 eos_id=eos,
+                                 token_strings=self._token_strings)
+        if len(self._fsm_cache) >= 64:  # bounded: drop oldest pattern
+            self._fsm_cache.pop(next(iter(self._fsm_cache)))
+        self._fsm_cache[key] = fsm
+        return fsm
 
     def _chat_prompt(self, messages: List[Dict[str, str]]):
         tok = self.tokenizer

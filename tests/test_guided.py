@@ -145,6 +145,36 @@ def test_regex_whitespace_escapes():
     assert fsm2.allowed(fsm2.start)[2]
 
 
+def test_regex_class_escapes_and_anchors():
+    """[\\n] matches newline (not 'n'), [\\D] negates digits inside a
+    class, and ^...$ anchors are fullmatch no-ops (r5 review fixes)."""
+    strs = [None, "\n", "n", "5", "x"]
+    fsm = TokenFSM.from_regex(r"[\n]", strs, eos_id=EOS)
+    assert fsm.allowed(fsm.start)[1] and not fsm.allowed(fsm.start)[2]
+    fsm2 = TokenFSM.from_regex(r"[\D]", strs, eos_id=EOS)
+    assert not fsm2.allowed(fsm2.start)[3]
+    assert fsm2.allowed(fsm2.start)[2] and fsm2.allowed(fsm2.start)[4]
+    # anchored pattern == unanchored (the common outlines style)
+    fsm3 = TokenFSM.from_regex(r"^[0-9]+$", toy_vocab(), eos_id=EOS)
+    assert set(np.flatnonzero(fsm3.allowed(fsm3.start))) \
+        == set(range(1, 11))
+
+
+def test_regex_lazy_quantifiers_same_language():
+    """X+? / X{m,n}? constrain the MATCH, not the language — the empty
+    string must stay illegal for +? (r5 review fix)."""
+    fsm = TokenFSM.from_regex(r"[1-9]+?", toy_vocab(), eos_id=EOS)
+    assert not fsm.is_accepting(fsm.start)
+    assert not fsm.allowed(fsm.start)[EOS]
+    s = fsm.advance(fsm.start, 3)
+    assert fsm.is_accepting(s)
+    fsm2 = TokenFSM.from_regex(r"1{2,3}?", toy_vocab(), eos_id=EOS)
+    s = fsm2.advance(fsm2.start, 1)
+    assert not fsm2.is_accepting(s)
+    s = fsm2.advance(s, 1)
+    assert fsm2.is_accepting(s)
+
+
 def test_regex_rejects_bad_pattern():
     with pytest.raises(ValueError):
         TokenFSM.from_regex(r"(unclosed", toy_vocab(), eos_id=EOS)

@@ -283,3 +283,37 @@ def test_cached_prefix_served_identically(rt):
         len(tok.encode(full_prompt))
     serve.delete("prefix-app")
     serve.delete("plain-app")
+
+
+def test_guided_choice_over_api(openai_app):
+    """vLLM-style guided_choice: the completion text is exactly one of
+    the allowed strings (tokenized with the server's tokenizer)."""
+    port = openai_app
+    with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 8,
+                      "guided_choice": ["AB", "XY"]}) as r:
+        out = json.loads(r.read())
+    text = out["choices"][0]["text"]
+    # DummyTok: encode maps chars to ids, decode maps id t->chr(32+t%90)
+    assert text in ("ab", "xy"), text
+
+
+def test_guided_regex_over_api(openai_app):
+    """guided_regex constrains the detokenized output to the pattern."""
+    import re
+    port = openai_app
+    with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 8,
+                      "guided_regex": "[0-9]{2}"}) as r:
+        out = json.loads(r.read())
+    text = out["choices"][0]["text"]
+    assert re.fullmatch(r"[0-9]{2}", text), text
+
+
+def test_guided_validation_over_api(openai_app):
+    """Conflicting guided params come back as an OpenAI error object
+    (invalid_request_error), matching the server's error contract."""
+    port = openai_app
+    with _post(port, {"prompt": [1, 2], "guided_choice": ["A"],
+                      "guided_regex": "x"}) as r:
+        out = json.loads(r.read())
+    assert out["error"]["type"] == "invalid_request_error"
+    assert "guided_choice OR guided_regex" in out["error"]["message"]
