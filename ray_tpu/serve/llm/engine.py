@@ -100,6 +100,21 @@ class LLMEngineConfig:
     # Total pool budget in KV tokens (rounded up to whole pages).
     # 0 = max_slots * max_seq_len (same HBM as the legacy layout).
     kv_pool_tokens: int = 0
+    # n-gram (prompt-lookup) speculative decoding: propose K tokens per
+    # step by matching the trailing `ngram_order`-gram against the
+    # request's own prompt+generation history, verify all K in ONE
+    # forward (in-jit prefix acceptance), emit 1..K+1 tokens per
+    # dispatch. Decode is weight-bandwidth-bound, so accepted tokens
+    # amortize a full weight read — repetitive text (summaries, code,
+    # RAG) decodes up to (1+K)x faster. Greedy (temp==0), non-guided
+    # requests only; output is token-identical to plain decode.
+    # Speculative traffic steps synchronously (proposals need the
+    # previous step's tokens). 0 disables.
+    ngram_speculation: int = 0
+    ngram_order: int = 2
+    # proposal lookback window (tokens of trailing history searched per
+    # step, vLLM prompt-lookup style) — bounds host work per step
+    ngram_lookback: int = 256
 
 
 @dataclass
@@ -126,6 +141,9 @@ class _Request:
     # per-state vocab mask constrains sampling; state advances at emit
     fsm: Optional[object] = None
     fsm_state: int = 0
+    # n-gram speculation: prompt+generated history (proposal source);
+    # None when this request is ineligible (sampled/guided)
+    hist: Optional[list] = None
 
 
 _END = ("__end__", None)
@@ -256,6 +274,8 @@ class LLMEngine:
         self._top_ps_dev = None
         self._guided_allow_buf = None
         self._guided_prev = None
+        self._spec_idle = 0
+        self._spec_retry = 0
         self._mask_dirty = True
         self._shutdown = threading.Event()
         # no "preempted" stat: slots are statically sized for
@@ -305,6 +325,7 @@ class LLMEngine:
             self._prefill_batch_impl, static_argnames=("pad_len",),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._verify_jit = jax.jit(self._verify_impl, donate_argnums=(1,))
         self._decode_block_jit = (
             jax.jit(self._decode_block_impl, donate_argnums=(1,))
             if cfg.decode_block > 1 else None)
@@ -317,6 +338,9 @@ class LLMEngine:
                 static_argnames=("chunk", "sample"), donate_argnums=(1, 3))
             self._decode_paged_jit = jax.jit(
                 self._decode_paged_impl, donate_argnums=(1, 3),
+                static_argnames=("window_pages",))
+            self._verify_paged_jit = jax.jit(
+                self._verify_paged_impl, donate_argnums=(1, 3),
                 static_argnames=("window_pages",))
             self._decode_block_paged_jit = (
                 jax.jit(self._decode_block_paged_impl,
@@ -668,6 +692,86 @@ class LLMEngine:
             out.append((k, v))
         return out
 
+    def _verify_impl(self, params, cache, last_tokens, proposals,
+                     active_mask, temps, top_ps, rng_key):
+        """n-gram speculation verify (contiguous cache): ONE forward of
+        [last, p1..pK] per slot; in-jit greedy prefix acceptance.
+        proposals (S, K) int32, -1 = no proposal at that offset.
+        Returns (out (S, K+1), n_emit (S,), logps (S, K+1), cache',
+        last') — emit out[s, :n_emit[s]]. Rejected positions' KV is
+        invisible (attention masks by length) and overwritten by later
+        writes at the same positions."""
+        jnp = self._jnp
+        jax = self._jax
+        K = proposals.shape[1]
+        old_lengths = cache[0][2]
+        toks_in = jnp.concatenate(
+            [last_tokens[:, None], jnp.maximum(proposals, 0)], axis=1)
+        positions = old_lengths[:, None] + jnp.arange(K + 1)[None, :]
+        logits, new_cache = self.model.apply(
+            {"params": params}, toks_in, cache=cache,
+            positions=positions)                       # (S, K+1, V)
+        out, n_emit, logps, last = self._verify_accept(
+            logits, proposals, last_tokens, active_mask, temps, top_ps,
+            rng_key)
+        new_len = old_lengths + n_emit
+        fixed = [(ck, cv, new_len) for (ck, cv, _l) in new_cache]
+        return out, n_emit, logps, fixed, last
+
+    def _verify_paged_impl(self, params, pools, page_table, lengths,
+                           last_tokens, proposals, active_mask, temps,
+                           top_ps, rng_key, window_pages: int = 0):
+        """n-gram speculation verify over the page pool (see
+        _verify_impl). Accepted tokens always land in reserved pages
+        (acceptance <= remaining budget); overshoot writes may hit the
+        trash page, which is by-construction inert."""
+        jnp = self._jnp
+        K = proposals.shape[1]
+        if window_pages and window_pages < page_table.shape[1]:
+            page_table = page_table[:, :window_pages]
+        entries = self._paged_entries(pools, page_table, lengths)
+        toks_in = jnp.concatenate(
+            [last_tokens[:, None], jnp.maximum(proposals, 0)], axis=1)
+        positions = lengths[:, None] + jnp.arange(K + 1)[None, :]
+        logits, new_entries = self.model.apply(
+            {"params": params}, toks_in, cache=entries,
+            positions=positions)
+        new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
+        out, n_emit, logps, last = self._verify_accept(
+            logits, proposals, last_tokens, active_mask, temps, top_ps,
+            rng_key)
+        new_lengths = lengths + n_emit
+        return out, n_emit, logps, new_pools, new_lengths, last
+
+    def _verify_accept(self, logits, proposals, last_tokens, active_mask,
+                       temps, top_ps, rng_key):
+        """Shared in-jit acceptance: greedy chain for speculating rows,
+        normal sampling (position 0 only) for sampled rows."""
+        jnp = self._jnp
+        jax = self._jax
+        K = proposals.shape[1]
+        S = logits.shape[0]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S,K+1)
+        match = (proposals == greedy[:, :K]) & (proposals >= 0)
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        m = acc.sum(axis=1)                                     # (S,)
+        out0, lp0 = self._sample_tokens(logits[:, 0], temps, top_ps,
+                                        rng_key)
+        out = greedy.at[:, 0].set(out0)  # _sample_tokens is greedy at
+        #                                  temp==0, so this is uniform
+        if self.cfg.logprobs:
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logps = jnp.take_along_axis(
+                lsm, out[..., None].astype(jnp.int32), -1)[..., 0]
+            logps = logps.at[:, 0].set(lp0)
+        else:
+            logps = jnp.zeros(out.shape, jnp.float32)
+        n_emit = jnp.where(temps > 0, 1, m + 1)
+        n_emit = jnp.where(active_mask, n_emit, 0).astype(jnp.int32)
+        last = out[jnp.arange(S), jnp.maximum(n_emit - 1, 0)]
+        last = jnp.where(active_mask, last, last_tokens)
+        return out, n_emit, logps, last
+
     def _decode_impl(self, params, cache, last_tokens, active_mask,
                      temps, top_ps, rng_key, allow=None):
         """One decode step for every slot. Returns (next_tokens (S,),
@@ -876,7 +980,11 @@ class LLMEngine:
                        prefix_id=-1 if prefix_id is None else prefix_id,
                        fsm=guided_fsm,
                        fsm_state=(guided_fsm.start
-                                  if guided_fsm is not None else 0))
+                                  if guided_fsm is not None else 0),
+                       hist=(list(map(int, prompt))
+                             if (self.cfg.ngram_speculation > 0
+                                 and temperature == 0.0
+                                 and guided_fsm is None) else None))
         with self._lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -1417,6 +1525,8 @@ class LLMEngine:
                 "emit_ms": max(0.0, (now - admit) * 1000
                                - req.prefill_dispatch_ms),
                 "total_ms": (now - req.submit_ts) * 1000})
+        if req.hist is not None:
+            req.hist.append(tok)
         req.out_queue.put(("token", (tok, logp)))
         if ((self.cfg.eos_token_id is not None
              and tok == self.cfg.eos_token_id)
@@ -1491,6 +1601,23 @@ class LLMEngine:
         w = _next_pow2(-(-need // ps))
         return 0 if w >= self._pages_per_slot else w
 
+    def _propose_ngram(self, req) -> "Optional[List[int]]":
+        """Prompt-lookup proposal: the K tokens that followed the most
+        recent earlier occurrence of the trailing `ngram_order`-gram in
+        this request's own history. None = no match (plain decode)."""
+        k = self.cfg.ngram_speculation
+        g = max(1, self.cfg.ngram_order)
+        h = req.hist
+        if h is None or len(h) < g + 1:
+            return None
+        key = h[-g:]
+        lo = max(0, len(h) - g - 1 - max(g + 1, self.cfg.ngram_lookback))
+        for i in range(len(h) - g - 1, lo - 1, -1):
+            if h[i:i + g] == key:
+                prop = h[i + g:i + g + k]
+                return prop or None
+        return None
+
     def _guided_prefill_allow(self, reqs, g: int):
         """(g, V) bool mask rows for a prefill group (padding rows all
         True); None when no member is guided."""
@@ -1535,6 +1662,50 @@ class LLMEngine:
         self._guided_prev = prev
         return self._jnp.asarray(buf)
 
+    def _spec_plan(self):
+        """(proposals (S, K) int32 device array, host counts) for one
+        speculative verify step, or None when no active slot proposes
+        anything or any slot is too close to max_seq_len (the verify
+        forward writes K+1 positions). Spec-eligible requests exist
+        only when cfg.ngram_speculation > 0 (req.hist gating)."""
+        k = self.cfg.ngram_speculation
+        if not k:
+            return None
+        eligible = [(slot, r) for slot, r in self._active.items()
+                    if r.hist is not None]
+        if not eligible:
+            return None
+        props = np.full((self._n_slots, k), -1, np.int32)
+        any_prop = False
+        for slot, r in self._active.items():
+            if r.prompt.size + r.generated + k + 1 > self.cfg.max_seq_len:
+                return None  # one overlong slot vetoes the step
+        for slot, r in eligible:
+            p = self._propose_ngram(r)
+            if p:
+                props[slot, :len(p)] = p
+                any_prop = True
+        if not any_prop:
+            self._spec_idle += 1
+            return None
+        self._spec_idle = 0
+        return self._jnp.asarray(props)
+
+    def _spec_sync_active(self) -> bool:
+        """True when speculation wants synchronous stepping (any
+        spec-eligible active request): proposals derive from tokens the
+        host must have seen."""
+        if not self.cfg.ngram_speculation or not any(
+                r.hist is not None for r in self._active.values()):
+            return False
+        # backoff: after 8 consecutive no-proposal steps fall back to
+        # pipelined plain decode (sync-only costs throughput for
+        # nothing); periodically re-probe in case repetition develops
+        self._spec_retry = (self._spec_retry + 1) % 64
+        if self._spec_retry == 0:
+            self._spec_idle = 0
+        return self._spec_idle < 8
+
     def _device_mask_temps(self):
         """(active_mask, temps, top_ps) as device arrays, rebuilt only
         when the active set changed — not every step."""
@@ -1553,12 +1724,59 @@ class LLMEngine:
             self._mask_dirty = False
         return self._mask_dev, self._temps_dev, self._top_ps_dev
 
+    def _drain_verify(self, snapshot, out_dev, ne_lp):
+        """Emit a speculative verify step's 1..K+1 tokens per slot.
+        Host emission may stop early (EOS / budget) — those requests
+        release immediately, so the device-side length overshoot is
+        moot."""
+        ne_dev, lp_dev = ne_lp
+        try:
+            out = np.asarray(out_dev)
+            n_emit = np.asarray(ne_dev)
+            lps = np.asarray(lp_dev) if lp_dev is not None else None
+        except BaseException as e:  # noqa: BLE001
+            for slot, req in snapshot:
+                if req.slot == slot:
+                    req.out_queue.put(("error", e))
+                    self._release(req)
+            return
+        self.stats["decode_steps"] += 1
+        for slot, req in snapshot:
+            if req.slot != slot:
+                continue  # released/reused slot
+            if req.generated >= req.max_new_tokens:
+                self._release(req)
+                continue
+            n = int(n_emit[slot])
+            emitted = 0
+            for j in range(n):
+                if req.generated >= req.max_new_tokens:
+                    break
+                self._emit(req, int(out[slot, j]),
+                           float(lps[slot, j]) if lps is not None
+                           else None)
+                emitted += 1
+            self.stats["spec_accepted"] = (
+                self.stats.get("spec_accepted", 0) + max(0, emitted - 1))
+            if self._paged and req.slot == slot \
+                    and slot in self._disp_len:
+                # resync the window mirror to the true length (the
+                # dispatch bumped it by the K+1 upper bound)
+                self._disp_len[slot] = req.prompt.size + req.generated
+            full = (req.prompt.size + req.generated
+                    >= self.cfg.max_seq_len)
+            if req.generated >= req.max_new_tokens or full:
+                self._release(req)
+
     def _drain_one(self, inflight):
         """Fetch the oldest in-flight result and emit its tokens.
         Termination/EOS checks happen here, `pipeline_depth` steps behind
         dispatch; lagged tokens for finished/reused slots are discarded
         by the (req.slot == slot, generated < budget) guards."""
         kind, payload, arr, lp_arr = inflight.popleft()
+        if kind == "verify":
+            self._drain_verify(payload, arr, lp_arr)
+            return
         try:
             host = np.asarray(arr)
             lps = np.asarray(lp_arr) if lp_arr is not None else None
@@ -1634,7 +1852,9 @@ class LLMEngine:
                     self._dispatch_chunk(inflight)
                 allow = (self._guided_decode_allow()
                          if self._active else None)
-                if self._active and (allow is None or not inflight):
+                spec_sync = self._active and self._spec_sync_active()
+                need_sync = allow is not None or spec_sync
+                if self._active and (not need_sync or not inflight):
                     # guided traffic with results in flight waits for
                     # the drain below: the next mask depends on tokens
                     # the host hasn't seen yet
@@ -1642,7 +1862,40 @@ class LLMEngine:
                     self._rng_key, sub = self._jax.random.split(
                         self._rng_key)
                     snapshot = list(self._active.items())
-                    if self._paged:
+                    props = (self._spec_plan()
+                             if spec_sync and allow is None else None)
+                    if props is not None:
+                        K = self.cfg.ngram_speculation
+                        if self._paged:
+                            for slot in self._active:
+                                self._disp_len[slot] += K + 1
+                            window = self._decode_window_pages()
+                            out, n_emit, logps, self._pools, \
+                                self._lengths, last = \
+                                self._verify_paged_jit(
+                                    self.params, self._pools,
+                                    self._page_table, self._lengths,
+                                    self._last_tokens, props, mask,
+                                    temps, top_ps, sub,
+                                    window_pages=window)
+                        else:
+                            out, n_emit, logps, self._cache, last = \
+                                self._verify_jit(
+                                    self.params, self._cache,
+                                    self._last_tokens, props, mask,
+                                    temps, top_ps, sub)
+                        self._last_tokens = last
+                        self._start_fetch(out)
+                        self._start_fetch(n_emit)
+                        if self.cfg.logprobs:
+                            self._start_fetch(logps)
+                        self.stats["spec_steps"] = \
+                            self.stats.get("spec_steps", 0) + 1
+                        inflight.append(
+                            ("verify", snapshot, out,
+                             (n_emit, logps if self.cfg.logprobs
+                              else None)))
+                    elif self._paged:
                         window = self._decode_window_pages()
                         akw = {} if allow is None else {"allow": allow}
                         if self._decode_block_paged_jit is not None \
@@ -1684,13 +1937,14 @@ class LLMEngine:
                             **({} if allow is None
                                else {"allow": allow}))
                         last = toks
-                    self._last_tokens = last
-                    self._start_fetch(toks)
-                    if self.cfg.logprobs:
-                        self._start_fetch(logps)
-                    inflight.append(("decode", snapshot, toks,
-                                     logps if self.cfg.logprobs
-                                     else None))
+                    if props is None:
+                        self._last_tokens = last
+                        self._start_fetch(toks)
+                        if self.cfg.logprobs:
+                            self._start_fetch(logps)
+                        inflight.append(("decode", snapshot, toks,
+                                         logps if self.cfg.logprobs
+                                         else None))
                 self._m_active.set(float(len(self._active)),
                                    tags=self._mtags)
                 self._m_waiting.set(float(self._waiting.qsize()),
@@ -1701,8 +1955,9 @@ class LLMEngine:
                 # stay `pipeline_depth` steps ahead while decoding;
                 # drain fully once nothing is active
                 target = self.cfg.pipeline_depth if self._active else 0
-                if allow is not None:
-                    target = 0  # guided: masks need last step's tokens
+                if allow is not None or spec_sync:
+                    target = 0  # guided masks / n-gram proposals need
+                    #             the previous step's tokens on host
                 while len(inflight) > target:
                     self._drain_one(inflight)
             except BaseException as e:  # noqa: BLE001  loop must survive
