@@ -203,43 +203,76 @@ class OpenAIServer(LLMServer):
             return err
         return super().__call__(body)
 
+    @staticmethod
+    def _n_choices(body: Dict[str, Any]) -> int:
+        raw = body.get("n")
+        n = 1 if raw is None else int(raw)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        best_of = body.get("best_of")
+        if best_of is not None and int(best_of) != n:
+            raise ValueError("best_of != n is not supported")
+        if body.get("stream") and n > 1:
+            raise ValueError("streaming with n > 1 is not supported")
+        return n
+
     def _completions(self, body: Dict[str, Any]):
         prompt = self._encode(body["prompt"])
         sp, stops, effective = self._sampling(body, len(prompt))
         suffix, prefix_id = self._match_prefix(prompt)
-        rid = self.engine.submit(suffix, prefix_id=prefix_id, **sp)
+        n = self._n_choices(body)
+        # all n submits enter the engine together and continuous-batch
+        rids = [self.engine.submit(suffix, prefix_id=prefix_id, **sp)
+                for _ in range(n)]
         oid = f"cmpl-{next(_req_ids)}"
         if body.get("stream"):
             return self._stream_events(
-                rid, oid, "text_completion", stops, effective,
+                rids[0], oid, "text_completion", stops, effective,
                 sp["stop_token_ids"],
                 content_chunk=lambda text: {"text": text},
                 final_extra=lambda: {"text": ""})
-        toks, lps, text, by_string = self._collect(rid, stops)
-        logprobs = None
-        if body.get("logprobs") and any(lp is not None for lp in lps):
-            logprobs = {
-                "tokens": [self._decode_text([t]) for t in toks],
-                "token_logprobs": lps,
-                "top_logprobs": None, "text_offset": None}
-        return {
-            "id": oid, "object": "text_completion",
-            "created": int(time.time()), "model": self.model_name,
-            "choices": [{
-                "index": 0, "text": text,
+        choices = []
+        total_out = 0
+        try:
+            collected = [self._collect(rid, stops) for rid in rids]
+        except BaseException:
+            for r in rids:  # don't strand sibling choices on the engine
+                try:
+                    self.engine.abort(r)
+                except Exception:
+                    pass
+            raise
+        for idx, (toks, lps, text, by_string) in enumerate(collected):
+            total_out += len(toks)
+            logprobs = None
+            if body.get("logprobs") and any(lp is not None
+                                            for lp in lps):
+                logprobs = {
+                    "tokens": [self._decode_text([t]) for t in toks],
+                    "token_logprobs": lps,
+                    "top_logprobs": None, "text_offset": None}
+            choices.append({
+                "index": idx, "text": text,
                 "finish_reason": self._finish_reason(
                     len(toks), effective, toks[-1] if toks else None,
                     sp["stop_token_ids"], by_string),
-                "logprobs": logprobs}],
+                "logprobs": logprobs})
+        return {
+            "id": oid, "object": "text_completion",
+            "created": int(time.time()), "model": self.model_name,
+            "choices": choices,
             "usage": {"prompt_tokens": len(prompt),
-                      "completion_tokens": len(toks),
-                      "total_tokens": len(prompt) + len(toks)}}
+                      "completion_tokens": total_out,
+                      "total_tokens": len(prompt) + total_out}}
 
     def _chat(self, body: Dict[str, Any]):
         prompt = self._chat_prompt(body["messages"])
         sp, stops, effective = self._sampling(body, len(prompt))
         suffix, prefix_id = self._match_prefix(prompt)
-        rid = self.engine.submit(suffix, prefix_id=prefix_id, **sp)
+        n = self._n_choices(body)
+        rids = [self.engine.submit(suffix, prefix_id=prefix_id, **sp)
+                for _ in range(n)]
+        rid = rids[0]
         oid = f"chatcmpl-{next(_req_ids)}"
         if body.get("stream"):
             return self._stream_events(
@@ -248,19 +281,32 @@ class OpenAIServer(LLMServer):
                 content_chunk=lambda text: {"delta": {"content": text}},
                 final_extra=lambda: {"delta": {}},
                 lead_chunk={"delta": {"role": "assistant"}})
-        toks, _lps, text, by_string = self._collect(rid, stops)
-        return {
-            "id": oid, "object": "chat.completion",
-            "created": int(time.time()), "model": self.model_name,
-            "choices": [{
-                "index": 0,
+        try:
+            collected = [self._collect(r, stops) for r in rids]
+        except BaseException:
+            for r in rids:
+                try:
+                    self.engine.abort(r)
+                except Exception:
+                    pass
+            raise
+        choices = []
+        total_out = 0
+        for idx, (toks, _lps, text, by_string) in enumerate(collected):
+            total_out += len(toks)
+            choices.append({
+                "index": idx,
                 "message": {"role": "assistant", "content": text},
                 "finish_reason": self._finish_reason(
                     len(toks), effective, toks[-1] if toks else None,
-                    sp["stop_token_ids"], by_string)}],
+                    sp["stop_token_ids"], by_string)})
+        return {
+            "id": oid, "object": "chat.completion",
+            "created": int(time.time()), "model": self.model_name,
+            "choices": choices,
             "usage": {"prompt_tokens": len(prompt),
-                      "completion_tokens": len(toks),
-                      "total_tokens": len(prompt) + len(toks)}}
+                      "completion_tokens": total_out,
+                      "total_tokens": len(prompt) + total_out}}
 
     def _stream_events(self, rid: str, oid: str, obj: str,
                        stops: List[str], effective: int, stop_ids,

@@ -317,3 +317,32 @@ def test_guided_validation_over_api(openai_app):
         out = json.loads(r.read())
     assert out["error"]["type"] == "invalid_request_error"
     assert "guided_choice OR guided_regex" in out["error"]["message"]
+
+
+def test_completions_n_choices(openai_app):
+    """n > 1 returns n choices that continuous-batch in one engine
+    (reference: OpenAI/vLLM `n` sampling parameter)."""
+    port = openai_app
+    with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 5,
+                      "temperature": 0.9, "n": 3}) as r:
+        out = json.loads(r.read())
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    assert all(isinstance(c["text"], str) for c in out["choices"])
+    # usage sums all three choices' tokens (5 each at this budget)
+    assert out["usage"]["completion_tokens"] == 15
+
+
+def test_completions_n_validation(openai_app):
+    port = openai_app
+    with _post(port, {"prompt": [1, 2], "n": 2, "stream": True}) as r:
+        raw = r.read().decode()
+    first_event = next(line[len("data: "):] for line in raw.splitlines()
+                       if line.startswith("data: "))
+    assert json.loads(first_event)["error"]["type"] == \
+        "invalid_request_error"
+    with _post(port, {"prompt": [1, 2], "n": 2, "best_of": 5}) as r:
+        out = json.loads(r.read())
+    assert out["error"]["type"] == "invalid_request_error"
+    with _post(port, {"prompt": [1, 2], "n": 0}) as r:
+        out = json.loads(r.read())
+    assert out["error"]["type"] == "invalid_request_error"
