@@ -144,6 +144,13 @@ class _Request:
     # n-gram speculation: prompt+generated history (proposal source);
     # None when this request is ineligible (sampled/guided)
     hist: Optional[list] = None
+    # sampling penalties (OpenAI semantics): subtract presence once and
+    # frequency*count per occurrence of a GENERATED token; logit_bias is
+    # a static {token_id: float} addend. Counts live ON DEVICE and
+    # update in-jit from last_tokens, so pipelining is preserved.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    logit_bias: Optional[dict] = None
 
 
 _END = ("__end__", None)
@@ -276,6 +283,13 @@ class LLMEngine:
         self._guided_prev = None
         self._spec_idle = 0
         self._spec_retry = 0
+        # penalties: device-resident per-slot token-count + static-bias
+        # matrices, allocated on first use; seeded per slot assignment
+        self._pen_counts = None
+        self._pen_static = None
+        self._pen_seeded: Dict[int, str] = {}
+        self._pen_coef_dev = None
+        self._pen_coef_dirty = True
         self._mask_dirty = True
         self._shutdown = threading.Event()
         # no "preempted" stat: slots are statically sized for
@@ -365,7 +379,31 @@ class LLMEngine:
             self.precompile()
 
     # ---- jitted kernels ---------------------------------------------------
-    def _sample_tokens(self, logits, temps, top_ps, rng_key, allow=None):
+    def _pen_bias(self, pen, last_tokens, active_mask):
+        """In-jit penalty bias for one decode step. pen = (counts (S,V)
+        i32 DEVICE state, static_bias (S,V) f32, presence (S,), freq
+        (S,)). The previously-emitted token (last_tokens — incl. the
+        prefill's first token) is counted here, so every generated
+        token influences penalties from the NEXT step on, with no host
+        round-trip: pipelining is preserved. The counts input is NOT
+        donated (it shares one kwarg tuple with static_bias, which must
+        survive across steps), so each penalized step allocates a fresh
+        (S, V) i32 output — ~1 MB at 8x32k; split counts into its own
+        donated arg if this ever shows at scale. Returns (bias or None,
+        updated counts or None)."""
+        if pen is None:
+            return None, None
+        jnp = self._jnp
+        counts, static_bias, presence, freq = pen
+        S = counts.shape[0]
+        inc = active_mask.astype(counts.dtype)
+        counts = counts.at[jnp.arange(S), last_tokens].add(inc)
+        bias = (static_bias
+                - presence[:, None] * (counts > 0)
+                - freq[:, None] * counts)
+        return bias, counts
+    def _sample_tokens(self, logits, temps, top_ps, rng_key, allow=None,
+                       bias=None):
         """Sample per row of logits (N, V): greedy when temp==0, else
         temperature + optional global top-k + per-row nucleus top-p.
         All on device; returns (tokens (N,) int32, logprobs (N,) f32 of
@@ -373,14 +411,17 @@ class LLMEngine:
 
         allow (N, V) bool, optional: guided-decoding mask — tokens
         outside it are impossible under every sampling mode (reported
-        logprobs stay raw-model). None at trace time keeps the
-        unguided compile identical."""
+        logprobs stay raw-model). bias (N, V) float, optional: additive
+        logit adjustments (logit_bias + presence/frequency penalties).
+        None at trace time keeps the plain compile identical."""
         jnp = self._jnp
         jax = self._jax
         # cfg.logprobs is a plain Python bool at trace time: disabled
         # engines compile WITHOUT the full-vocab log_softmax + gather
         raw_logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
                     if self.cfg.logprobs else None)
+        if bias is not None:
+            logits = logits + bias.astype(logits.dtype)
         if allow is not None:
             logits = jnp.where(allow, logits, -jnp.inf)
         if self.cfg.top_k and self.cfg.top_k > 0:
@@ -417,7 +458,8 @@ class LLMEngine:
         return toks, logps
 
     def _prefill_impl(self, params, cache, tokens, slot, true_len, temp,
-                      top_p, rng_key, pad_len: int, allow=None):
+                      top_p, rng_key, pad_len: int, allow=None,
+                      bias=None):
         """Run the prompt through the model writing KV into `slot`, and
         sample the first generated token ON DEVICE (no host sync).
         tokens: (1, pad_len); returns (token () int32, cache')."""
@@ -442,12 +484,13 @@ class LLMEngine:
         last = logits[0, true_len - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
                                           top_p[None], rng_key,
-                                          allow=allow)
+                                          allow=allow, bias=bias)
         return toks[0], logps[0], out_cache
 
     def _prefill_chunk_impl(self, params, cache, tokens, slot, start,
                             new_len, temp, top_p, rng_key,
-                            chunk: int, sample: bool, allow=None):
+                            chunk: int, sample: bool, allow=None,
+                            bias=None):
         """One chunk of a long prompt through the CACHED path: tokens
         (1, chunk) written at positions [start, start+chunk); the slot's
         length becomes `new_len` (start + true tokens in this chunk, so
@@ -481,12 +524,12 @@ class LLMEngine:
         last = logits[0, new_len - start - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
                                           top_p[None], rng_key,
-                                          allow=allow)
+                                          allow=allow, bias=bias)
         return toks[0], logps[0], out_cache
 
     def _prefill_batch_impl(self, params, cache, tokens, slots, true_lens,
                             temps, top_ps, rng_key, pad_len: int,
-                            allow=None):
+                            allow=None, bias=None):
         """Prefill G prompts of one length bucket in a single model pass.
         tokens: (G, pad_len); slots/true_lens/temps: (G,). Padding rows
         target the scratch slot. Returns (tokens (G,) int32, cache')."""
@@ -514,7 +557,7 @@ class LLMEngine:
             out_cache.append((ck, cv, lens))
         last = logits[jnp.arange(g), true_lens - 1]          # (G, V)
         toks, logps = self._sample_tokens(last, temps, top_ps, rng_key,
-                                          allow=allow)
+                                          allow=allow, bias=bias)
         return toks, logps, out_cache
 
     def _prefix_fill_impl(self, params, prefix_cache, tokens, pid,
@@ -568,7 +611,8 @@ class LLMEngine:
 
     def _prefill_paged_impl(self, params, pools, page_table, lengths,
                             tokens, slots, true_lens, temps, top_ps,
-                            rng_key, pad_len: int, allow=None):
+                            rng_key, pad_len: int, allow=None,
+                            bias=None):
         """Prefill G prompts (single and batched unified): KV streams
         straight into each slot's pages — no small-cache copy-back.
         tokens: (G, pad_len); slots/true_lens/temps/top_ps: (G,).
@@ -595,13 +639,13 @@ class LLMEngine:
         lengths = lengths.at[slots].set(true_lens)
         last = logits[jnp.arange(g), true_lens - 1]
         toks, logps = self._sample_tokens(last, temps, top_ps, rng_key,
-                                          allow=allow)
+                                          allow=allow, bias=bias)
         return toks, logps, new_pools, lengths
 
     def _chunk_paged_impl(self, params, pools, page_table, lengths,
                           tokens, slot, start, new_len, temp, top_p,
                           rng_key, chunk: int, sample: bool,
-                          allow=None):
+                          allow=None, bias=None):
         """One chunk of a long prompt (paged): gathers the slot's full
         page row (start is dynamic, so the attention window cannot be
         statically narrowed the way bucketed prefill narrows it)."""
@@ -623,12 +667,13 @@ class LLMEngine:
         last = logits[0, new_len - start - 1]
         toks, logps = self._sample_tokens(last[None, :], temp[None],
                                           top_p[None], rng_key,
-                                          allow=allow)
+                                          allow=allow, bias=bias)
         return toks[0], logps[0], new_pools, lengths
 
     def _decode_paged_impl(self, params, pools, page_table, lengths,
                            last_tokens, active_mask, temps, top_ps,
-                           rng_key, window_pages: int = 0, allow=None):
+                           rng_key, window_pages: int = 0, allow=None,
+                           pen=None):
         """One decode step for every slot over the page pool. Released
         slots' page-table rows point at the trash page, so their writes
         are inert; inactive lengths are restored so state never
@@ -652,9 +697,12 @@ class LLMEngine:
         new_pools = [(e.k_flat, e.v_flat) for e in new_entries]
         new_lengths = jnp.where(active_mask, new_entries[0].lengths,
                                 lengths)
+        bias, new_counts = self._pen_bias(pen, last_tokens, active_mask)
         nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key,
-                                         allow=allow)
+                                         allow=allow, bias=bias)
         nxt = jnp.where(active_mask, nxt, last_tokens)
+        if pen is not None:
+            return nxt, logps, new_pools, new_lengths, new_counts
         return nxt, logps, new_pools, new_lengths
 
     def _decode_block_paged_impl(self, params, pools, page_table,
@@ -773,7 +821,7 @@ class LLMEngine:
         return out, n_emit, logps, last
 
     def _decode_impl(self, params, cache, last_tokens, active_mask,
-                     temps, top_ps, rng_key, allow=None):
+                     temps, top_ps, rng_key, allow=None, pen=None):
         """One decode step for every slot. Returns (next_tokens (S,),
         cache'). Inactive slots' lengths are restored so their state
         never drifts."""
@@ -789,9 +837,12 @@ class LLMEngine:
         for (ck, cv, lens) in new_cache:
             lens = jnp.where(active_mask, lens, old_lengths)
             fixed.append((ck, cv, lens))
+        bias, new_counts = self._pen_bias(pen, last_tokens, active_mask)
         nxt, logps = self._sample_tokens(logits, temps, top_ps, rng_key,
-                                         allow=allow)
+                                         allow=allow, bias=bias)
         nxt = jnp.where(active_mask, nxt, last_tokens)
+        if pen is not None:
+            return nxt, logps, fixed, new_counts
         return nxt, logps, fixed
 
     def _decode_block_impl(self, params, cache, last_tokens, active_mask,
@@ -923,7 +974,10 @@ class LLMEngine:
                temperature: float = 0.0, top_p: float = 1.0,
                stop_token_ids=None,
                prefix_id: Optional[int] = None,
-               guided_fsm=None) -> str:
+               guided_fsm=None,
+               presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               logit_bias: Optional[dict] = None) -> str:
         """guided_fsm: a serve.llm.guided.TokenFSM constraining this
         request's output (per-step vocab masks; EOS only at accepting
         states). Guided traffic decodes synchronously (pipeline drains
@@ -933,6 +987,10 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if not -2.0 <= presence_penalty <= 2.0 \
+                or not -2.0 <= frequency_penalty <= 2.0:
+            raise ValueError("presence/frequency penalties must be in "
+                             "[-2, 2] (OpenAI semantics)")
         if guided_fsm is not None:
             vs = getattr(getattr(self.model, "cfg", None),
                          "vocab_size", None)
@@ -981,10 +1039,17 @@ class LLMEngine:
                        fsm=guided_fsm,
                        fsm_state=(guided_fsm.start
                                   if guided_fsm is not None else 0),
+                       presence_penalty=float(presence_penalty),
+                       frequency_penalty=float(frequency_penalty),
+                       logit_bias=dict(logit_bias) if logit_bias
+                       else None,
                        hist=(list(map(int, prompt))
                              if (self.cfg.ngram_speculation > 0
                                  and temperature == 0.0
-                                 and guided_fsm is None) else None))
+                                 and guided_fsm is None
+                                 and not (presence_penalty
+                                          or frequency_penalty
+                                          or logit_bias)) else None))
         with self._lock:
             self._requests[req.request_id] = req
         self._waiting.put(req)
@@ -1112,10 +1177,15 @@ class LLMEngine:
                       temperature: float = 0.0, top_p: float = 1.0,
                       stop_token_ids=None,
                       prefix_id: Optional[int] = None,
-                      guided_fsm=None) -> List[int]:
+                      guided_fsm=None, presence_penalty: float = 0.0,
+                      frequency_penalty: float = 0.0,
+                      logit_bias: Optional[dict] = None) -> List[int]:
         rid = self.submit(prompt_ids, max_new_tokens, temperature,
                           top_p=top_p, stop_token_ids=stop_token_ids,
                           guided_fsm=guided_fsm,
+                          presence_penalty=presence_penalty,
+                          frequency_penalty=frequency_penalty,
+                          logit_bias=logit_bias,
                           prefix_id=prefix_id)
         return list(self.stream(rid))
 
@@ -1365,6 +1435,10 @@ class LLMEngine:
                 allow = self._guided_prefill_allow(
                     [r for r, _ in members], g)
                 kw = {} if allow is None else {"allow": allow}
+                pbias = self._pen_prefill_bias(
+                    [r for r, _ in members], g)
+                if pbias is not None:
+                    kw["bias"] = pbias
                 toks_dev, lps_dev, self._pools, self._lengths = \
                     self._prefill_paged_jit(
                         self.params, self._pools, self._page_table,
@@ -1380,6 +1454,9 @@ class LLMEngine:
                 tokens[0, :req.prompt.size] = req.prompt
                 allow = self._guided_prefill_allow([req], 1)
                 kw = {} if allow is None else {"allow": allow}
+                pbias = self._pen_prefill_bias([req], 1)
+                if pbias is not None:
+                    kw["bias"] = pbias
                 tok_dev, lp_dev, self._cache = self._prefill_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.int32(slot), jnp.int32(req.prompt.size),
@@ -1402,6 +1479,10 @@ class LLMEngine:
                 allow = self._guided_prefill_allow(
                     [r for r, _ in members], g)
                 kw = {} if allow is None else {"allow": allow}
+                pbias = self._pen_prefill_bias(
+                    [r for r, _ in members], g)
+                if pbias is not None:
+                    kw["bias"] = pbias
                 toks_dev, lps_dev, self._cache = self._prefill_batch_jit(
                     self.params, self._cache, jnp.asarray(tokens),
                     jnp.asarray(slots), jnp.asarray(lens),
@@ -1431,6 +1512,7 @@ class LLMEngine:
                 self._disp_len[slot] = req.prompt.size
             self._active[slot] = req
         self._mask_dirty = True
+        self._pen_coef_dirty = True
         self._start_fetch(toks_dev)
         if self.cfg.logprobs:
             self._start_fetch(lps_dev)
@@ -1460,6 +1542,8 @@ class LLMEngine:
             kw = {}
             if is_last and req.fsm is not None:
                 kw["allow"] = self._guided_prefill_allow([req], 1)
+            if is_last and req.logit_bias:
+                kw["bias"] = self._pen_prefill_bias([req], 1)
             if self._paged:
                 tok_dev, lp_dev, self._pools, self._lengths = \
                     self._chunk_paged_jit(
@@ -1496,6 +1580,7 @@ class LLMEngine:
             self._last_tokens = self._last_tokens.at[req.slot].set(tok_dev)
             self._active[req.slot] = req
             self._mask_dirty = True
+            self._pen_coef_dirty = True
             toks_dev, lps_dev = tok_dev[None], lp_dev[None]
             self._start_fetch(toks_dev)
             if self.cfg.logprobs:
@@ -1586,6 +1671,7 @@ class LLMEngine:
             self._free_slots.append(req.slot)
             self._active.pop(req.slot, None)
             self._mask_dirty = True
+            self._pen_coef_dirty = True
             req.slot = -1
 
     def _decode_window_pages(self) -> int:
@@ -1705,6 +1791,70 @@ class LLMEngine:
         if self._spec_retry == 0:
             self._spec_idle = 0
         return self._spec_idle < 8
+
+    @staticmethod
+    def _bias_row(r, V: int) -> "np.ndarray":
+        row = np.zeros((V,), np.float32)
+        for tid, b in (r.logit_bias or {}).items():
+            tid = int(tid)
+            if 0 <= tid < V:
+                row[tid] = float(b)
+        return row
+
+    @staticmethod
+    def _req_has_pen(r) -> bool:
+        return bool(r.presence_penalty or r.frequency_penalty
+                    or r.logit_bias)
+
+    def _pen_active(self) -> bool:
+        return any(self._req_has_pen(r) for r in self._active.values())
+
+    def _pen_args(self):
+        """(counts, static_bias, presence, freq) device tuple for one
+        decode step, or None when no active request uses penalties.
+        Seeds count/static rows exactly once per slot assignment (the
+        engine loop is the only mutator, and always holds the LATEST
+        counts array — prior ones were donated)."""
+        if not self._pen_active():
+            return None
+        jnp = self._jnp
+        V = int(self.model.cfg.vocab_size)
+        S = self._n_slots
+        if self._pen_counts is None:
+            self._pen_counts = jnp.zeros((S, V), jnp.int32)
+            self._pen_static = jnp.zeros((S, V), jnp.float32)
+        for slot, r in self._active.items():
+            if self._pen_seeded.get(slot) == r.request_id:
+                continue
+            self._pen_seeded[slot] = r.request_id
+            self._pen_counts = self._pen_counts.at[slot].set(0)
+            self._pen_static = self._pen_static.at[slot].set(
+                jnp.asarray(self._bias_row(r, V)))
+        for slot in [sl for sl in self._pen_seeded
+                     if sl not in self._active]:
+            del self._pen_seeded[slot]
+        if self._pen_coef_dirty or self._pen_coef_dev is None:
+            pres = np.zeros((S,), np.float32)
+            freq = np.zeros((S,), np.float32)
+            for slot, r in self._active.items():
+                pres[slot] = r.presence_penalty
+                freq[slot] = r.frequency_penalty
+            self._pen_coef_dev = (jnp.asarray(pres), jnp.asarray(freq))
+            self._pen_coef_dirty = False
+        pres_dev, freq_dev = self._pen_coef_dev
+        return (self._pen_counts, self._pen_static, pres_dev, freq_dev)
+
+    def _pen_prefill_bias(self, reqs, g: int):
+        """(g, V) static logit_bias rows for a prefill group's first
+        sampled tokens (presence/frequency are zero then); None when no
+        member has a logit_bias."""
+        if not any(r.logit_bias for r in reqs):
+            return None
+        V = int(self.model.cfg.vocab_size)
+        B = np.zeros((g, V), np.float32)
+        for i, r in enumerate(reqs):
+            B[i] = self._bias_row(r, V)
+        return self._jnp.asarray(B)
 
     def _device_mask_temps(self):
         """(active_mask, temps, top_ps) as device arrays, rebuilt only
@@ -1852,7 +2002,12 @@ class LLMEngine:
                     self._dispatch_chunk(inflight)
                 allow = (self._guided_decode_allow()
                          if self._active else None)
-                spec_sync = self._active and self._spec_sync_active()
+                pen = self._pen_args() if self._active else None
+                # penalties pipeline fine but the verify kernels don't
+                # thread them: speculation (and its sync stepping)
+                # disables entirely while any penalized request is active
+                spec_sync = (self._active and pen is None
+                             and self._spec_sync_active())
                 need_sync = allow is not None or spec_sync
                 if self._active and (not need_sync or not inflight):
                     # guided traffic with results in flight waits for
@@ -1898,8 +2053,10 @@ class LLMEngine:
                     elif self._paged:
                         window = self._decode_window_pages()
                         akw = {} if allow is None else {"allow": allow}
+                        if pen is not None:
+                            akw["pen"] = pen
                         if self._decode_block_paged_jit is not None \
-                                and allow is None:
+                                and allow is None and pen is None:
                             toks, logps, self._pools, self._lengths, \
                                 last = self._decode_block_paged_jit(
                                     self.params, self._pools,
@@ -1908,13 +2065,18 @@ class LLMEngine:
                                     top_ps, sub, window_pages=window)
                             block = max(1, self.cfg.decode_block)
                         else:
-                            toks, logps, self._pools, self._lengths = \
-                                self._decode_paged_jit(
-                                    self.params, self._pools,
-                                    self._page_table, self._lengths,
-                                    self._last_tokens, mask, temps,
-                                    top_ps, sub, window_pages=window,
-                                    **akw)
+                            res = self._decode_paged_jit(
+                                self.params, self._pools,
+                                self._page_table, self._lengths,
+                                self._last_tokens, mask, temps,
+                                top_ps, sub, window_pages=window,
+                                **akw)
+                            if pen is not None:
+                                (toks, logps, self._pools,
+                                 self._lengths, self._pen_counts) = res
+                            else:
+                                (toks, logps, self._pools,
+                                 self._lengths) = res
                             last = toks
                             block = 1
                         for slot in self._active:
@@ -1924,18 +2086,24 @@ class LLMEngine:
                             # corrupt KV untraceably
                             self._disp_len[slot] += block
                     elif self._decode_block_jit is not None \
-                            and allow is None:
+                            and allow is None and pen is None:
                         toks, logps, self._cache, last = \
                             self._decode_block_jit(
                                 self.params, self._cache,
                                 self._last_tokens, mask, temps, top_ps,
                                 sub)
                     else:
-                        toks, logps, self._cache = self._decode_jit(
+                        dkw = {} if allow is None else {"allow": allow}
+                        if pen is not None:
+                            dkw["pen"] = pen
+                        res = self._decode_jit(
                             self.params, self._cache, self._last_tokens,
-                            mask, temps, top_ps, sub,
-                            **({} if allow is None
-                               else {"allow": allow}))
+                            mask, temps, top_ps, sub, **dkw)
+                        if pen is not None:
+                            toks, logps, self._cache, \
+                                self._pen_counts = res
+                        else:
+                            toks, logps, self._cache = res
                         last = toks
                     if props is None:
                         self._last_tokens = last

@@ -70,7 +70,11 @@ class OpenAIServer(LLMServer):
             max_new_tokens=requested,
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
-            stop_token_ids=stop_ids or None)
+            stop_token_ids=stop_ids or None,
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            logit_bias={int(k): float(v) for k, v in
+                        (body.get("logit_bias") or {}).items()} or None)
         fsm = self._guided_fsm(body)
         if fsm is not None:
             kwargs["guided_fsm"] = fsm

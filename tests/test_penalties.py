@@ -1,0 +1,136 @@
+"""Sampling penalties + logit_bias (OpenAI presence/frequency semantics,
+vLLM parity): device-resident per-slot token counts update in-jit from
+last_tokens, so penalties cost no host round-trip and keep pipelining."""
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.models import Llama, LlamaConfig
+from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+
+EOS = 0
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    cfg = LlamaConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq_len=128)
+    model = Llama(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def make_engine(model_params, **kw):
+    model, params = model_params
+    base = dict(max_slots=4, max_seq_len=128, prefill_buckets=(16, 32),
+                eos_token_id=EOS)
+    base.update(kw)
+    return LLMEngine(model, params, LLMEngineConfig(**base))
+
+
+PROMPT = np.arange(1, 9)
+
+
+def test_logit_bias_forces_and_blocks(model_params):
+    eng = make_engine(model_params)
+    try:
+        plain = eng.generate_sync(PROMPT, max_new_tokens=6)
+        # +1e4 on one token makes greedy pick it every step
+        forced = eng.generate_sync(PROMPT, max_new_tokens=6,
+                                   logit_bias={77: 1e4})
+        assert forced == [77] * 6
+        # -1e4 on the plain path's first token changes the output
+        blocked = eng.generate_sync(PROMPT, max_new_tokens=6,
+                                    logit_bias={plain[0]: -1e4})
+        assert blocked[0] != plain[0]
+    finally:
+        eng.shutdown()
+
+
+def test_presence_penalty_breaks_repetition(model_params):
+    """Calibrated on this fixture: bias +2.5 makes greedy emit token 77
+    every step; presence_penalty 2.0 (which outweighs 2.5 minus the
+    natural logit gap) must allow it exactly once then suppress it."""
+    eng = make_engine(model_params)
+    try:
+        rep = eng.generate_sync(PROMPT, max_new_tokens=8,
+                                logit_bias={77: 2.5})
+        assert rep == [77] * 8  # calibration precondition
+        pen = eng.generate_sync(PROMPT, max_new_tokens=8,
+                                logit_bias={77: 2.5},
+                                presence_penalty=2.0)
+        assert pen[0] == 77          # first emission unaffected
+        assert pen.count(77) == 1    # counted once -> suppressed after
+    finally:
+        eng.shutdown()
+
+
+def test_frequency_penalty_reduces_repeats(model_params):
+    eng = make_engine(model_params)
+    try:
+        plain = eng.generate_sync(PROMPT, max_new_tokens=16)
+        pen = eng.generate_sync(PROMPT, max_new_tokens=16,
+                                frequency_penalty=2.0)
+        def max_run(xs):
+            best = run = 1
+            for a, b in zip(xs, xs[1:]):
+                run = run + 1 if a == b else 1
+                best = max(best, run)
+            return best
+        # frequency penalty can only reduce the longest repeat run
+        assert max_run(pen) <= max(max_run(plain), 2)
+    finally:
+        eng.shutdown()
+
+
+def test_penalties_paged_and_concurrent(model_params):
+    """Penalties work over the paged KV cache with concurrent requests
+    (per-slot counts stay independent)."""
+    eng = make_engine(model_params, kv_page_size=16, kv_pool_tokens=512)
+    try:
+        rid_a = eng.submit(PROMPT, max_new_tokens=6,
+                           logit_bias={77: 1e4})
+        rid_b = eng.submit(PROMPT + 1, max_new_tokens=6,
+                           logit_bias={88: 1e4})
+        a = list(eng.stream(rid_a))
+        b = list(eng.stream(rid_b))
+        assert a == [77] * 6 and b == [88] * 6
+    finally:
+        eng.shutdown()
+
+
+def test_penalties_do_not_leak_across_slot_reuse(model_params):
+    """A later request reusing the slot of a penalized one starts with
+    fresh counts/bias (seeding is per assignment)."""
+    eng = make_engine(model_params, max_slots=1)
+    try:
+        eng.generate_sync(PROMPT, max_new_tokens=4, logit_bias={77: 1e4})
+        plain = eng.generate_sync(PROMPT, max_new_tokens=4)
+        assert plain != [77] * 4
+    finally:
+        eng.shutdown()
+
+
+def test_penalty_validation(model_params):
+    eng = make_engine(model_params)
+    try:
+        with pytest.raises(ValueError, match="penalties"):
+            eng.submit(PROMPT, presence_penalty=3.0)
+    finally:
+        eng.shutdown()
+
+
+def test_penalties_with_guided_mask(model_params):
+    """Guided mask + logit_bias compose: output stays in the language
+    regardless of bias."""
+    from ray_tpu.serve.llm import TokenFSM
+    eng = make_engine(model_params)
+    try:
+        fsm = TokenFSM.from_choices([[11, 12], [21, 22]], vocab_size=128,
+                                    eos_id=EOS)
+        out = eng.generate_sync(PROMPT, max_new_tokens=6,
+                                guided_fsm=fsm, logit_bias={21: 1e4})
+        got = [t for t in out if t != EOS]
+        assert got == [21, 22]  # bias steers WITHIN the language
+    finally:
+        eng.shutdown()
