@@ -12,7 +12,8 @@ import numpy as np
 
 from ..deployment import Application, deployment_decorator
 from .engine import LLMEngine, LLMEngineConfig
-from .guided import GuidedSpec, TokenFSM, compile_guided
+from .guided import (GuidedSpec, TokenFSM, compile_guided,
+                     json_schema_to_regex)
 
 
 class LLMServer:
@@ -142,6 +143,7 @@ def __getattr__(name):
 
 
 __all__ = ["LLMEngine", "LLMEngineConfig", "GuidedSpec",
+           "json_schema_to_regex",
            "TokenFSM", "compile_guided", "LLMServer",
            "build_llm_deployment", "OpenAIServer",
            "build_openai_deployment"]

@@ -30,7 +30,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["GuidedSpec", "TokenFSM", "compile_guided"]
+__all__ = ["GuidedSpec", "TokenFSM", "compile_guided",
+           "json_schema_to_regex"]
 
 
 # ---------------------------------------------------------------- regex
@@ -339,20 +340,30 @@ class _DFA:
 
 class GuidedSpec:
     """User-facing constraint: exactly one of `choices` (strings or
-    token-id sequences) OR a `regex` over the detokenized output."""
+    token-id sequences), `regex` over the detokenized output, or
+    `json_schema` (compiled to a regex via json_schema_to_regex — the
+    output is canonical compact JSON matching the schema subset)."""
 
     def __init__(self, choices: Optional[Sequence] = None,
-                 regex: Optional[str] = None):
-        if (choices is None) == (regex is None):
-            raise ValueError("GuidedSpec needs exactly one of "
-                             "choices= or regex=")
+                 regex: Optional[str] = None,
+                 json_schema: Optional[dict] = None):
+        provided = sum(x is not None
+                       for x in (choices, regex, json_schema))
+        if provided != 1:
+            raise ValueError("GuidedSpec needs exactly one of choices=, "
+                             "regex=, or json_schema=")
+        if json_schema is not None:
+            regex = json_schema_to_regex(json_schema)
         self.choices = list(choices) if choices is not None else None
         self.regex = regex
+        self.json_schema = json_schema
 
     def __repr__(self):
-        return (f"GuidedSpec(choices={self.choices!r})"
-                if self.choices is not None
-                else f"GuidedSpec(regex={self.regex!r})")
+        if self.choices is not None:
+            return f"GuidedSpec(choices={self.choices!r})"
+        if self.json_schema is not None:
+            return f"GuidedSpec(json_schema={self.json_schema!r})"
+        return f"GuidedSpec(regex={self.regex!r})"
 
 
 class TokenFSM:
@@ -541,3 +552,131 @@ def compile_guided(spec: GuidedSpec, *, vocab_size: int, eos_id: int,
         raise ValueError("regex constraints need token_strings= "
                          "(text appended by each token id)")
     return TokenFSM.from_regex(spec.regex, token_strings, eos_id)
+
+
+# ---------------------------------------------------------- JSON schema
+
+_REGEX_META = set(".[]{}()*+?|^$\\")
+
+
+def _rx_literal(text: str) -> str:
+    """Escape `text` for the guided regex engine (fullmatch subset)."""
+    out = []
+    for ch in text:
+        if ch in _REGEX_META:
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# canonical compact JSON value regexes (guided output is canonical:
+# no whitespace, fixed key order — the standard shape for guided_json)
+_RX_STRING = r'"[^"\\]*"'          # simple strings: no escapes/quotes
+_RX_INTEGER = r"-?(0|[1-9][0-9]{0,15})"
+_RX_NUMBER = _RX_INTEGER + r"(\.[0-9]{1,8})?"
+_RX_BOOL = r"(true|false)"
+_RX_NULL = r"null"
+
+
+def json_schema_to_regex(schema: dict, *, _depth: int = 0) -> str:
+    """Compile a practical JSON-schema subset to the guided regex
+    language (reference: the guided_json mode of the vLLM/outlines-style
+    serving API — schema-constrained decoding).
+
+    Supported: type object (properties in declaration order; non-required
+    trailing properties become optional), string (+ enum, maxLength via
+    simple strings), integer, number, boolean, null, array (items,
+    minItems/maxItems up to 8), enum of strings/numbers, const.
+    The output language is CANONICAL compact JSON: no whitespace, keys
+    in schema order — every string in the language parses with
+    json.loads and validates against the schema subset."""
+    if not isinstance(schema, dict):
+        raise ValueError(
+            f"json schema must be an object, got {type(schema).__name__}")
+    if _depth > 16:
+        raise ValueError("json schema nesting too deep (>16)")
+    if "const" in schema:
+        import json as _json
+        return _rx_literal(_json.dumps(schema["const"],
+                                       separators=(",", ":")))
+    if "enum" in schema:
+        import json as _json
+        if not schema["enum"]:
+            raise ValueError("enum must be non-empty (unsatisfiable)")
+        opts = [_rx_literal(_json.dumps(v, separators=(",", ":")))
+                for v in schema["enum"]]
+        return "(" + "|".join(opts) + ")"
+    t = schema.get("type")
+    if t == "string":
+        lo = schema.get("minLength")
+        hi = schema.get("maxLength")
+        if lo is None and hi is None:
+            return _RX_STRING
+        lo = int(lo or 0)
+        hi = int(hi if hi is not None else max(lo, 64))
+        if lo > hi:
+            raise ValueError("minLength > maxLength")
+        return '"' + r'[^"\\]' + "{%d,%d}" % (lo, hi) + '"'
+
+    if t == "integer":
+        return _RX_INTEGER
+    if t == "number":
+        return _RX_NUMBER
+    if t == "boolean":
+        return _RX_BOOL
+    if t == "null":
+        return _RX_NULL
+    if t == "array":
+        item = json_schema_to_regex(schema.get("items", {"type": "null"}),
+                                    _depth=_depth + 1)
+        lo = int(schema.get("minItems", 0))
+        hi = int(schema.get("maxItems", 8))
+        if hi > 8 or lo > hi:
+            raise ValueError("array bounds: need minItems <= maxItems "
+                             "<= 8 for guided arrays")
+        item_g = f"({item})"
+        if hi == 0:
+            return r"\[\]"
+        more = f"(,{item_g}){{{max(lo - 1, 0)},{hi - 1}}}" \
+            if hi > 1 else ""
+        body = f"{item_g}{more}"
+        if lo == 0:
+            return r"\[" + f"({body})?" + r"\]"
+        return r"\[" + body + r"\]"
+    if t == "object" or "properties" in schema:
+        props = schema.get("properties", {})
+        required = set(schema.get("required", list(props)))
+        parts = []
+        import json as _json
+        for key, sub in props.items():
+            val = json_schema_to_regex(sub, _depth=_depth + 1)
+            # keys are JSON-encoded like const/enum values, so quotes,
+            # control chars, and non-latin1 keys stay valid JSON (or
+            # fail loudly in the regex engine, never silently)
+            pair = f'{_rx_literal(_json.dumps(key))}:({val})'
+            parts.append((pair, key in required))
+        if not parts:
+            return r"\{\}"
+        # canonical order; optional properties must be a trailing run
+        # AFTER at least one required property, so every optional pair
+        # carries its own leading comma and the grammar stays regular
+        if not parts[0][1] and len(parts) > 1:
+            raise ValueError(
+                "guided JSON objects need the first property required "
+                "(optional properties form a trailing run)")
+        seen_optional = False
+        body = ""
+        for idx, (pair, req) in enumerate(parts):
+            lead = "," if idx > 0 else ""
+            if req:
+                if seen_optional:
+                    raise ValueError(
+                        "required properties must precede optional ones "
+                        "(canonical guided JSON)")
+                body += lead + pair
+            else:
+                seen_optional = True
+                body += f"({lead}{pair})?"
+        return r"\{" + body + r"\}"
+    raise ValueError(f"unsupported json schema: {schema!r}")

@@ -88,11 +88,22 @@ class OpenAIServer(LLMServer):
         serving north star exposes)."""
         choice = body.get("guided_choice")
         regex = body.get("guided_regex")
-        if not choice and not regex:
+        schema = body.get("guided_json")
+        if sum(x is not None for x in (choice, regex, schema)) > 1:
+            raise ValueError("use guided_choice OR guided_regex OR "
+                             "guided_json, not several")
+        if schema is not None:
+            if isinstance(schema, str):  # vLLM also accepts encoded
+                import json as _json
+                try:
+                    schema = _json.loads(schema)
+                except ValueError as e:
+                    raise ValueError(f"guided_json is not valid JSON: "
+                                     f"{e}") from e
+            from .guided import json_schema_to_regex
+            regex = json_schema_to_regex(schema)
+        if choice is None and regex is None:
             return None
-        if choice and regex:
-            raise ValueError("use guided_choice OR guided_regex, "
-                             "not both")
         if self.tokenizer is None:
             raise ValueError("guided output needs a tokenizer "
                              "(set tokenizer= on the deployment)")

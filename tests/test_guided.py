@@ -225,3 +225,146 @@ def test_compile_guided_validation():
         compile_guided(GuidedSpec(regex="a"), vocab_size=4, eos_id=EOS)
     with pytest.raises(ValueError, match="tokenize"):
         compile_guided(GuidedSpec(choices=["a"]), vocab_size=4, eos_id=EOS)
+
+
+# ---------------------------------------------------------- json schema
+
+def ascii_vocab():
+    """Token id i (1..95) appends chr(31+i); id 0 is EOS."""
+    return [None] + [chr(31 + i) for i in range(1, 96)]
+
+
+def tok(s):
+    return [ord(c) - 31 for c in s]
+
+
+def test_json_schema_object_roundtrip():
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    import json as j
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string"},
+                             "age": {"type": "integer"},
+                             "tags": {"type": "array",
+                                      "items": {"type": "string"},
+                                      "maxItems": 3}},
+              "required": ["name", "age", "tags"]}
+    rx = json_schema_to_regex(schema)
+    fsm = TokenFSM.from_regex(rx, ascii_vocab(), eos_id=0)
+    doc = j.dumps({"name": "ada", "age": 41, "tags": ["x", "y"]},
+                  separators=(",", ":"))
+    s = walk(fsm, tok(doc))
+    assert fsm.is_accepting(s)
+    # invalid docs are dead: wrong key order / wrong type
+    bad = j.dumps({"age": 41, "name": "ada", "tags": []},
+                  separators=(",", ":"))
+    st = fsm.start
+    dead = False
+    for t in tok(bad):
+        if not fsm.allowed(st)[t]:
+            dead = True
+            break
+        st = fsm.advance(st, t)
+    assert dead
+
+
+def test_json_schema_enum_const_optional():
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    schema = {"type": "object",
+              "properties": {"kind": {"const": "event"},
+                             "level": {"enum": ["low", "high", 3]},
+                             "note": {"type": "string"}},
+              "required": ["kind", "level"]}
+    rx = json_schema_to_regex(schema)
+    fsm = TokenFSM.from_regex(rx, ascii_vocab(), eos_id=0)
+    s = walk(fsm, tok('{"kind":"event","level":3}'))
+    assert fsm.is_accepting(s)           # optional note omitted
+    s2 = walk(fsm, tok('{"kind":"event","level":"low","note":"hi"}'))
+    assert fsm.is_accepting(s2)
+
+
+def test_json_schema_guided_walk_produces_valid_json():
+    """Greedy walk under the mask always yields parseable JSON matching
+    the schema shape."""
+    import json as j
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"},
+                             "n": {"type": "integer"}},
+              "required": ["ok", "n"]}
+    fsm = TokenFSM.from_regex(json_schema_to_regex(schema),
+                              ascii_vocab(), eos_id=0)
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        s, text = fsm.start, []
+        for _step in range(64):
+            mask = fsm.allowed(s)
+            assert mask.any()
+            logits = rng.standard_normal(fsm.vocab_size)
+            logits[~mask] = -np.inf
+            t = int(np.argmax(logits))
+            if t == 0:
+                break
+            text.append(chr(31 + t))
+            s = fsm.advance(s, t)
+        doc = j.loads("".join(text))
+        assert isinstance(doc["ok"], bool) and isinstance(doc["n"], int)
+
+
+def test_json_schema_validation_errors():
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    with pytest.raises(ValueError, match="unsupported"):
+        json_schema_to_regex({"type": "frobnicate"})
+    with pytest.raises(ValueError, match="first property required"):
+        json_schema_to_regex({"type": "object",
+                              "properties": {"a": {"type": "integer"},
+                                             "b": {"type": "integer"}},
+                              "required": ["b"]})
+    with pytest.raises(ValueError):
+        GuidedSpec(regex="a", json_schema={"type": "string"})
+
+
+def test_json_schema_spec_compiles():
+    spec = GuidedSpec(json_schema={"type": "object",
+                                   "properties": {"x": {"type":
+                                                        "integer"}},
+                                   "required": ["x"]})
+    fsm = compile_guided(spec, vocab_size=96, eos_id=0,
+                         token_strings=ascii_vocab())
+    s = walk(fsm, tok('{"x":7}'))
+    assert fsm.is_complete(s)
+
+
+def test_json_schema_review_fixes():
+    """r5 review: encoded keys, maxLength enforced, empty enum and
+    non-dict schemas rejected."""
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    # quoted key stays valid JSON
+    rx = json_schema_to_regex({"type": "object",
+                               "properties": {'a"b': {"type": "null"}},
+                               "required": ['a"b']})
+    fsm = TokenFSM.from_regex(rx, ascii_vocab() + ["\\"], eos_id=0)
+    import json as j
+    doc = j.dumps({'a"b': None}, separators=(",", ":"))
+    s = fsm.start
+    for ch in doc:
+        tid = (ord(ch) - 31) if 32 <= ord(ch) <= 126 else 96
+        assert fsm.allowed(s)[tid], (ch, doc)
+        s = fsm.advance(s, tid)
+    assert fsm.is_accepting(s)
+    # maxLength enforced
+    rx2 = json_schema_to_regex({"type": "string", "maxLength": 2})
+    fsm2 = TokenFSM.from_regex(rx2, ascii_vocab(), eos_id=0)
+    s = walk(fsm2, tok('"ab"'))
+    assert fsm2.is_accepting(s)
+    st = fsm2.start
+    ok = True
+    for t in tok('"abc"'):
+        if not fsm2.allowed(st)[t]:
+            ok = False
+            break
+        st = fsm2.advance(st, t)
+    assert not ok  # 3 chars rejected
+    with pytest.raises(ValueError, match="non-empty"):
+        json_schema_to_regex({"enum": []})
+    with pytest.raises(ValueError, match="must be an object"):
+        json_schema_to_regex("{}")

@@ -346,3 +346,17 @@ def test_completions_n_validation(openai_app):
     with _post(port, {"prompt": [1, 2], "n": 0}) as r:
         out = json.loads(r.read())
     assert out["error"]["type"] == "invalid_request_error"
+
+
+def test_guided_json_over_api(openai_app):
+    """guided_json forces schema-valid canonical JSON output. (Array
+    schema: DummyTok's decode range covers [ ] , digits but not { }.)"""
+    port = openai_app
+    schema = {"type": "array", "items": {"type": "integer"},
+              "minItems": 1, "maxItems": 3}
+    with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 24,
+                      "guided_json": schema}) as r:
+        out = json.loads(r.read())
+    doc = json.loads(out["choices"][0]["text"])
+    assert isinstance(doc, list) and 1 <= len(doc) <= 3
+    assert all(isinstance(x, int) for x in doc)
