@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""MFU sweep for the flagship train step (SURVEY §6: ≥40% target).
+
+Runs `bench.py --phase train-llama` under a grid of the knobs that move
+MFU on one chip — gradient-accumulation depth, remat policy, batch size —
+with SHORT measure windows, then re-runs the best configuration at full
+length. Every TPU-completed child already snapshots its result into
+BENCH_TPU.json (bench.py:_snapshot_write); this tool additionally writes
+the ranked table to MFU_SWEEP.json so the best configuration is a
+committed, reproducible artifact.
+
+Run (holds the TPU tunnel for its duration):
+    python tools/mfu_sweep.py
+Driven automatically by tools/tpu_watcher.py after the baseline
+train-llama capture.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MFU_SWEEP.json")
+
+# (accum, remat_policy, batch) — ordered so the expected-best configs run
+# first (a budget kill still leaves the informative rows).
+GRID = [
+    (4, "dots", 8),     # the r4 default recipe
+    (1, "dots", 8),     # no accum scan: fewer, larger steps
+    (2, "dots", 8),
+    (4, "dots", 16),    # bigger batch if HBM allows
+    (4, "full", 8),     # cheaper memory, more recompute
+    (4, "none", 4),     # no remat at reduced batch
+]
+SHORT_ENV = {"RAY_TPU_BENCH_STEPS": "8", "RAY_TPU_BENCH_WARMUP": "2"}
+PER_RUN_TIMEOUT = float(os.environ.get("MFU_SWEEP_RUN_TIMEOUT", 900))
+TOTAL_BUDGET = float(os.environ.get("MFU_SWEEP_BUDGET", 4500))
+
+
+def run_cfg(accum: int, remat: str, batch: int, env_extra: dict,
+            timeout_s: float) -> dict:
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["RAY_TPU_BENCH_ACCUM"] = str(accum)
+    env["RAY_TPU_BENCH_REMAT_POLICY"] = remat
+    env["RAY_TPU_BENCH_BATCH"] = str(batch)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--phase", "train-llama"],
+            cwd=REPO, env=env, capture_output=True, timeout=timeout_s)
+        lines = proc.stdout.decode(errors="replace").strip().splitlines()
+        rec = json.loads(lines[-1]) if lines else {}
+        if proc.returncode != 0 or not isinstance(rec, dict):
+            rec = {"error": f"rc={proc.returncode}",
+                   "tail": proc.stderr.decode(errors="replace")[-400:]}
+    except subprocess.TimeoutExpired:
+        rec = {"error": f"timeout {timeout_s:.0f}s"}
+    except (ValueError, json.JSONDecodeError) as e:
+        rec = {"error": f"unparseable output: {e!r}"}
+    rec.update({"accum": accum, "remat": remat, "batch_cfg": batch,
+                "wall_s": round(time.time() - t0, 1)})
+    return rec
+
+
+def main() -> None:
+    t_start = time.time()
+    rows = []
+    for accum, remat, batch in GRID:
+        if time.time() - t_start > TOTAL_BUDGET - PER_RUN_TIMEOUT:
+            rows.append({"accum": accum, "remat": remat,
+                         "batch_cfg": batch, "skipped": "budget"})
+            continue
+        print(f"[mfu-sweep] accum={accum} remat={remat} batch={batch}",
+              flush=True)
+        rec = run_cfg(accum, remat, batch, SHORT_ENV, PER_RUN_TIMEOUT)
+        print(f"[mfu-sweep]   -> mfu={rec.get('mfu')} "
+              f"tok/s={rec.get('tokens_per_s')} err={rec.get('error')}",
+              flush=True)
+        rows.append(rec)
+        _write(rows, final=None)
+    scored = [r for r in rows
+              if isinstance(r.get("mfu"), (int, float))
+              and r.get("platform") == "tpu"]
+    final = None
+    if scored:
+        best = max(scored, key=lambda r: r["mfu"])
+        print(f"[mfu-sweep] best short-run: {best['mfu']:.3f} "
+              f"(accum={best['accum']} remat={best['remat']} "
+              f"batch={best['batch_cfg']}); re-running full-length",
+              flush=True)
+        remaining = TOTAL_BUDGET - (time.time() - t_start)
+        final = run_cfg(best["accum"], best["remat"], best["batch_cfg"],
+                        {}, max(PER_RUN_TIMEOUT, min(remaining, 1800)))
+        print(f"[mfu-sweep] full-length best: mfu={final.get('mfu')}",
+              flush=True)
+    _write(rows, final)
+
+
+def _write(rows, final) -> None:
+    with open(OUT, "w") as f:
+        json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "short_runs": rows, "best_full": final}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

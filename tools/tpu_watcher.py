@@ -59,6 +59,9 @@ SWEEP = [
     ("train-llama",
      [sys.executable, "bench.py", "--phase", "train-llama"],
      2400, ["BENCH_TPU.json"]),
+    ("mfu-sweep",
+     [sys.executable, "tools/mfu_sweep.py"],
+     5400, ["MFU_SWEEP.json", "BENCH_TPU.json"]),
     ("flash-ab",
      [sys.executable, "bench.py", "--phase", "flash-ab"],
      1800, ["BENCH_TPU.json", "FLASH_AB.json"]),
