@@ -490,6 +490,36 @@ def phase_probe_8b() -> dict:
                     "wall_s": round(time.time() - t0, 1)}
             finally:
                 eng.shutdown()
+            # n-gram speculation at 8B: decode reads ~6.6 GB of weights
+            # per step, so accepted tokens multiply tok/s almost
+            # linearly — the headline case for the draft-free path.
+            if os.environ.get("RAY_TPU_BENCH_8B_SPEC", "1") == "1":
+                try:
+                    spec_eng = LLMEngine(model, params, LLMEngineConfig(
+                        max_slots=8, max_seq_len=1024,
+                        prefill_buckets=(128,),
+                        kv_page_size=64, kv_pool_tokens=4096,
+                        ngram_speculation=4))
+                    try:
+                        rep = np.tile(np.arange(1, 17), 6)
+                        spec_eng.generate_sync(rep, max_new_tokens=4)
+                        t4 = time.time()
+                        toks4 = spec_eng.generate_sync(
+                            rep, max_new_tokens=32)
+                        spec_s = time.time() - t4
+                        st = spec_eng.get_stats()
+                        serve_result["ngram_spec"] = {
+                            "tokens": len(toks4),
+                            "wall_s": round(spec_s, 2),
+                            "tok_s": round(
+                                len(toks4) / max(spec_s, 1e-6), 1),
+                            "dispatches": st.get("decode_steps"),
+                            "accepted": st.get("spec_accepted", 0)}
+                    finally:
+                        spec_eng.shutdown()
+                except BaseException as e:  # noqa: BLE001
+                    serve_result["ngram_spec"] = {
+                        "error": repr(e)[:200]}
         except BaseException as e:  # noqa: BLE001
             serve_result = {"ok": False, "error": repr(e)[:300],
                             "wall_s": round(time.time() - t0, 1)}
