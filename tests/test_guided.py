@@ -368,3 +368,78 @@ def test_json_schema_review_fixes():
         json_schema_to_regex({"enum": []})
     with pytest.raises(ValueError, match="must be an object"):
         json_schema_to_regex("{}")
+
+
+# ------------------------------------------------- differential fuzzing
+
+def _random_pattern(rng, depth=0):
+    """Random pattern from the supported subset (kept re-compatible)."""
+    def atom():
+        r = rng.random()
+        if r < 0.35:
+            return rng.choice(list("abc01"))
+        if r < 0.5:
+            return rng.choice(["[ab]", "[0-9]", "[^a]", r"\d", r"\w"])
+        if r < 0.6:
+            return "."
+        if depth < 2:
+            return "(" + _random_pattern(rng, depth + 1) + ")"
+        return rng.choice(list("abc01"))
+
+    parts = []
+    for _ in range(rng.integers(1, 4)):
+        a = atom()
+        r = rng.random()
+        if r < 0.15:
+            a += "*"
+        elif r < 0.3:
+            a += "+"
+        elif r < 0.4:
+            a += "?"
+        elif r < 0.5:
+            m = int(rng.integers(0, 3))
+            n = m + int(rng.integers(0, 3))
+            a += f"{{{m},{n}}}"
+        parts.append(a)
+    pat = "".join(parts)
+    if rng.random() < 0.2 and depth == 0:
+        pat = pat + "|" + _random_pattern(rng, depth + 1)
+    return pat
+
+
+def test_regex_engine_matches_python_re():
+    """Differential test: the guided DFA accepts exactly the strings
+    re.fullmatch accepts, over random supported-subset patterns and
+    random candidate strings (single-char tokens)."""
+    import re
+    alphabet = "abc019 "
+    strs = [None] + list(alphabet)           # token i -> alphabet[i-1]
+    rng = np.random.default_rng(42)
+    checked = 0
+    for _pi in range(60):
+        pat = _random_pattern(rng)
+        try:
+            gold = re.compile(pat)
+        except re.error:
+            continue
+        try:
+            fsm = TokenFSM.from_regex(pat, strs, eos_id=0)
+        except ValueError:
+            continue
+        for _si in range(25):
+            n = int(rng.integers(0, 7))
+            cand = "".join(rng.choice(list(alphabet))
+                           for _ in range(n))
+            want = gold.fullmatch(cand) is not None
+            s = fsm.start
+            ok = True
+            for ch in cand:
+                t = alphabet.index(ch) + 1
+                if s < 0 or not fsm.allowed(s)[t]:
+                    ok = False
+                    break
+                s = fsm.advance(s, t)
+            got = ok and s >= 0 and fsm.is_accepting(s)
+            assert got == want, (pat, cand, got, want)
+            checked += 1
+    assert checked > 800  # the fuzz actually exercised many pairs
