@@ -145,6 +145,28 @@ class Dataset:
         return self._with_stage(Stage(name=spec.name, kind="exchange",
                                       exchange=spec))
 
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli sample each row with probability `fraction`
+        (reference: Dataset.random_sample) — a vectorized per-block
+        mask, deterministic per (seed, block content size/order)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        counter = [0]
+
+        def sample(block: Block) -> Block:
+            n = block_num_rows(block)
+            # per-call stream offset keeps blocks independent while a
+            # fixed seed keeps the whole pass reproducible
+            rng = np.random.default_rng(
+                None if seed is None else seed + counter[0])
+            counter[0] += 1
+            keep = rng.random(n) < fraction
+            return {k: np.asarray(v)[keep] for k, v in block.items()}
+
+        return self._with_stage(map_batches_stage(
+            f"random_sample({fraction})", sample))
+
     def limit(self, n: int) -> "Dataset":
         def shuffle_fn(blocks: List[Block]) -> List[Block]:
             out, got = [], 0

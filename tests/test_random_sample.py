@@ -1,0 +1,23 @@
+"""Dataset.random_sample (reference: python/ray/data/dataset.py
+random_sample): Bernoulli row sampling, seeded determinism."""
+import numpy as np
+import pytest
+
+from ray_tpu import data
+
+
+def test_random_sample_fraction_and_determinism():
+    ds = data.range(10_000)
+    a = ds.random_sample(0.2, seed=7).count()
+    b = ds.random_sample(0.2, seed=7).count()
+    assert a == b                      # seeded -> deterministic
+    assert 1500 < a < 2500             # ~2000 expected
+    assert ds.random_sample(0.0, seed=1).count() == 0
+    assert ds.random_sample(1.0, seed=1).count() == 10_000
+    rows = data.range(100).random_sample(0.5, seed=3).take_all()
+    assert all(0 <= r["id"] < 100 for r in rows)
+
+
+def test_random_sample_validation():
+    with pytest.raises(ValueError, match="fraction"):
+        data.range(10).random_sample(1.5)
