@@ -186,10 +186,13 @@ class NativeStore:
 
     # -- read path ----------------------------------------------------------
     def get_value(self, loc: ObjectLocation) -> Any:
+        from ..core.object_store import record_read  # noqa: PLC0415
         if loc.kind == "inline":
+            record_read("inline")
             return serialization.unpack(loc.data)
         if loc.kind == "spill":
             from ..core.object_store import _read_spill_loc  # noqa: PLC0415
+            record_read("spill")
             return serialization.unpack(_read_spill_loc(loc))
         if loc.kind == "native":
             size = ctypes.c_uint64()
@@ -199,9 +202,11 @@ class NativeStore:
                 if loc.spill_path:
                     from ..core.object_store import \
                         _read_spill_loc  # noqa: PLC0415
+                    record_read("spill")
                     return serialization.unpack(_read_spill_loc(loc))
                 raise ObjectLostError(
                     f"object {loc.name} is gone from the arena (evicted?)")
+            record_read("hit")
             # The pin (refcount) lives exactly as long as the deserialized
             # value: zero-copy numpy views keep `pin` alive through the
             # memoryview chain; when the last view dies, __del__ unpins and
@@ -217,10 +222,13 @@ class NativeStore:
     def get_bytes(self, loc: ObjectLocation) -> bytes:
         """Raw packed payload for cross-node transfer (copies out of the
         arena; the pin lives only for the copy)."""
+        from ..core.object_store import record_read  # noqa: PLC0415
         if loc.kind == "inline":
+            record_read("inline")
             return loc.data
         if loc.kind == "spill":
             from ..core.object_store import _read_spill_loc  # noqa: PLC0415
+            record_read("spill")
             return _read_spill_loc(loc)
         if loc.kind == "native":
             size = ctypes.c_uint64()
@@ -230,9 +238,11 @@ class NativeStore:
                 if loc.spill_path:
                     from ..core.object_store import \
                         _read_spill_loc  # noqa: PLC0415
+                    record_read("spill")
                     return _read_spill_loc(loc)
                 raise ObjectLostError(
                     f"object {loc.name} is gone from the arena (evicted?)")
+            record_read("hit")
             try:
                 return bytes(self._data[off:off + size.value])
             finally:

@@ -94,8 +94,97 @@ def cmd_timeline(args):
           "(load in chrome://tracing or Perfetto)")
 
 
+def _parse_prometheus(text: str):
+    """Parse a Prometheus text exposition into
+    (meta {name: (kind, help)}, samples [(name, {label: val}, value)]).
+    Histogram _bucket/_sum/_count samples keep their suffixed names."""
+    import re
+    meta = {}
+    samples = []
+    line_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$')
+    label_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            kind = meta.get(name, ("untyped", ""))[0]
+            meta[name] = (kind, help_)
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            help_ = meta.get(name, ("", ""))[1]
+            meta[name] = (kind.strip(), help_)
+        elif not line.startswith("#"):
+            m = line_re.match(line)
+            if not m:
+                continue
+            labels = {k: v for k, v in
+                      label_re.findall(m.group(3) or "")}
+            try:
+                value = float(m.group(4))
+            except ValueError:
+                continue
+            samples.append((m.group(1), labels, value))
+    return meta, samples
+
+
+def _format_metrics(text: str, needle: str = "") -> str:
+    """Pretty-print a merged exposition grouped by metric: counters and
+    gauges one line per series; histograms as count/sum/mean."""
+    meta, samples = _parse_prometheus(text)
+    by_base = {}
+    for name, labels, value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in meta \
+                    and meta[name[:-len(suffix)]][0] == "histogram":
+                base = name[:-len(suffix)]
+                break
+        by_base.setdefault(base, []).append((name, labels, value))
+    out = []
+    for base in sorted(by_base):
+        if needle and needle not in base:
+            continue
+        kind, help_ = meta.get(base, ("untyped", ""))
+        out.append(f"{base} ({kind})" + (f" — {help_}" if help_ else ""))
+        rows = by_base[base]
+        if kind == "histogram":
+            # one row per tag set: count / sum / mean
+            hist = {}
+            for name, labels, value in rows:
+                key = tuple(sorted((k, v) for k, v in labels.items()
+                                   if k != "le"))
+                ent = hist.setdefault(key, {"count": 0.0, "sum": 0.0})
+                if name.endswith("_count"):
+                    ent["count"] = value
+                elif name.endswith("_sum"):
+                    ent["sum"] = value
+            for key in sorted(hist):
+                ent = hist[key]
+                tags = ",".join(f'{k}="{v}"' for k, v in key)
+                mean = (ent["sum"] / ent["count"]) if ent["count"] else 0
+                out.append(f"  {{{tags}}}  count={ent['count']:g} "
+                           f"sum={ent['sum']:.6g} mean={mean:.6g}")
+        else:
+            for name, labels, value in sorted(
+                    rows, key=lambda r: sorted(r[1].items())):
+                tags = ",".join(f'{k}="{v}"'
+                                for k, v in sorted(labels.items()))
+                out.append(f"  {{{tags}}}  {value:g}")
+        out.append("")
+    return "\n".join(out)
+
+
 def cmd_metrics(args):
-    sys.stdout.write(_open(args.address, "/metrics").decode())
+    text = _open(args.address, "/metrics").decode()
+    if args.raw:
+        sys.stdout.write(text)
+        return
+    sys.stdout.write(_format_metrics(text, needle=args.grep or ""))
 
 
 def cmd_job(args):
@@ -234,8 +323,14 @@ def main(argv=None):
     tp.add_argument("-o", "--output", default="timeline.json")
     tp.set_defaults(fn=cmd_timeline)
 
-    sub.add_parser("metrics", help="Prometheus exposition").set_defaults(
-        fn=cmd_metrics)
+    mp = sub.add_parser(
+        "metrics", help="merged cluster metrics (pretty-printed; "
+                        "--raw for the Prometheus text)")
+    mp.add_argument("--raw", action="store_true",
+                    help="dump the raw Prometheus exposition")
+    mp.add_argument("--grep", default="",
+                    help="only show metrics whose name contains this")
+    mp.set_defaults(fn=cmd_metrics)
 
     svp = sub.add_parser("serve", help="serve an Application over HTTP")
     svsub = svp.add_subparsers(dest="serve_cmd", required=True)

@@ -63,8 +63,10 @@ class ActorHandle:
         """Build the method-call TaskSpec without submitting (compiled
         DAGs batch these through runtime.submit_many). Returns
         (spec, streaming)."""
+        from ..util import tracing  # noqa: PLC0415
         streaming = num_returns in ("streaming", "dynamic")
         n = 1 if streaming else num_returns
+        trace_id, span_id, parent_span_id = tracing.submit_context()
         spec = TaskSpec(
             task_id=new_task_id(),
             name=f"{self._class_name}.{method_name}",
@@ -81,6 +83,8 @@ class ActorHandle:
                                or {}).get("concurrency_group"),
             streaming=streaming,
             dep_object_ids=extract_arg_deps(args, kwargs),
+            trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent_span_id,
         )
         return spec, streaming
 

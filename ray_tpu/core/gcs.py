@@ -56,6 +56,11 @@ class TaskEntry:
     # actor-method concurrency group this task dispatched under (None =
     # the default lane); read back to decrement the right counter
     concurrency_group: Optional[str] = None
+    # trace linkage (util/tracing.py): the submit span this entry
+    # represents in the timeline, and the span it parents to
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
 
 @dataclasses.dataclass

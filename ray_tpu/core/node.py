@@ -86,6 +86,36 @@ class NodeAgent:
             "labels": dict(self.labels),
             "pid": os.getpid(),
         }))
+        # Metrics plane: this agent's registry (node-local store stats,
+        # any user metrics recorded here) ships delta snapshots on the
+        # node connection; the driver merges them tagged with node_id.
+        self._metrics_interval = float(os.environ.get(
+            "RAY_TPU_METRICS_INTERVAL_S", "1.0"))
+        if self._metrics_interval > 0:
+            threading.Thread(target=self._metrics_loop, daemon=True,
+                             name="node-metrics").start()
+
+    def _metrics_loop(self) -> None:
+        from ..util.metrics import DeltaExporter  # noqa: PLC0415
+        from ..util import metrics_catalog as mcat  # noqa: PLC0415
+        exporter = DeltaExporter()
+        while True:
+            time.sleep(self._metrics_interval)
+            try:
+                mcat.get("ray_tpu_object_store_used_bytes").set(
+                    float(self.store.used_bytes()))
+                cap = getattr(self.store, "capacity", None)
+                if cap:
+                    mcat.get(
+                        "ray_tpu_object_store_capacity_bytes").set(
+                        float(cap))
+                payload = exporter.collect()
+                if payload:
+                    self.conn.send(("metrics", payload))
+            except ConnectionClosed:
+                return
+            except Exception:
+                pass  # telemetry must never kill the agent
 
     # ---- command loop -----------------------------------------------------
     def run(self) -> None:

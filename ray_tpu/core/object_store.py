@@ -30,6 +30,18 @@ from ..exceptions import ObjectStoreFullError, ObjectLostError
 INLINE_MAX = 64 * 1024
 
 
+def record_read(result: str) -> None:
+    """Count one object read by outcome ("inline" | "hit" | "spill").
+    Shared by ShmStore and the native arena binding; never raises — a
+    metrics hiccup must not fail a read."""
+    try:
+        from ..util import metrics_catalog as mcat  # noqa: PLC0415
+        mcat.get("ray_tpu_object_store_reads_total").inc(
+            tags={"result": result})
+    except Exception:
+        pass
+
+
 @dataclasses.dataclass
 class ObjectLocation:
     """Picklable descriptor of where a sealed object's payload lives."""
@@ -119,16 +131,20 @@ class ShmStore:
     # -- read path ----------------------------------------------------------
     def get_value(self, loc: ObjectLocation) -> Any:
         if loc.kind == "inline":
+            record_read("inline")
             return serialization.unpack(loc.data)
         if loc.kind == "spill":
+            record_read("spill")
             return serialization.unpack(_read_spill_loc(loc))
         if loc.kind == "shm":
             try:
                 seg = self._attach(loc.name)
             except ObjectLostError:
                 # evicted from shm, but a spill copy survives on disk
+                record_read("spill")
                 return serialization.unpack(_read_spill_loc(loc))
             # memoryview aliases the mapped pages -> zero-copy numpy reads.
+            record_read("hit")
             return serialization.unpack(seg.buf[:loc.size])
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
 
@@ -136,14 +152,18 @@ class ShmStore:
         """Raw packed payload — the cross-node transfer unit (the remote
         side rebuilds the value with serialization.unpack)."""
         if loc.kind == "inline":
+            record_read("inline")
             return loc.data
         if loc.kind == "spill":
+            record_read("spill")
             return _read_spill_loc(loc)
         if loc.kind == "shm":
             try:
                 seg = self._attach(loc.name)
             except ObjectLostError:
+                record_read("spill")
                 return _read_spill_loc(loc)
+            record_read("hit")
             return bytes(seg.buf[:loc.size])
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
 

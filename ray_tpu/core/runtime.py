@@ -41,6 +41,14 @@ from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           PlacementGroupError, RuntimeNotInitializedError,
                           TaskCancelledError, TaskError, WorkerCrashedError)
 
+
+def _mcat():
+    # lazy: ray_tpu.util's __init__ imports modules that import THIS
+    # module, so a top-level util import would be circular during
+    # package init; every call site runs long after init completes
+    from ..util import metrics_catalog  # noqa: PLC0415
+    return metrics_catalog
+
 _runtime: Optional[Any] = None
 _runtime_lock = threading.Lock()
 
@@ -303,9 +311,19 @@ class DriverRuntime:
         self._fetch_lock = threading.Lock()
         self._fetch_events: Dict[int, Tuple[threading.Event, dict]] = {}
 
+        # cluster metrics plane: remote processes ship delta snapshots
+        # of their registries here (util/metrics.py); trace spans from
+        # worker executions land in trace_spans for the timeline export
+        from ..util.metrics import ClusterMetricsStore  # noqa: PLC0415
+        self.cluster_metrics = ClusterMetricsStore()
+        self.trace_spans: collections.deque = collections.deque(
+            maxlen=8192)
+
         self.report_handlers["sys.lookup_actor"] = self._sys_lookup_actor
         self.report_handlers["sys.kv"] = \
             lambda _wid, payload: self._kv_op(*payload)
+        self.report_handlers["sys.metrics"] = self._on_worker_metrics
+        self.report_handlers["sys.spans"] = self._on_worker_spans
 
         # Backstop for drivers that exit without calling shutdown() (e.g.
         # a pytest process): workers self-exit on socket close, but the shm
@@ -399,6 +417,9 @@ class DriverRuntime:
     # ================= event handling =================
     def _handle(self, item):
         kind = item[0]
+        if kind == "tick":
+            self._update_builtin_gauges()
+            return
         if kind == "register":
             _, wid, conn, pid = item
             w = self.workers.get(wid)
@@ -599,6 +620,11 @@ class DriverRuntime:
                     self._fetch_events.pop(rid, None)
                 box["data"], box["err"] = bytes(buf), None
                 ev.set()
+        elif mtype == "metrics":
+            # the node agent's own registry (store stats etc.) ships on
+            # the node connection; workers ship on their own conns
+            self.cluster_metrics.ingest(
+                {"node_id": nid, "worker_id": "node-agent"}, m[1])
         elif mtype == "worker_spawn_failed":
             sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
                              f"worker {m[1]}: {m[2]}\n")
@@ -612,6 +638,7 @@ class DriverRuntime:
         entry = self.gcs.nodes.get(nid)
         if entry is not None:
             entry.alive = False
+        self.cluster_metrics.drop_source({"node_id": nid})
         # In-flight fetches against this node resolve via their timeout.
         for w in list(self.workers.values()):
             if w.node_id == nid and w.state != "dead":
@@ -1059,8 +1086,13 @@ class DriverRuntime:
     def _register_task(self, spec: TaskSpec):
         te = TaskEntry(task_id=spec.task_id, name=spec.name,
                        actor_id=spec.actor_id, submitted_at=time.time(),
-                       retries_left=spec.max_retries)
+                       retries_left=spec.max_retries,
+                       trace_id=getattr(spec, "trace_id", ""),
+                       span_id=getattr(spec, "span_id", ""),
+                       parent_span_id=getattr(spec, "parent_span_id", ""))
         self.gcs.tasks[spec.task_id] = te
+        _mcat().get("ray_tpu_tasks_submitted_total").inc(tags={
+            "kind": "actor_task" if spec.actor_id else "task"})
         for oid in spec.return_ids:
             self.gcs.add_pending_object(oid, owner_task=spec.task_id)
         if getattr(spec, "streaming", False):
@@ -1505,6 +1537,9 @@ class DriverRuntime:
             w.held_resources = dict(need)
             te.state, te.worker_id, te.started_at = ("RUNNING", w.worker_id,
                                                      time.time())
+            if te.submitted_at:
+                _mcat().get("ray_tpu_task_sched_latency_s").observe(
+                    te.started_at - te.submitted_at)
         self.pending_tasks = still
 
         # 3. actor tasks
@@ -1553,6 +1588,9 @@ class DriverRuntime:
                 te.state, te.worker_id, te.started_at = ("RUNNING",
                                                          w.worker_id,
                                                          time.time())
+                if te.submitted_at:
+                    _mcat().get("ray_tpu_task_sched_latency_s").observe(
+                        te.started_at - te.submitted_at)
                 return True
 
             if not group_limits:
@@ -1829,6 +1867,11 @@ class DriverRuntime:
                 self._fail_object(oid, error)
             self._gen_settle(task_id, error)
         te.finished_at = time.time()
+        _mcat().get("ray_tpu_tasks_finished_total").inc(
+            tags={"state": te.state})
+        if te.started_at:
+            _mcat().get("ray_tpu_task_run_s").observe(
+                te.finished_at - te.started_at)
         spec = self._respawnable_specs.pop(task_id, None)
         if spec is not None and error is None and spec.actor_id is None:
             # retain for lineage reconstruction of this task's outputs
@@ -1885,6 +1928,9 @@ class DriverRuntime:
         if w is None or w.state == "dead":
             return
         w.state = "dead"
+        # a dead worker's gauge series would otherwise report its last
+        # "current state" forever (counters/histograms stay: history)
+        self.cluster_metrics.drop_source({"worker_id": wid})
         if w.blocked:
             # Blocked workers already returned their CPU when they entered
             # get() — release only the non-CPU remainder they still hold.
@@ -2338,6 +2384,51 @@ class DriverRuntime:
                 return [k.split("\x00", 1)[1].encode() for k in kv
                         if k.startswith(args[0])]
             raise ValueError(f"unknown kv op {op!r}")
+
+    def _on_worker_metrics(self, wid: str, payload) -> None:
+        w = self.workers.get(wid)
+        node = (w.node_id if w is not None and w.node_id else None) \
+            or self.node_id
+        self.cluster_metrics.ingest(
+            {"node_id": node, "worker_id": wid}, payload)
+
+    def _on_worker_spans(self, wid: str, payload) -> None:
+        w = self.workers.get(wid)
+        node = (w.node_id if w is not None and w.node_id else None) \
+            or self.node_id
+        for sp in payload or ():
+            sp = dict(sp)
+            if not sp.get("worker_id"):
+                sp["worker_id"] = wid
+            if not sp.get("node_id"):
+                sp["node_id"] = node
+            self.trace_spans.append(sp)
+
+    def _update_builtin_gauges(self) -> None:
+        """Periodic (reaper-tick) refresh of the driver-side pool/store
+        gauges; failures must never take down the dispatcher."""
+        try:
+            by_state: Dict[str, int] = {}
+            for w in self.workers.values():
+                by_state[w.state] = by_state.get(w.state, 0) + 1
+            g = _mcat().get("ray_tpu_workers")
+            for state in ("starting", "idle", "busy", "actor", "dead"):
+                g.set(float(by_state.get(state, 0)),
+                      tags={"state": state})
+            _mcat().get("ray_tpu_pending_tasks").set(
+                float(len(self.pending_tasks)))
+            _mcat().get("ray_tpu_object_store_used_bytes").set(
+                float(self.store.used_bytes()))
+            cap = getattr(self.store, "capacity", None)
+            if cap:
+                _mcat().get("ray_tpu_object_store_capacity_bytes").set(
+                    float(cap))
+            nobj = getattr(self.store, "num_objects", None)
+            if callable(nobj):
+                _mcat().get("ray_tpu_object_store_objects").set(
+                    float(nobj()))
+        except Exception:
+            pass
 
     def _sys_lookup_actor(self, _wid, payload) -> Optional[tuple]:
         """Built-in report_sync channel backing get_actor() from workers."""

@@ -48,6 +48,12 @@ class TaskSpec:
     # bookkeeping
     func_id: str = ""                  # cache key for deserialized functions
     dep_object_ids: List[str] = dataclasses.field(default_factory=list)
+    # cross-process tracing (util/tracing.py): span_id names this task's
+    # SUBMIT span; the executing worker opens a child execution span
+    # parented to it, so the timeline links driver and worker sides
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
 
 @dataclasses.dataclass
@@ -97,7 +103,9 @@ def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
                    placement_group_id=None,
                    bundle_index=-1, scheduling_strategy=None,
                    runtime_env=None) -> TaskSpec:
+    from ..util import tracing  # noqa: PLC0415
     tid = new_task_id()
+    trace_id, span_id, parent_span_id = tracing.submit_context()
     spec = TaskSpec(
         task_id=tid,
         name=name or getattr(func, "__qualname__", "anonymous"),
@@ -117,5 +125,7 @@ def make_task_spec(func, args, kwargs, *, name=None, num_returns=1,
         scheduling_strategy=scheduling_strategy,
         runtime_env=runtime_env,
         dep_object_ids=extract_arg_deps(args, kwargs or {}),
+        trace_id=trace_id, span_id=span_id,
+        parent_span_id=parent_span_id,
     )
     return spec

@@ -14,6 +14,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -25,6 +26,9 @@ from .object_store import ShmStore, ObjectLocation, INLINE_MAX, make_store
 from .protocol import Connection, ConnectionClosed, connect_address
 from .task import TaskSpec, ActorCreationSpec
 from ..exceptions import TaskError, GetTimeoutError, ObjectLostError
+from ..util import metrics as metrics_mod
+from ..util import metrics_catalog as mcat
+from ..util import tracing
 
 
 class WorkerRuntime:
@@ -266,6 +270,15 @@ class WorkerLoop:
         self._group_pools: Dict[str, ThreadPoolExecutor] = {}
         self._async_loop = None
         self._cancelled: set = set()
+        # telemetry plane: metric deltas + execution spans ship to the
+        # driver over the existing conn (report channels sys.metrics /
+        # sys.spans) after each task and on a periodic heartbeat, so
+        # the driver's /metrics exposes series recorded IN this process
+        self._delta_exporter = metrics_mod.DeltaExporter()
+        self._spans: List[dict] = []
+        self._telemetry_lock = threading.Lock()
+        self._last_flush = 0.0
+        self._heartbeat_on = True   # set from env in run()
 
     # ---- main -------------------------------------------------------------
     def run(self) -> None:
@@ -274,6 +287,13 @@ class WorkerLoop:
         self.conn.send(("register", self.worker_id, os.getpid()))
         reader = threading.Thread(target=self._read_loop, daemon=True)
         reader.start()
+        interval = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S",
+                                        "1.0"))
+        self._heartbeat_on = interval > 0
+        if interval > 0:
+            threading.Thread(target=self._telemetry_loop,
+                             args=(interval,), daemon=True,
+                             name="worker-telemetry").start()
         while not self._shutdown.is_set():
             try:
                 item = self._task_q.get(timeout=0.2)
@@ -325,6 +345,68 @@ class WorkerLoop:
                 device_store.drop(msg[1])
             elif mtype == "shutdown":
                 self._shutdown.set()
+
+    # ---- telemetry --------------------------------------------------------
+    def _telemetry_loop(self, interval: float) -> None:
+        """Heartbeat shipping for long-running work (an actor hosting an
+        LLM engine records tokens continuously with no task boundary)."""
+        while not self._shutdown.is_set():
+            time.sleep(interval)
+            self._flush_telemetry()
+
+    def _record_span(self, spec: TaskSpec, span_id: str, start: float,
+                     end: float, status: str) -> None:
+        with self._telemetry_lock:
+            self._spans.append({
+            "trace_id": getattr(spec, "trace_id", "") or "",
+            "span_id": span_id,
+            "parent_span_id": getattr(spec, "span_id", "") or "",
+            "task_id": spec.task_id, "name": spec.name,
+                "start": start, "end": end, "status": status,
+                "pid": os.getpid(), "worker_id": self.worker_id,
+                "node_id": os.environ.get("RAY_TPU_NODE_ID"),
+            })
+
+    def _flush_telemetry(self, min_interval: float = 0.0) -> None:
+        """Ship buffered spans + registry deltas. Never raises — a
+        telemetry failure must not fail user work. min_interval > 0
+        throttles the registry walk (sub-millisecond task storms must
+        not pay a full delta collect per task; the heartbeat thread
+        ships whatever a throttled call left buffered)."""
+        with self._telemetry_lock:
+            now = time.monotonic()
+            if min_interval and now - self._last_flush < min_interval:
+                return
+            self._last_flush = now
+            spans, self._spans = self._spans, []
+            try:
+                payload = self._delta_exporter.collect()
+            except Exception:
+                payload = None
+        try:
+            if spans:
+                self.conn.send(("report", "sys.spans", spans))
+            if payload:
+                self.conn.send(("report", "sys.metrics", payload))
+        except Exception:  # ConnectionClosed included: driver is gone
+            pass
+
+    def _finish_task_telemetry(self, spec: TaskSpec, span_id: str,
+                               start: float, status: str) -> None:
+        end = time.time()
+        try:
+            mcat.get("ray_tpu_worker_task_run_s").observe(end - start)
+            mcat.get("ray_tpu_worker_tasks_total").inc(
+                tags={"status": status})
+        except Exception:
+            pass
+        try:
+            self._record_span(spec, span_id, start, end, status)
+        except Exception:
+            pass
+        # throttle only when the heartbeat will sweep the leftovers
+        self._flush_telemetry(
+            min_interval=0.2 if self._heartbeat_on else 0.0)
 
     # ---- execution --------------------------------------------------------
     def _seal_returns(self, spec: TaskSpec, result: Any):
@@ -383,24 +465,35 @@ class WorkerLoop:
         # Dispatcher-assigned chip indices (disjoint across concurrent
         # workloads; placement-group tasks get their bundle's ids)
         self.rt.current_tpu_ids = list(getattr(spec, "tpu_ids", []) or [])
+        t0 = time.time()
+        exec_span = tracing.new_span_id()
+        status = "ok"
         try:
             from . import runtime_env as renv_mod  # noqa: PLC0415
             fn = self.rt.load_func(spec)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
-            with renv_mod.applied(spec.runtime_env):
+            # execution runs under this task's span so nested .remote()
+            # submissions parent to it (cross-process trace tree)
+            with renv_mod.applied(spec.runtime_env), \
+                    tracing.active(getattr(spec, "trace_id", "") or "",
+                                   exec_span):
                 result = fn(*args, **kwargs)
                 if getattr(spec, "streaming", False):
                     cancelled = self._stream_items(spec, result)
+                    if cancelled:
+                        status = "cancelled"
                     self.conn.send(("task_done", spec.task_id, [],
                                     "cancelled" if cancelled else None))
                     return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
         except BaseException as e:  # noqa: BLE001
+            status = "error"
             err = TaskError(repr(e), traceback.format_exc(), spec.name)
             self.conn.send(("task_done", spec.task_id, [], err))
         finally:
             self.rt.current_task_id = None
+            self._finish_task_telemetry(spec, exec_span, t0, status)
 
     def _create_actor(self, acspec: ActorCreationSpec) -> None:
         try:
@@ -483,15 +576,22 @@ class WorkerLoop:
 
     def _run_actor_task(self, spec: TaskSpec) -> None:
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
+        t0 = time.time()
+        exec_span = tracing.new_span_id()
+        status = "ok"
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
-            result = method(*args, **kwargs)
-            if getattr(spec, "streaming", False):
-                cancelled = self._stream_items(spec, result)
-                self.conn.send(("task_done", spec.task_id, [],
-                                "cancelled" if cancelled else None))
-                return
+            with tracing.active(getattr(spec, "trace_id", "") or "",
+                                exec_span):
+                result = method(*args, **kwargs)
+                if getattr(spec, "streaming", False):
+                    cancelled = self._stream_items(spec, result)
+                    if cancelled:
+                        status = "cancelled"
+                    self.conn.send(("task_done", spec.task_id, [],
+                                    "cancelled" if cancelled else None))
+                    return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
         except ActorExitRequest:
@@ -502,16 +602,22 @@ class WorkerLoop:
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)  # works from threadpool threads too
         except BaseException as e:  # noqa: BLE001
+            status = "error"
             err = TaskError(repr(e), traceback.format_exc(),
                             f"{type(self._actor_instance).__name__}."
                             f"{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
+        finally:
+            self._finish_task_telemetry(spec, exec_span, t0, status)
 
     async def _run_actor_task_asyncgen(self, spec: TaskSpec) -> None:
         """Streaming from an `async def ... yield` actor method. Requires
         num_returns=\"streaming\" on the call (enforced below — a plain
         call would otherwise try to seal an async_generator object)."""
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
+        t0 = time.time()
+        exec_span = tracing.new_span_id()
+        status = "ok"
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
@@ -527,6 +633,8 @@ class WorkerLoop:
                     await agen.aclose()
                     break
                 self._put_gen_item(spec, item)
+            if cancelled:
+                status = "cancelled"
             self.conn.send(("task_done", spec.task_id, [],
                             "cancelled" if cancelled else None))
         except ActorExitRequest:
@@ -534,12 +642,21 @@ class WorkerLoop:
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
+            status = "error"
             err = TaskError(repr(e), traceback.format_exc(),
                             f"asyncgen.{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
+        finally:
+            # no tracing.active here: interleaved coroutines share the
+            # loop thread, so a thread-local context would leak between
+            # requests — the span record alone keeps the timeline link
+            self._finish_task_telemetry(spec, exec_span, t0, status)
 
     async def _run_actor_task_async(self, spec: TaskSpec) -> None:
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
+        t0 = time.time()
+        exec_span = tracing.new_span_id()
+        status = "ok"
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
@@ -552,9 +669,12 @@ class WorkerLoop:
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
+            status = "error"
             err = TaskError(repr(e), traceback.format_exc(),
                             f"async.{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
+        finally:
+            self._finish_task_telemetry(spec, exec_span, t0, status)
 
     def _ensure_async_loop(self):
         if self._async_loop is None:

@@ -149,21 +149,24 @@ class Dataset:
                       *, seed: Optional[int] = None) -> "Dataset":
         """Bernoulli sample each row with probability `fraction`
         (reference: Dataset.random_sample) — a vectorized per-block
-        mask, deterministic per (seed, block content size/order)."""
+        mask, deterministic per (seed, block index)."""
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1], got {fraction}")
-        counter = [0]
 
-        def sample(block: Block) -> Block:
+        def sample(block: Block, block_index: int = 0) -> Block:
             n = block_num_rows(block)
-            # per-call stream offset keeps blocks independent while a
-            # fixed seed keeps the whole pass reproducible
+            # Seed from (seed, block_index) — the index is threaded
+            # through the stage by the executor, so every deserialized
+            # worker copy of this fn derives the SAME per-block stream.
+            # A closure counter here would restart at 0 in each copy and
+            # correlate masks across blocks under distributed execution.
             rng = np.random.default_rng(
-                None if seed is None else seed + counter[0])
-            counter[0] += 1
+                None if seed is None
+                else (seed & 0xFFFF_FFFF_FFFF_FFFF, block_index))
             keep = rng.random(n) < fraction
             return {k: np.asarray(v)[keep] for k, v in block.items()}
 
+        sample._wants_block_index = True
         return self._with_stage(map_batches_stage(
             f"random_sample({fraction})", sample))
 

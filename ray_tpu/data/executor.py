@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 import os
 
 from .block import Block, block_size_bytes
-from .plan import Stage, fuse_stages
+from .plan import Stage, call_block_fn, fuse_stages
 
 MAX_IN_FLIGHT = 8
 # Byte budget for in-flight blocks (VERDICT r4 weak #3: count-only
@@ -65,8 +65,21 @@ def _runtime():
     return None
 
 
-def _apply_map(fn: Callable[[Block], Block], block: Block) -> Block:
-    return fn(block)
+def _stage_metrics():
+    """(inflight-bytes gauge, stall counter, blocks counter); any
+    registry failure degrades to None (metrics never break execution)."""
+    try:
+        from ..util import metrics_catalog as mcat
+        return (mcat.get("ray_tpu_data_inflight_bytes"),
+                mcat.get("ray_tpu_data_backpressure_stall_s_total"),
+                mcat.get("ray_tpu_data_blocks_total"))
+    except Exception:
+        return None, None, None
+
+
+def _apply_map(fn: Callable[[Block], Block], block: Block,
+               index: int = 0) -> Block:
+    return call_block_fn(fn, block, index)
 
 
 class _StatefulMapActor:
@@ -77,8 +90,8 @@ class _StatefulMapActor:
         ctor = cloudpickle.loads(ctor_bytes)
         self.fn = ctor()
 
-    def apply(self, block: Block) -> Block:
-        return self.fn(block)
+    def apply(self, block: Block, index: int = 0) -> Block:
+        return call_block_fn(self.fn, block, index)
 
 
 def execute_plan(source_blocks: Iterator[Block], stages: Sequence[Stage],
@@ -144,9 +157,9 @@ def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
     rt = _runtime()
     if rt is None:
         def local() -> Iterator[Block]:
-            for block in stream:
+            for i, block in enumerate(stream):
                 t0 = time.time()
-                out = stage.fn(block)
+                out = call_block_fn(stage.fn, block, i)
                 stats.record(stage.name, time.time() - t0)
                 yield out
         return local()
@@ -161,30 +174,47 @@ def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
         window: "collections.deque" = collections.deque()  # (ref, bytes)
         inflight_bytes = 0
         peak = 0
+        stall_s = 0.0
+        g_inflight, c_stall, c_blocks = _stage_metrics()
+        mtags = {"stage": stage.name}
         fn_ref = api.put(stage.fn)  # ship the (possibly fused) fn once
 
         def drain_one():
             nonlocal inflight_bytes
             ref, nbytes = window.popleft()
             inflight_bytes -= nbytes
-            return api.get(ref)
+            out = api.get(ref)
+            if g_inflight is not None:
+                g_inflight.set(float(inflight_bytes), tags=mtags)
+            return out
 
-        for block in stream:
+        for i, block in enumerate(stream):
             nbytes = block_size_bytes(block)
             # byte budget first (count cap on top); always admit one
             while window and (inflight_bytes + nbytes
                               > MAX_IN_FLIGHT_BYTES
                               or len(window) >= parallelism):
-                yield drain_one()
-            window.append((remote_fn.remote(fn_ref, block), nbytes))
+                t0 = time.perf_counter()
+                out = drain_one()
+                dt = time.perf_counter() - t0
+                stall_s += dt
+                if c_stall is not None:
+                    c_stall.inc(dt, tags=mtags)
+                yield out
+            window.append((remote_fn.remote(fn_ref, block, i), nbytes))
             inflight_bytes += nbytes
             peak = max(peak, inflight_bytes)
+            if g_inflight is not None:
+                g_inflight.set(float(inflight_bytes), tags=mtags)
+            if c_blocks is not None:
+                c_blocks.inc(tags=mtags)
         while window:
             yield drain_one()
         stats.record(stage.name, time.time() - t_start)
         stats.backpressure[stage.name] = {
             "budget_bytes": MAX_IN_FLIGHT_BYTES,
-            "peak_inflight_bytes": peak}
+            "peak_inflight_bytes": peak,
+            "stall_s": stall_s}
     return distributed()
 
 
@@ -197,9 +227,9 @@ def _actor_pool_map(stream: Iterator[Block], stage: Stage,
         fn = stage.fn_constructor()
 
         def local() -> Iterator[Block]:
-            for block in stream:
+            for i, block in enumerate(stream):
                 t0 = time.time()
-                out = fn(block)
+                out = call_block_fn(fn, block, i)
                 stats.record(stage.name, time.time() - t0)
                 yield out
         return local()
@@ -215,31 +245,48 @@ def _actor_pool_map(stream: Iterator[Block], stage: Stage,
         window: "collections.deque" = collections.deque()  # (ref, bytes)
         inflight_bytes = 0
         peak = 0
+        stall_s = 0.0
         i = 0
+        g_inflight, c_stall, c_blocks = _stage_metrics()
+        mtags = {"stage": stage.name}
 
         def drain_one():
             nonlocal inflight_bytes
             ref, nbytes = window.popleft()
             inflight_bytes -= nbytes
-            return api.get(ref)
+            out = api.get(ref)
+            if g_inflight is not None:
+                g_inflight.set(float(inflight_bytes), tags=mtags)
+            return out
 
         for block in stream:
             nbytes = block_size_bytes(block)
             while window and (inflight_bytes + nbytes
                               > MAX_IN_FLIGHT_BYTES
                               or len(window) >= parallelism):
-                yield drain_one()
+                t0 = time.perf_counter()
+                out = drain_one()
+                dt = time.perf_counter() - t0
+                stall_s += dt
+                if c_stall is not None:
+                    c_stall.inc(dt, tags=mtags)
+                yield out
             actor = actors[i % pool_size]
+            window.append((actor.apply.remote(block, i), nbytes))
             i += 1
-            window.append((actor.apply.remote(block), nbytes))
             inflight_bytes += nbytes
             peak = max(peak, inflight_bytes)
+            if g_inflight is not None:
+                g_inflight.set(float(inflight_bytes), tags=mtags)
+            if c_blocks is not None:
+                c_blocks.inc(tags=mtags)
         while window:
             yield drain_one()
         stats.record(stage.name, time.time() - t_start)
         stats.backpressure[stage.name] = {
             "budget_bytes": MAX_IN_FLIGHT_BYTES,
-            "peak_inflight_bytes": peak}
+            "peak_inflight_bytes": peak,
+            "stall_s": stall_s}
         for a in actors:
             try:
                 api.kill(a)

@@ -66,6 +66,19 @@ def map_batches_stage(name: str, batch_fn: Callable[[Block], Block],
                  can_fuse=(compute == "tasks"))
 
 
+def fn_wants_index(fn: Callable) -> bool:
+    """Stage fns marked `_wants_block_index = True` receive the block's
+    position in the stage's input stream as a second argument — the
+    hook that lets per-block randomness (random_sample) derive seeds
+    from a value that SURVIVES serialization to workers, instead of a
+    closure counter that restarts at 0 in every deserialized copy."""
+    return bool(getattr(fn, "_wants_block_index", False))
+
+
+def call_block_fn(fn: Callable, block: Block, index: int) -> Block:
+    return fn(block, index) if fn_wants_index(fn) else fn(block)
+
+
 def fuse_stages(stages: Sequence[Stage]) -> List[Stage]:
     """Fuse runs of adjacent fusible map_block stages into single stages."""
     fused: List[Stage] = []
@@ -81,10 +94,13 @@ def fuse_stages(stages: Sequence[Stage]) -> List[Stage]:
             fns = [s.fn for s in run]
             name = "+".join(s.name for s in run)
 
-            def combined(block: Block, fns=fns) -> Block:
+            def combined(block: Block, _index: int = 0,
+                         fns=fns) -> Block:
                 for f in fns:
-                    block = f(block)
+                    block = call_block_fn(f, block, _index)
                 return block
+            combined._wants_block_index = any(
+                fn_wants_index(f) for f in fns)
             fused.append(Stage(name=name, kind="map_block", fn=combined))
         run = []
 

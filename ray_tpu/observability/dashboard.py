@@ -119,7 +119,10 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._json({"error": f"no route {route}"}, 404)
             elif route == "/metrics":
-                self._send(200, metrics_mod.exposition().encode(),
+                # merged cluster exposition: local registry + every
+                # worker/node snapshot shipped to the driver (series
+                # tagged node_id/worker_id)
+                self._send(200, metrics_mod.cluster_exposition().encode(),
                            "text/plain; version=0.0.4")
             elif route in ("", "/"):
                 self._send(200, _INDEX_HTML.encode(),

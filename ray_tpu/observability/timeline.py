@@ -4,10 +4,18 @@ Reference counterpart: ray.timeline() (python/ray/_private/profiling.py,
 state API timeline export) — emits the chrome://tracing "trace events"
 JSON array format. Rows are workers; spans are task executions; instant
 events mark actor state changes.
+
+Cross-process spans: each task's driver-side SUBMIT span (queued →
+dispatched, drawn on the driver lane) carries the span_id stamped on its
+TaskSpec (util/tracing.py); worker processes ship their execution spans
+back over the telemetry channel and they render here parented to the
+submit span (args.parent_span_id + chrome flow arrows), so one export
+shows the full submit → dispatch → execute tree across processes.
 """
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, List, Optional
 
 from ..core.runtime import get_runtime
@@ -32,6 +40,29 @@ def timeline_events() -> List[Dict[str, Any]]:
         return lanes[key]
 
     for te in list(rt.gcs.tasks.values()):
+        span_id = getattr(te, "span_id", "")
+        if te.submitted_at:
+            # driver-side submit span: queued -> dispatched (or queued ->
+            # now for still-pending tasks — those are exactly the bars a
+            # queueing investigation needs to see); worker execution
+            # spans parent to its span_id
+            sub_end = te.started_at or te.finished_at or time.time()
+            events.append({
+                "name": f"submit:{te.name}", "cat": "submit", "ph": "X",
+                "ts": te.submitted_at * _US,
+                "dur": max(1.0, (sub_end - te.submitted_at) * _US),
+                "pid": pid, "tid": lane("driver"),
+                "args": {"task_id": te.task_id, "state": te.state,
+                         "span_id": span_id,
+                         "parent_span_id": getattr(te, "parent_span_id",
+                                                   ""),
+                         "trace_id": getattr(te, "trace_id", "")},
+            })
+            if span_id:
+                events.append({
+                    "name": "task", "cat": "submit_flow", "ph": "s",
+                    "id": span_id, "ts": te.submitted_at * _US,
+                    "pid": pid, "tid": lane("driver")})
         if not te.started_at:
             continue
         end = te.finished_at or te.started_at
@@ -43,9 +74,37 @@ def timeline_events() -> List[Dict[str, Any]]:
             "pid": pid, "tid": lane(te.worker_id),
             "args": {"task_id": te.task_id, "state": te.state,
                      "actor_id": te.actor_id,
+                     "span_id": span_id,
                      "queued_s": round(te.started_at - te.submitted_at, 6)
                      if te.submitted_at else None},
         })
+    # worker-side execution spans shipped over the telemetry channel
+    # (core/worker.py): true in-process timing, parented to the driver's
+    # submit span and linked with a chrome flow arrow
+    for sp in list(getattr(rt, "trace_spans", ())):
+        try:
+            start, end = sp["start"], sp["end"]
+        except (KeyError, TypeError):
+            continue
+        events.append({
+            "name": sp.get("name", "task"), "cat": "task_exec",
+            "ph": "X", "ts": start * _US,
+            "dur": max(1.0, (end - start) * _US),
+            "pid": pid, "tid": lane(sp.get("worker_id")),
+            "args": {"task_id": sp.get("task_id"),
+                     "span_id": sp.get("span_id"),
+                     "parent_span_id": sp.get("parent_span_id"),
+                     "trace_id": sp.get("trace_id"),
+                     "status": sp.get("status"),
+                     "node_id": sp.get("node_id"),
+                     "worker_pid": sp.get("pid")},
+        })
+        if sp.get("parent_span_id"):
+            events.append({
+                "name": "task", "cat": "submit_flow", "ph": "f",
+                "bp": "e", "id": sp["parent_span_id"],
+                "ts": start * _US, "pid": pid,
+                "tid": lane(sp.get("worker_id"))})
     for ae in list(rt.gcs.actors.values()):
         if ae.worker_id is None:
             continue

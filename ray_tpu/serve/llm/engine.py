@@ -160,27 +160,31 @@ _metrics_singletons = None
 
 
 def _engine_metrics():
-    """Shared registry metrics (created once; per-engine series via the
-    `engine` tag — re-instantiating per engine would clobber the
-    registry entry and drop earlier engines' series)."""
+    """Shared built-in registry metrics, resolved through the catalog
+    (util/metrics_catalog.py) so names stay `ray_tpu_`-prefixed and
+    documented in one place. Per-engine series ride the `engine` tag —
+    re-instantiating per engine would clobber the registry entry and
+    drop earlier engines' series. A cleared registry (tests do that)
+    is detected and the metrics re-register fresh."""
     global _metrics_singletons
     from ...util import metrics as metrics_mod  # noqa: PLC0415
+    from ...util import metrics_catalog as mcat  # noqa: PLC0415
     if (_metrics_singletons is not None
-            and metrics_mod.get_metric("llm_engine_tokens_generated")
-            is not _metrics_singletons[0]):
-        # the registry was cleared (tests do); re-register fresh metrics
+            and metrics_mod.get_metric(
+                "ray_tpu_llm_engine_tokens_generated")
+            is not _metrics_singletons["tokens"]):
         _metrics_singletons = None
     if _metrics_singletons is None:
-        _metrics_singletons = (
-            metrics_mod.Counter("llm_engine_tokens_generated",
-                                "tokens sampled across all requests",
-                                tag_keys=("engine",)),
-            metrics_mod.Gauge("llm_engine_active_slots",
-                              "requests currently decoding",
-                              tag_keys=("engine",)),
-            metrics_mod.Gauge("llm_engine_waiting_requests",
-                              "requests awaiting a slot",
-                              tag_keys=("engine",)))
+        _metrics_singletons = {
+            "tokens": mcat.get("ray_tpu_llm_engine_tokens_generated"),
+            "active": mcat.get("ray_tpu_llm_engine_active_slots"),
+            "waiting": mcat.get("ray_tpu_llm_engine_waiting_requests"),
+            "occupancy": mcat.get("ray_tpu_llm_engine_batch_occupancy"),
+            "kv_util": mcat.get(
+                "ray_tpu_llm_engine_kv_page_utilization"),
+            "ttft": mcat.get("ray_tpu_llm_engine_ttft_s"),
+            "tpot": mcat.get("ray_tpu_llm_engine_tpot_s"),
+        }
     return _metrics_singletons
 
 
@@ -303,9 +307,11 @@ class LLMEngine:
             maxlen=512)
         self._prefill_compile_ms: Dict[int, float] = {}  # bucket -> ms
         # surfaced on the shared metrics registry (/metrics, dashboard);
-        # one labeled series per engine instance
+        # one labeled series per engine instance. The dict is cached
+        # here and refreshed once per engine-loop step — the per-token
+        # emit path must not take the registry lock for clear-detection
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
-        self._m_tokens, self._m_active, self._m_waiting = _engine_metrics()
+        self._m = _engine_metrics()
 
         # prefix cache: per layer (n_prefixes, L, Hkv, D) k/v + host-side
         # token records; written by register_prefix, read (copied into a
@@ -1599,7 +1605,8 @@ class LLMEngine:
               logp: Optional[float] = None):
         req.generated += 1
         self.stats["tokens_generated"] += 1
-        self._m_tokens.inc(1.0, tags=self._mtags)
+        m = self._m
+        m["tokens"].inc(1.0, tags=self._mtags)
         if req.first_token_ts is None:
             now = time.time()
             req.first_token_ts = now
@@ -1610,6 +1617,7 @@ class LLMEngine:
                 "emit_ms": max(0.0, (now - admit) * 1000
                                - req.prefill_dispatch_ms),
                 "total_ms": (now - req.submit_ts) * 1000})
+            m["ttft"].observe(now - req.submit_ts, tags=self._mtags)
         if req.hist is not None:
             req.hist.append(tok)
         req.out_queue.put(("token", (tok, logp)))
@@ -1666,6 +1674,13 @@ class LLMEngine:
 
     def _release(self, req: _Request):
         req.out_queue.put(_END)
+        if req.first_token_ts is not None and req.generated > 1:
+            try:
+                self._m["tpot"].observe(
+                    (time.time() - req.first_token_ts)
+                    / (req.generated - 1), tags=self._mtags)
+            except Exception:
+                pass
         if req.slot >= 0:
             self._free_slot_pages(req.slot)
             self._free_slots.append(req.slot)
@@ -1741,7 +1756,10 @@ class LLMEngine:
             buf[slot] = True
             del prev[slot]
         for slot, r in guided.items():
-            key = (id(r), r.fsm_state)
+            # key on the request_id, NOT id(r): a freed _Request's
+            # address can be reused by a new guided request, which
+            # would then silently inherit the stale mask row
+            key = (r.request_id, r.fsm_state)
             if prev.get(slot) != key:
                 buf[slot] = r.fsm.allowed(r.fsm_state)
                 prev[slot] = key
@@ -2113,10 +2131,18 @@ class LLMEngine:
                         inflight.append(("decode", snapshot, toks,
                                          logps if self.cfg.logprobs
                                          else None))
-                self._m_active.set(float(len(self._active)),
-                                   tags=self._mtags)
-                self._m_waiting.set(float(self._waiting.qsize()),
-                                    tags=self._mtags)
+                m = self._m = _engine_metrics()
+                m["active"].set(float(len(self._active)),
+                                tags=self._mtags)
+                m["waiting"].set(float(self._waiting.qsize()),
+                                 tags=self._mtags)
+                m["occupancy"].set(
+                    len(self._active) / max(1, self.cfg.max_slots),
+                    tags=self._mtags)
+                if self._paged:
+                    m["kv_util"].set(
+                        (self._n_pages - len(self._free_pages))
+                        / max(1, self._n_pages), tags=self._mtags)
                 if not inflight:
                     time.sleep(0.002)
                     continue

@@ -218,6 +218,26 @@ class OpenAIServer(LLMServer):
             return err
         return super().__call__(body)
 
+    def _submit_n(self, n: int, suffix, prefix_id, sp) -> List[str]:
+        """Submit all n choices; if the k-th submit raises (e.g. the
+        pool can never admit it), abort the k-1 already-submitted
+        request ids before re-raising — mirroring the _collect cleanup,
+        so failed multi-choice calls never strand siblings on the
+        engine."""
+        rids: List[str] = []
+        try:
+            for _ in range(n):
+                rids.append(self.engine.submit(
+                    suffix, prefix_id=prefix_id, **sp))
+        except BaseException:
+            for r in rids:
+                try:
+                    self.engine.abort(r)
+                except Exception:
+                    pass
+            raise
+        return rids
+
     @staticmethod
     def _n_choices(body: Dict[str, Any]) -> int:
         raw = body.get("n")
@@ -237,8 +257,7 @@ class OpenAIServer(LLMServer):
         suffix, prefix_id = self._match_prefix(prompt)
         n = self._n_choices(body)
         # all n submits enter the engine together and continuous-batch
-        rids = [self.engine.submit(suffix, prefix_id=prefix_id, **sp)
-                for _ in range(n)]
+        rids = self._submit_n(n, suffix, prefix_id, sp)
         oid = f"cmpl-{next(_req_ids)}"
         if body.get("stream"):
             return self._stream_events(
@@ -285,8 +304,7 @@ class OpenAIServer(LLMServer):
         sp, stops, effective = self._sampling(body, len(prompt))
         suffix, prefix_id = self._match_prefix(prompt)
         n = self._n_choices(body)
-        rids = [self.engine.submit(suffix, prefix_id=prefix_id, **sp)
-                for _ in range(n)]
+        rids = self._submit_n(n, suffix, prefix_id, sp)
         rid = rids[0]
         oid = f"chatcmpl-{next(_req_ids)}"
         if body.get("stream"):

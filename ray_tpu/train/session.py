@@ -41,10 +41,35 @@ class TrainSession:
         self.context = context
         self._report_fn = report_fn
         self.iteration = 0
+        self._last_report_t: Optional[float] = None
+
+    def _record_builtin_metrics(self, metrics: Dict[str, Any]) -> None:
+        """Mirror the loop's cadence and well-known throughput keys onto
+        the registry — these ship to the driver's exposition over the
+        worker telemetry channel, giving bench.py a driver-captured
+        source for step-time / tokens/s / MFU artifacts. Never raises."""
+        import time  # noqa: PLC0415
+        try:
+            from ..util import metrics_catalog as mcat  # noqa: PLC0415
+            now = time.perf_counter()
+            if self._last_report_t is not None:
+                mcat.get("ray_tpu_train_step_time_s").observe(
+                    now - self._last_report_t)
+            self._last_report_t = now
+            mcat.get("ray_tpu_train_reports_total").inc()
+            for key, gauge in (("tokens_per_s",
+                                "ray_tpu_train_tokens_per_s"),
+                               ("mfu", "ray_tpu_train_mfu")):
+                v = metrics.get(key)
+                if isinstance(v, (int, float)):
+                    mcat.get(gauge).set(float(v))
+        except Exception:
+            pass
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Any] = None) -> None:
         self.iteration += 1
+        self._record_builtin_metrics(metrics)
         payload = {"metrics": dict(metrics), "iteration": self.iteration,
                    "rank": self.context.world_rank}
         if checkpoint is not None:

@@ -4,13 +4,22 @@ Reference counterpart: python/ray/util/metrics.py (user-facing metric
 objects) and python/ray/_private/metrics_agent.py (export). Metrics live
 in an in-process registry; `exposition()` renders the Prometheus text
 format the dashboard serves at /metrics.
+
+Cluster-wide plane: each worker / node-agent process periodically ships a
+DELTA snapshot of its local registry to the driver (DeltaExporter in this
+module + the telemetry pusher in core/worker.py / core/node.py); the
+driver merges them into a ClusterMetricsStore — counters and histogram
+buckets sum, gauges are last-write — with every remote series tagged
+node_id/worker_id. `cluster_exposition()` renders local + merged remote
+series as one Prometheus document (what the dashboard's /metrics serves),
+so worker-side recordings are visible from one scrape.
 """
 from __future__ import annotations
 
 import bisect
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
@@ -200,15 +209,8 @@ def exposition() -> str:
         lines.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, Histogram):
             for key, (buckets, total, count) in m._series():
-                acc = 0
-                for i, b in enumerate(m.boundaries):
-                    acc += buckets[i]
-                    tk = key + (("le", str(b)),)
-                    lines.append(f"{m.name}_bucket{_fmt_tags(tk)} {acc}")
-                tk = key + (("le", "+Inf"),)
-                lines.append(f"{m.name}_bucket{_fmt_tags(tk)} {count}")
-                lines.append(f"{m.name}_sum{_fmt_tags(key)} {total}")
-                lines.append(f"{m.name}_count{_fmt_tags(key)} {count}")
+                _render_histogram_series(lines, m.name, key, m.boundaries,
+                                         buckets, total, count)
         else:
             for key, v in m._series():
                 lines.append(f"{m.name}{_fmt_tags(key)} {v}")
@@ -223,3 +225,222 @@ def get_metric(name: str) -> Optional[Metric]:
 def clear_registry() -> None:
     with _registry_lock:
         _registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# Worker -> driver shipping: delta snapshots + driver-side merge store.
+# ---------------------------------------------------------------------------
+
+def _snapshot_registry() -> List[tuple]:
+    """[(name, kind, help, boundaries|None, {tags_key: value})] of every
+    local metric. Histogram values are (buckets, sum, count)."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out = []
+    for m in metrics:
+        boundaries = m.boundaries if isinstance(m, Histogram) else None
+        out.append((m.name, m.kind, m.description, boundaries,
+                    dict(m._series())))
+    return out
+
+
+class DeltaExporter:
+    """Diffs the local registry against the last collect() so repeated
+    pushes ship only increments (counters / histograms) or current values
+    (gauges). A registry clear (tests) resets the baseline: a counter
+    that shrank is treated as restarted and its full value re-ships."""
+
+    def __init__(self):
+        self._last: Dict[tuple, Any] = {}   # (name, tags_key) -> value
+
+    def collect(self) -> Optional[dict]:
+        """A payload for ClusterMetricsStore.ingest, or None when
+        nothing changed since the previous collect."""
+        shipped = []
+        for name, kind, help_, boundaries, series in _snapshot_registry():
+            rows = []
+            for key, val in series.items():
+                lk = (name, key)
+                if kind == "gauge":
+                    if self._last.get(lk) != val:
+                        self._last[lk] = val
+                        rows.append((key, val))
+                    continue
+                if kind == "histogram":
+                    buckets, total, count = val
+                    lb, lt, lc = self._last.get(lk) or \
+                        ([0] * len(buckets), 0.0, 0)
+                    if count < lc or len(lb) != len(buckets):
+                        lb, lt, lc = [0] * len(buckets), 0.0, 0  # restart
+                    if count == lc:
+                        continue
+                    rows.append((key, ([b - p for b, p in
+                                        zip(buckets, lb)],
+                                       total - lt, count - lc)))
+                    self._last[lk] = (list(buckets), total, count)
+                    continue
+                # counter (and any future monotonic kind)
+                last = self._last.get(lk, 0.0)
+                if val < last:
+                    last = 0.0                    # restarted
+                if val == last:
+                    continue
+                rows.append((key, val - last))
+                self._last[lk] = val
+            if rows:
+                shipped.append({"name": name, "kind": kind, "help": help_,
+                                "boundaries": boundaries, "series": rows})
+        return {"metrics": shipped} if shipped else None
+
+
+class ClusterMetricsStore:
+    """Driver-side merge of remote delta snapshots. Counters and
+    histogram buckets accumulate; gauges keep the last write. Every
+    remote series is re-keyed with the source's node_id/worker_id tags
+    (which win over any same-named tag the remote set).
+
+    Lifecycle: when a source dies, drop_source() removes its GAUGE
+    series (a dead worker's "current state" is a lie) while counters/
+    histograms stay (they are historical facts). A per-metric series
+    cap bounds memory under sustained worker churn — oldest series
+    drop first."""
+
+    _SERIES_CAP = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {"kind", "help", "series": {tags_key: value},
+        #          "boundaries": {tags_key: tuple}}
+        self._metrics: Dict[str, dict] = {}
+
+    def drop_source(self, source_tags: Dict[str, str]) -> None:
+        """Remove gauge series carrying ALL of source_tags (called when
+        the worker/node that shipped them dies)."""
+        items = tuple(source_tags.items())
+        with self._lock:
+            for ent in self._metrics.values():
+                if ent["kind"] != "gauge":
+                    continue
+                doomed = [k for k in ent["series"]
+                          if all(pair in k for pair in items)]
+                for k in doomed:
+                    del ent["series"][k]
+
+    def ingest(self, source_tags: Dict[str, str], payload: dict) -> None:
+        if not payload:
+            return
+        with self._lock:
+            for m in payload.get("metrics", ()):
+                ent = self._metrics.setdefault(
+                    m["name"], {"kind": m["kind"],
+                                "help": m.get("help", ""),
+                                "series": {}, "boundaries": {}})
+                if ent["kind"] != m["kind"]:
+                    continue  # conflicting registration; drop
+                for key, val in m["series"]:
+                    tags = dict(key)
+                    tags.update(source_tags)
+                    skey = tuple(sorted(tags.items()))
+                    while (skey not in ent["series"]
+                           and len(ent["series"]) >= self._SERIES_CAP):
+                        oldest = next(iter(ent["series"]))
+                        del ent["series"][oldest]
+                        ent["boundaries"].pop(oldest, None)
+                    if m["kind"] == "gauge":
+                        ent["series"][skey] = val
+                    elif m["kind"] == "histogram":
+                        buckets, total, count = val
+                        pb, pt, pc = ent["series"].get(skey) or \
+                            ([0] * len(buckets), 0.0, 0)
+                        if len(pb) != len(buckets):
+                            pb, pt, pc = [0] * len(buckets), 0.0, 0
+                        ent["series"][skey] = (
+                            [a + b for a, b in zip(pb, buckets)],
+                            pt + total, pc + count)
+                        ent["boundaries"][skey] = tuple(
+                            m.get("boundaries") or ())
+                    else:
+                        ent["series"][skey] = \
+                            ent["series"].get(skey, 0.0) + val
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: {"kind": e["kind"], "help": e["help"],
+                           "series": dict(e["series"]),
+                           "boundaries": dict(e["boundaries"])}
+                    for name, e in self._metrics.items()}
+
+
+def _render_histogram_series(lines: List[str], name: str, key: tuple,
+                             boundaries, buckets, total, count) -> None:
+    acc = 0
+    for i, b in enumerate(boundaries):
+        acc += buckets[i]
+        tk = key + (("le", str(b)),)
+        lines.append(f"{name}_bucket{_fmt_tags(tk)} {acc}")
+    tk = key + (("le", "+Inf"),)
+    lines.append(f"{name}_bucket{_fmt_tags(tk)} {count}")
+    lines.append(f"{name}_sum{_fmt_tags(key)} {total}")
+    lines.append(f"{name}_count{_fmt_tags(key)} {count}")
+
+
+def cluster_exposition(remote: Optional[ClusterMetricsStore] = None) -> str:
+    """Prometheus text exposition of the local registry MERGED with the
+    remote series shipped to this process's driver runtime (all of a
+    metric's series stay grouped under one # TYPE header, as the format
+    requires). Falls back to the local registry alone when no runtime —
+    or no store — is up."""
+    if remote is None:
+        try:
+            from ..core import runtime as runtime_mod  # noqa: PLC0415
+            if runtime_mod.runtime_initialized():
+                remote = getattr(runtime_mod.get_runtime(),
+                                 "cluster_metrics", None)
+        except Exception:
+            remote = None
+    remote_snap = remote.snapshot() if remote is not None else {}
+
+    lines: List[str] = []
+    seen: set = set()
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        seen.add(m.name)
+        help_ = m.description
+        rm = remote_snap.get(m.name)
+        if not help_ and rm:
+            help_ = rm["help"]
+        if help_:
+            lines.append(f"# HELP {m.name} {help_}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            for key, (buckets, total, count) in m._series():
+                _render_histogram_series(lines, m.name, key, m.boundaries,
+                                         buckets, total, count)
+        else:
+            for key, v in m._series():
+                lines.append(f"{m.name}{_fmt_tags(key)} {v}")
+        if rm is not None and rm["kind"] == m.kind:
+            for key, val in rm["series"].items():
+                if m.kind == "histogram":
+                    buckets, total, count = val
+                    bnd = rm["boundaries"].get(key) or m.boundaries
+                    _render_histogram_series(lines, m.name, key, bnd,
+                                             buckets, total, count)
+                else:
+                    lines.append(f"{m.name}{_fmt_tags(key)} {val}")
+    for name, rm in remote_snap.items():
+        if name in seen:
+            continue
+        if rm["help"]:
+            lines.append(f"# HELP {name} {rm['help']}")
+        lines.append(f"# TYPE {name} {rm['kind']}")
+        for key, val in rm["series"].items():
+            if rm["kind"] == "histogram":
+                buckets, total, count = val
+                bnd = rm["boundaries"].get(key) or DEFAULT_BOUNDARIES
+                _render_histogram_series(lines, name, key, bnd,
+                                         buckets, total, count)
+            else:
+                lines.append(f"{name}{_fmt_tags(key)} {val}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,128 @@
+"""Catalog of every built-in `ray_tpu_`-prefixed metric.
+
+One place declares name / kind / help / tags / unit for the runtime's
+own telemetry (docs/OBSERVABILITY.md renders this table; a tier-1 test
+asserts the naming rules). Hot paths call `get(name)` — it returns the
+live registry entry, re-creating it if tests cleared the registry, so
+instrumentation sites never hold a stale Metric across clears.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from . import metrics as metrics_mod
+
+# name -> (kind, help, tag_keys, unit, boundaries|None)
+_SPEC = Tuple[str, str, Tuple[str, ...], str,
+              Optional[Sequence[float]]]
+
+# Sub-second latency boundaries for per-token / per-step observations.
+_FAST = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 5)
+
+BUILTIN: Dict[str, _SPEC] = {
+    # ---- core runtime (driver side) ----
+    "ray_tpu_tasks_submitted_total": (
+        "counter", "tasks registered with the scheduler", ("kind",),
+        "tasks", None),
+    "ray_tpu_tasks_finished_total": (
+        "counter", "tasks reaching a terminal state", ("state",),
+        "tasks", None),
+    "ray_tpu_task_sched_latency_s": (
+        "histogram", "submit -> dispatch latency", (), "seconds", None),
+    "ray_tpu_task_run_s": (
+        "histogram", "dispatch -> completion latency (driver view)",
+        (), "seconds", None),
+    "ray_tpu_workers": (
+        "gauge", "worker processes by state", ("state",), "workers",
+        None),
+    "ray_tpu_pending_tasks": (
+        "gauge", "tasks waiting for placement", (), "tasks", None),
+    "ray_tpu_object_store_used_bytes": (
+        "gauge", "bytes sealed in the local object store", (), "bytes",
+        None),
+    "ray_tpu_object_store_capacity_bytes": (
+        "gauge", "local object-store capacity", (), "bytes", None),
+    "ray_tpu_object_store_objects": (
+        "gauge", "objects resident in the local arena", (), "objects",
+        None),
+    "ray_tpu_object_store_reads_total": (
+        "counter", "object reads by outcome "
+        "(inline / hit / spill fallback)", ("result",), "reads", None),
+    # ---- worker processes (shipped to the driver exposition) ----
+    "ray_tpu_worker_task_run_s": (
+        "histogram", "task execution latency measured IN the worker",
+        (), "seconds", None),
+    "ray_tpu_worker_tasks_total": (
+        "counter", "tasks executed by this worker", ("status",),
+        "tasks", None),
+    # ---- serve LLM engine ----
+    "ray_tpu_llm_engine_tokens_generated": (
+        "counter", "tokens sampled across all requests", ("engine",),
+        "tokens", None),
+    "ray_tpu_llm_engine_active_slots": (
+        "gauge", "requests currently decoding", ("engine",), "requests",
+        None),
+    "ray_tpu_llm_engine_waiting_requests": (
+        "gauge", "requests awaiting a slot", ("engine",), "requests",
+        None),
+    "ray_tpu_llm_engine_batch_occupancy": (
+        "gauge", "active slots / max_slots", ("engine",), "ratio", None),
+    "ray_tpu_llm_engine_kv_page_utilization": (
+        "gauge", "KV pages in use / pool pages (paged engines)",
+        ("engine",), "ratio", None),
+    "ray_tpu_llm_engine_ttft_s": (
+        "histogram", "submit -> first token", ("engine",), "seconds",
+        None),
+    "ray_tpu_llm_engine_tpot_s": (
+        "histogram", "mean time per output token after the first",
+        ("engine",), "seconds", _FAST),
+    # ---- data executor ----
+    "ray_tpu_data_inflight_bytes": (
+        "gauge", "bytes of blocks in flight in a streaming stage",
+        ("stage",), "bytes", None),
+    "ray_tpu_data_backpressure_stall_s_total": (
+        "counter", "seconds the producer stalled on the in-flight "
+        "byte/count budget", ("stage",), "seconds", None),
+    "ray_tpu_data_blocks_total": (
+        "counter", "blocks processed by a streaming stage", ("stage",),
+        "blocks", None),
+    # ---- train loop ----
+    "ray_tpu_train_step_time_s": (
+        "histogram", "wall time between session.report() calls",
+        (), "seconds", None),
+    "ray_tpu_train_reports_total": (
+        "counter", "session.report() calls", (), "reports", None),
+    "ray_tpu_train_tokens_per_s": (
+        "gauge", "training throughput (mirrors the reported "
+        "tokens_per_s metric)", (), "tokens/s", None),
+    "ray_tpu_train_mfu": (
+        "gauge", "model FLOPs utilization (mirrors the reported mfu "
+        "metric)", (), "ratio", None),
+}
+
+_create_lock = threading.Lock()
+
+
+def get(name: str) -> metrics_mod.Metric:
+    """The live registry Metric for a catalog name (created on first use
+    and re-created after clear_registry)."""
+    m = metrics_mod.get_metric(name)
+    if m is not None:
+        return m
+    spec = BUILTIN.get(name)
+    if spec is None:
+        raise KeyError(f"{name!r} is not a cataloged built-in metric")
+    kind, help_, tag_keys, _unit, boundaries = spec
+    with _create_lock:
+        m = metrics_mod.get_metric(name)
+        if m is not None:
+            return m
+        if kind == "counter":
+            return metrics_mod.Counter(name, help_, tag_keys=tag_keys)
+        if kind == "gauge":
+            return metrics_mod.Gauge(name, help_, tag_keys=tag_keys)
+        return metrics_mod.Histogram(
+            name, help_,
+            boundaries=boundaries or metrics_mod.DEFAULT_BOUNDARIES,
+            tag_keys=tag_keys)

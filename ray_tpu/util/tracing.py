@@ -1,0 +1,54 @@
+"""Cross-process trace-span context.
+
+Reference counterpart: OpenTelemetry-style span propagation through
+ray.remote submissions (python/ray/util/tracing/). Kept dependency-free:
+a span context is just (trace_id, span_id) carried on the TaskSpec; the
+submitting side stamps the spec with a fresh submit-span id parented to
+whatever span is active on the current thread, and the executing worker
+opens a child execution span whose record ships back to the driver over
+the telemetry channel (core/worker.py) so observability/timeline.py can
+export one parented tree across processes.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import uuid
+from typing import Optional, Tuple
+
+_local = threading.local()
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def current() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the span active on this thread, or None."""
+    return getattr(_local, "ctx", None)
+
+
+@contextlib.contextmanager
+def active(trace_id: str, span_id: str):
+    """Make (trace_id, span_id) the current span for this thread; tasks
+    submitted inside the block parent to it."""
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = (trace_id, span_id)
+    try:
+        yield
+    finally:
+        _local.ctx = prev
+
+
+def submit_context() -> Tuple[str, str, str]:
+    """(trace_id, span_id, parent_span_id) for a task being submitted on
+    this thread. The returned span_id names the SUBMIT span (queued →
+    dispatched, driver side); the worker's execution span parents to it."""
+    ctx = current()
+    if ctx is None:
+        return new_trace_id(), new_span_id(), ""
+    return ctx[0], new_span_id(), ctx[1]

@@ -181,3 +181,48 @@ def test_compile_guided_spec_end_to_end(model_params):
                        [vocab["f"], vocab["d"]]), got
     finally:
         eng.shutdown()
+
+
+def test_guided_allow_cache_keys_on_request_id(model_params):
+    """ADVICE r5: the per-slot mask cache must key on (request_id,
+    fsm_state), NOT (id(request), fsm_state) — a freed _Request's
+    address can be reused by a new guided request, which would then
+    inherit a stale mask row. Exercise the cache directly: swapping a
+    slot's occupant for a different request with the SAME fsm_state
+    must recompute the row, and the cached keys must be request ids."""
+    import numpy as _np
+    from ray_tpu.serve.llm.engine import _Request
+
+    eng = make_engine(model_params)
+    # stop the engine loop first: this test drives the host-side mask
+    # cache directly, and a live loop would decode the injected slot
+    eng.shutdown()
+    eng._loop_thread.join(timeout=30)
+    try:
+        fsm_a = TokenFSM.from_choices([[11, 12]], vocab_size=128,
+                                      eos_id=EOS)
+        fsm_b = TokenFSM.from_choices([[21, 22]], vocab_size=128,
+                                      eos_id=EOS)
+        prompt = _np.asarray(PROMPT, _np.int32)
+        r1 = _Request(request_id="req-key-a", prompt=prompt,
+                      max_new_tokens=4, temperature=0.0, fsm=fsm_a,
+                      fsm_state=fsm_a.start)
+        eng._active[0] = r1
+        m1 = _np.asarray(eng._guided_decode_allow())
+        assert m1[0, 11] and not m1[0, 21]
+        # cache keys must be derived from request_id, never id(obj)
+        for key in eng._guided_prev.values():
+            assert key[0] == "req-key-a"
+        # same slot, same fsm_state value, DIFFERENT request: the row
+        # must be rebuilt (with id()-keying this only worked while the
+        # old object's address was not reused)
+        r2 = _Request(request_id="req-key-b", prompt=prompt,
+                      max_new_tokens=4, temperature=0.0, fsm=fsm_b,
+                      fsm_state=fsm_b.start)
+        assert r2.fsm_state == r1.fsm_state
+        eng._active[0] = r2
+        m2 = _np.asarray(eng._guided_decode_allow())
+        assert m2[0, 21] and not m2[0, 11]
+    finally:
+        eng._active.pop(0, None)
+        eng.shutdown()

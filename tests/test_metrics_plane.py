@@ -1,0 +1,311 @@
+"""Cluster-wide metrics plane + cross-process trace spans.
+
+Covers: built-in metric naming rules (catalog lint), worker->driver
+delta shipping and merge, the driver's unified /metrics exposition,
+hot-path instrumentation (core, serve LLM, data, train), and the
+parented submit->execute span tree in the timeline export.
+"""
+import json
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import metrics_catalog as mcat
+
+
+@ray_tpu.remote
+def _sq(x):
+    return x * x
+
+
+@ray_tpu.remote
+def _nested(x):
+    return ray_tpu.get(_sq.remote(x)) + 1
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def _poll(fn, timeout=15.0, interval=0.25):
+    """Poll fn() until truthy (telemetry ships asynchronously)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+# ---------- naming rules (satellite: catalog lint) ----------
+
+_NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+
+
+def test_builtin_metric_names_prefixed_snake_unique():
+    names = list(mcat.BUILTIN)
+    assert len(names) == len(set(names))
+    for name in names:
+        assert _NAME_RE.match(name), \
+            f"built-in metric {name!r} must be ray_tpu_-prefixed " \
+            f"snake_case"
+        kind, help_, tag_keys, unit, _bnd = mcat.BUILTIN[name]
+        assert kind in ("counter", "gauge", "histogram")
+        assert help_ and unit
+        m = mcat.get(name)
+        assert m.kind == kind and m.name == name
+    # every catalog name resolves to exactly one registry entry
+    assert len({id(mcat.get(n)) for n in names}) == len(names)
+
+
+def test_no_uncataloged_builtin_metric_literals():
+    """Lint: any Counter/Gauge/Histogram constructed with a literal name
+    inside the package must use a cataloged ray_tpu_ name (user-facing
+    metric classes stay unrestricted — this scans ray_tpu/ only)."""
+    import os
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ray_tpu")
+    ctor = re.compile(
+        r"(?:Counter|Gauge|Histogram)\(\s*['\"]([A-Za-z0-9_]+)['\"]")
+    offenders = []
+    for root, _dirs, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py") or f in ("metrics.py",):
+                continue
+            path = os.path.join(root, f)
+            with open(path) as fh:
+                for name in ctor.findall(fh.read()):
+                    if name not in mcat.BUILTIN or \
+                            not _NAME_RE.match(name):
+                        offenders.append((path, name))
+    assert not offenders, offenders
+
+
+# ---------- delta shipping + merge (unit) ----------
+
+def test_delta_exporter_and_cluster_store_merge():
+    metrics_mod.clear_registry()
+    c = mcat.get("ray_tpu_tasks_submitted_total")
+    h = mcat.get("ray_tpu_task_run_s")
+    g = mcat.get("ray_tpu_pending_tasks")
+    exporter = metrics_mod.DeltaExporter()
+    store = metrics_mod.ClusterMetricsStore()
+    src = {"node_id": "nodeA", "worker_id": "w1"}
+
+    c.inc(3, tags={"kind": "task"})
+    h.observe(0.02)
+    g.set(5)
+    store.ingest(src, exporter.collect())
+    c.inc(2, tags={"kind": "task"})
+    h.observe(0.6)
+    g.set(1)
+    store.ingest(src, exporter.collect())
+    # an idle collect ships nothing
+    assert exporter.collect() is None
+
+    snap = store.snapshot()
+    key = tuple(sorted({"kind": "task", **src}.items()))
+    assert snap["ray_tpu_tasks_submitted_total"]["series"][key] == 5.0
+    hkey = tuple(sorted(src.items()))
+    buckets, total, count = snap["ray_tpu_task_run_s"]["series"][hkey]
+    assert count == 2 and abs(total - 0.62) < 1e-9
+    assert snap["ray_tpu_pending_tasks"]["series"][hkey] == 1.0
+
+    text = metrics_mod.cluster_exposition(remote=store)
+    assert 'ray_tpu_tasks_submitted_total{kind="task",node_id="nodeA"' \
+           in text
+    assert 'ray_tpu_task_run_s_count{node_id="nodeA",worker_id="w1"} 2' \
+           in text
+
+
+def test_delta_exporter_restart_reships_full_value():
+    metrics_mod.clear_registry()
+    exporter = metrics_mod.DeltaExporter()
+    c = mcat.get("ray_tpu_worker_tasks_total")
+    c.inc(4, tags={"status": "ok"})
+    exporter.collect()
+    metrics_mod.clear_registry()          # process-level restart analog
+    c2 = mcat.get("ray_tpu_worker_tasks_total")
+    c2.inc(1, tags={"status": "ok"})
+    payload = exporter.collect()
+    rows = {m["name"]: dict(m["series"]) for m in payload["metrics"]}
+    key = (("status", "ok"),)
+    assert rows["ray_tpu_worker_tasks_total"][key] == 1.0
+
+
+# ---------- worker -> driver shipping (live) ----------
+
+def test_cluster_exposition_contains_worker_series(rt):
+    ray_tpu.get([_sq.remote(i) for i in range(4)])
+    d = _Doubler.remote()
+    assert ray_tpu.get(d.double.remote(3)) == 6
+
+    def check():
+        text = metrics_mod.cluster_exposition()
+        return ("ray_tpu_worker_task_run_s_bucket" in text
+                and 'worker_id="' in text and 'node_id="' in text
+                and text)
+    text = _poll(check)
+    assert text, "worker-side series never reached the driver"
+    # driver-side hot-path series are there too
+    assert "ray_tpu_tasks_submitted_total" in text
+    assert "ray_tpu_task_sched_latency_s_count" in text
+    assert 'ray_tpu_worker_tasks_total{node_id="' in text
+
+
+def test_timeline_cross_process_spans(rt):
+    ray_tpu.get(_nested.remote(7))
+    from ray_tpu.observability import timeline_events
+
+    def check():
+        # the three conditions are ALL polled: spans ship asynchronously
+        # (per-task flush throttle + heartbeat), so any single-shot
+        # assertion here would race the telemetry channel
+        evs = timeline_events()
+        submit_ids = {e["args"].get("span_id") for e in evs
+                      if e.get("cat") == "submit"}
+        execs = [e for e in evs if e.get("cat") == "task_exec"]
+        if not execs:
+            return None
+        if not any(e["args"].get("parent_span_id") in submit_ids
+                   for e in execs):
+            return None
+        # nested submission: some submit span parents to an exec span
+        exec_ids = {e["args"]["span_id"] for e in execs}
+        if not any(e.get("cat") == "submit"
+                   and e["args"].get("parent_span_id") in exec_ids
+                   for e in evs):
+            return None
+        return evs
+    evs = _poll(check)
+    assert evs, "no parented worker execution / nested submit spans"
+    execs = [e for e in evs if e.get("cat") == "task_exec"]
+    assert all("ts" in e and "dur" in e for e in execs)
+    # flow arrows bind the tree for Perfetto
+    assert any(e.get("ph") == "s" for e in evs)
+    assert any(e.get("ph") == "f" for e in evs)
+
+
+# ---------- acceptance integration: tasks + serve + data ----------
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax
+    from ray_tpu.models import Llama, LlamaConfig
+    from ray_tpu.serve.llm import LLMEngine, LLMEngineConfig
+    cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=128,
+                      remat=False)
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = LLMEngine(model, params, LLMEngineConfig(
+        max_slots=2, max_seq_len=128, prefill_buckets=(16, 32),
+        eos_token_id=0))
+    yield eng
+    eng.shutdown()
+
+
+def test_metrics_plane_integration(rt, tiny_engine):
+    """Acceptance: drive tasks/actors plus a short serve+data workload;
+    the driver's /metrics exposition must contain series recorded
+    INSIDE worker processes (node_id/worker_id tags, task-latency
+    histograms) and engine TTFT/TPOT; the timeline must contain
+    worker-side spans parented to driver-side submit spans."""
+    from ray_tpu import data
+
+    # tasks + actor
+    ray_tpu.get([_sq.remote(i) for i in range(3)])
+    a = _Doubler.remote()
+    ray_tpu.get(a.double.remote(2))
+    # data workload over the runtime (distributed streaming stage)
+    out = data.range(64, block_rows=16).map_batches(
+        lambda b: {"id": b["id"] * 2}).take_all()
+    assert len(out) == 64
+    # serve LLM workload
+    toks = tiny_engine.generate_sync(np.arange(1, 9), max_new_tokens=6)
+    assert len(toks) >= 1
+
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+    dash = start_dashboard()
+    try:
+        def scrape():
+            with urllib.request.urlopen(dash.url + "/metrics",
+                                        timeout=5) as r:
+                text = r.read().decode()
+            ok = ("ray_tpu_worker_task_run_s_bucket" in text
+                  and 'worker_id="' in text and 'node_id="' in text
+                  and "ray_tpu_llm_engine_ttft_s_count" in text)
+            return text if ok else None
+        text = _poll(scrape)
+        assert text, "merged exposition missing worker/engine series"
+        assert "ray_tpu_llm_engine_tpot_s" in text
+        assert "ray_tpu_llm_engine_tokens_generated" in text
+        assert "ray_tpu_data_blocks_total" in text
+        assert "ray_tpu_data_inflight_bytes" in text
+        assert "ray_tpu_tasks_finished_total" in text
+
+        with urllib.request.urlopen(dash.url + "/api/timeline",
+                                    timeout=5) as r:
+            evs = json.loads(r.read())
+        submit_ids = {e["args"].get("span_id") for e in evs
+                      if e.get("cat") == "submit"}
+        execs = [e for e in evs if e.get("cat") == "task_exec"]
+        assert execs and any(
+            e["args"].get("parent_span_id") in submit_ids
+            for e in execs)
+    finally:
+        stop_dashboard()
+
+
+# ---------- train session instrumentation ----------
+
+def test_train_session_builtin_metrics():
+    metrics_mod.clear_registry()
+    from ray_tpu.train.session import (TrainContext, clear_session,
+                                       init_session)
+    reports = []
+    session = init_session(TrainContext(), reports.append)
+    try:
+        session.report({"loss": 1.0, "tokens_per_s": 1234.0,
+                        "mfu": 0.41})
+        session.report({"loss": 0.5, "tokens_per_s": 2000.0})
+    finally:
+        clear_session()
+    assert len(reports) == 2
+    assert mcat.get("ray_tpu_train_reports_total").get() == 2.0
+    assert mcat.get("ray_tpu_train_tokens_per_s").get() == 2000.0
+    assert mcat.get("ray_tpu_train_mfu").get() == 0.41
+    h = mcat.get("ray_tpu_train_step_time_s")
+    assert h._count.get((), 0) == 1   # first report seeds the clock
+
+
+# ---------- CLI pretty-printer ----------
+
+def test_cli_metrics_pretty_format():
+    from ray_tpu.cli import _format_metrics
+    text = (
+        "# HELP ray_tpu_tasks_submitted_total tasks registered\n"
+        "# TYPE ray_tpu_tasks_submitted_total counter\n"
+        'ray_tpu_tasks_submitted_total{kind="task"} 5.0\n'
+        "# TYPE ray_tpu_task_run_s histogram\n"
+        'ray_tpu_task_run_s_bucket{le="0.1"} 1\n'
+        'ray_tpu_task_run_s_bucket{le="+Inf"} 2\n'
+        "ray_tpu_task_run_s_sum 0.52\n"
+        "ray_tpu_task_run_s_count 2\n")
+    out = _format_metrics(text)
+    assert "ray_tpu_tasks_submitted_total (counter)" in out
+    assert 'kind="task"' in out and "5" in out
+    assert "ray_tpu_task_run_s (histogram)" in out
+    assert "count=2" in out and "mean=0.26" in out
+    # substring filter
+    assert "tasks_submitted" not in _format_metrics(
+        text, needle="task_run")

@@ -360,3 +360,43 @@ def test_guided_json_over_api(openai_app):
     doc = json.loads(out["choices"][0]["text"])
     assert isinstance(doc, list) and 1 <= len(doc) <= 3
     assert all(isinstance(x, int) for x in doc)
+
+
+def test_n_choices_submit_failure_aborts_siblings():
+    """ADVICE r5: if engine.submit raises on the k-th of n choices, the
+    k-1 already-submitted request ids must be aborted before the error
+    propagates (mirrors the _collect cleanup) — otherwise they decode
+    to completion with no consumer and strand slots on the engine."""
+    from ray_tpu.serve.llm.openai_api import OpenAIServer
+
+    server = OpenAIServer(
+        _factory, tokenizer=DummyTok(),
+        engine_config={"max_slots": 4, "max_seq_len": 128,
+                       "prefill_buckets": (16, 32),
+                       "max_new_tokens_default": 4})
+    try:
+        submitted, aborted = [], []
+        real_submit = server.engine.submit
+
+        def flaky_submit(*args, **kwargs):
+            if len(submitted) == 2:
+                raise RuntimeError("pool exhausted")
+            rid = real_submit(*args, **kwargs)
+            submitted.append(rid)
+            return rid
+
+        real_abort = server.engine.abort
+
+        def spy_abort(rid):
+            aborted.append(rid)
+            real_abort(rid)
+
+        server.engine.submit = flaky_submit
+        server.engine.abort = spy_abort
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            server._completions({"prompt": [1, 2, 3], "n": 3,
+                                 "max_tokens": 4})
+        assert len(submitted) == 2
+        assert sorted(aborted) == sorted(submitted)
+    finally:
+        server.engine.shutdown()

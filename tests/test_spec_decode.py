@@ -50,9 +50,26 @@ def test_spec_token_identical_contiguous(model_params):
         got = eng.generate_sync(REPETITIVE, max_new_tokens=24)
         assert got == want, (got, want)
         st = eng.get_stats()
+        # timing-independent correctness: speculation engaged (token
+        # identity asserted above); the dispatch-count payoff bound is
+        # load-sensitive and lives in the slow/perf-marked test below
         assert st.get("spec_steps", 0) > 0
-        # speculation must actually pay: fewer dispatches than tokens
-        assert st["decode_steps"] < 24
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.slow
+def test_spec_fewer_dispatches_than_tokens(model_params):
+    """Perf property: speculation must actually pay — fewer decode
+    dispatches than emitted tokens. Dispatch counts wobble under CI
+    load (the host loop may drain conservatively), so this bound is
+    perf-marked and kept out of the fast suite."""
+    eng = make_engine(model_params, spec=4)
+    try:
+        eng.generate_sync(REPETITIVE, max_new_tokens=24)
+        st = eng.get_stats()
+        assert st.get("spec_steps", 0) > 0
+        assert st["decode_steps"] < 24, st
     finally:
         eng.shutdown()
 
