@@ -673,6 +673,109 @@ def _paged_decode_ab(jax, platform: str) -> list:
     return rows
 
 
+def phase_core() -> dict:
+    """Core-runtime micro-benchmark (no jax in the measured path):
+    no-op task round-trips/s and actor calls/s over a WARM worker pool
+    (1k each), plus cross-node object movement — peer-pull MB/s over
+    the transfer plane vs driver-relay MB/s over the control
+    connections (the ratio is the whole point of the object-transfer
+    subsystem)."""
+    import json as _json
+    import subprocess as _sp
+
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+    n = int(os.environ.get("RAY_TPU_BENCH_CORE_TASKS", "1000"))
+
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    @ray_tpu.remote
+    class _Echo:
+        def ping(self):
+            return None
+
+    _progress("core: warming worker pool")
+    ray_tpu.get([_noop.remote() for _ in range(32)], timeout=120)
+    t0 = time.time()
+    ray_tpu.get([_noop.remote() for _ in range(n)], timeout=600)
+    tasks_s = n / (time.time() - t0)
+    _progress(f"core: {tasks_s:.0f} no-op tasks/s (n={n}, warm pool)")
+
+    actor = _Echo.remote()
+    ray_tpu.get(actor.ping.remote(), timeout=120)
+    t0 = time.time()
+    ray_tpu.get([actor.ping.remote() for _ in range(n)], timeout=600)
+    actor_s = n / (time.time() - t0)
+    _progress(f"core: {actor_s:.0f} actor calls/s (n={n})")
+
+    # ---- peer-pull vs driver-relay MB/s: join a second "host"
+    mb = float(os.environ.get("RAY_TPU_BENCH_CORE_MB", "64"))
+    n_elem = int(mb * (1 << 20) // 8)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, *env.get("PYTHONPATH", "").split(os.pathsep)])
+    from ray_tpu.util.jaxenv import subprocess_env_cpu
+    subprocess_env_cpu(env)
+    agent = _sp.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node", rt.tcp_address,
+         "--num-cpus", "1", "--resources", _json.dumps({"peer": 1.0}),
+         "--store-bytes", str(int(mb * 4) << 20)],
+        env=env, cwd=REPO)
+    transfer = {}
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and len(rt.cluster_nodes) < 2:
+            time.sleep(0.05)
+        if len(rt.cluster_nodes) < 2:
+            raise RuntimeError("node agent failed to register")
+        remote_nid = next(nid for nid in rt.cluster_nodes
+                          if nid != rt.node_id)
+
+        @ray_tpu.remote(resources={"peer": 1})
+        def _blob(k):
+            import numpy as np
+            return np.ones((k,), np.float64)
+
+        ref = _blob.remote(n_elem)
+        ray_tpu.wait([ref], timeout=300)
+        loc = rt.gcs.objects[ref.id].loc
+
+        def measure(label):
+            best = 0.0
+            for _ in range(3):
+                t0 = time.time()
+                data = rt.fetch_bytes(loc, oid=ref.id)
+                rate = len(data) / (time.time() - t0) / (1 << 20)
+                best = max(best, rate)
+            _progress(f"core: {label} {best:.0f} MB/s ({mb:.0f} MB blob)")
+            return round(best, 1)
+
+        transfer["peer_pull_mb_s"] = measure("peer pull")
+        addr = rt.transfer_addrs.pop(remote_nid, None)  # force the relay
+        transfer["driver_relay_mb_s"] = measure("driver relay")
+        if addr is not None:
+            rt.transfer_addrs[remote_nid] = addr
+        transfer["blob_mb"] = mb
+        if transfer["driver_relay_mb_s"]:
+            transfer["peer_vs_relay"] = round(
+                transfer["peer_pull_mb_s"]
+                / transfer["driver_relay_mb_s"], 2)
+    except BaseException as e:  # noqa: BLE001 — tasks/s still reports
+        transfer["error"] = repr(e)[:300]
+    finally:
+        try:
+            agent.terminate()
+        except OSError:
+            pass
+        ray_tpu.shutdown()
+    return {"noop_tasks_per_s": round(tasks_s, 1),
+            "actor_calls_per_s": round(actor_s, 1),
+            "n_calls": n, "transfer": transfer, "platform": "cpu"}
+
+
 def phase_serve() -> dict:
     """Serve req/s + p50 TTFT (BASELINE metric) on the continuous-batching
     LLM engine with a llama-family model."""
@@ -958,7 +1061,7 @@ def main():
     ap.add_argument("--measure-torch-baseline", action="store_true")
     ap.add_argument("--phase",
                     choices=["kernels", "train", "train-llama", "serve",
-                             "flash-ab", "probe-8b", "data"])
+                             "flash-ab", "probe-8b", "data", "core"])
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
 
@@ -974,7 +1077,8 @@ def main():
                  "serve": phase_serve,
                  "flash-ab": phase_flash_ab,
                  "probe-8b": phase_probe_8b,
-                 "data": phase_data}[args.phase]()
+                 "data": phase_data,
+                 "core": phase_core}[args.phase]()
         except BaseException as e:  # noqa: BLE001
             _progress(f"phase {args.phase} failed: {e!r}")
             raise SystemExit(3)

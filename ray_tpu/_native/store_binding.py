@@ -74,28 +74,6 @@ def get_lib() -> ctypes.CDLL:
         return _lib
 
 
-class _Pin:
-    """Holds one arena refcount for as long as any buffer view of the
-    object is alive (PEP 688: memoryview(_Pin) re-exports the arena slice
-    while keeping this object — and therefore the pin — referenced)."""
-
-    __slots__ = ("_store", "_name", "_view")
-
-    def __init__(self, store: "NativeStore", name: str, view: memoryview):
-        self._store = store
-        self._name = name
-        self._view = view
-
-    def __buffer__(self, flags: int) -> memoryview:
-        return self._view
-
-    def __del__(self):
-        try:
-            self._store._release_one(self._name)
-        except Exception:
-            pass  # interpreter teardown
-
-
 def _sweep_stale_arenas() -> None:
     """Unlink arenas whose owner pid is dead (a SIGKILLed/SIGTERMed
     driver never runs its atexit unlink, and a multi-GB /dev/shm segment
@@ -151,11 +129,25 @@ class NativeStore:
             raise RuntimeError(f"failed to map arena {name}")
         base = self._lib.rtpu_arena_base(self._handle)
         cap = self._lib.rtpu_arena_capacity(self._handle)
+        self._base_addr = ctypes.addressof(base.contents)
         # One memoryview over the whole data region; object views slice it.
         self._data = memoryview(
-            (ctypes.c_uint8 * cap).from_address(
-                ctypes.addressof(base.contents))).cast("B")
+            (ctypes.c_uint8 * cap).from_address(self._base_addr)).cast("B")
         self._lock = threading.Lock()
+
+    def _pinned_view(self, name: str, off: int, size: int) -> memoryview:
+        """Zero-copy view of a gotten (refcount-pinned) object whose pin
+        releases when the LAST derived view dies. The exporter is a
+        per-call ctypes array over the mapped pages with a
+        weakref.finalize dropping the refcount: numpy views built by
+        serialization.unpack keep the exporter alive through the buffer
+        chain. (A PEP 688 __buffer__ wrapper class would be neater, but
+        plain classes only export buffers from Python 3.12 — this must
+        run on 3.10.)"""
+        import weakref  # noqa: PLC0415
+        carr = (ctypes.c_uint8 * size).from_address(self._base_addr + off)
+        weakref.finalize(carr, self._release_one, name)
+        return memoryview(carr).cast("B")
 
     # -- write path ---------------------------------------------------------
     def put_value(self, oid: str, value: Any) -> ObjectLocation:
@@ -208,12 +200,12 @@ class NativeStore:
                     f"object {loc.name} is gone from the arena (evicted?)")
             record_read("hit")
             # The pin (refcount) lives exactly as long as the deserialized
-            # value: zero-copy numpy views keep `pin` alive through the
-            # memoryview chain; when the last view dies, __del__ unpins and
-            # the object becomes evictable again. Values with no
-            # out-of-band buffers drop the pin on return.
-            pin = _Pin(self, loc.name, self._data[off:off + size.value])
-            return serialization.unpack(memoryview(pin))
+            # value: zero-copy numpy views keep the exporter alive through
+            # the memoryview chain; when the last view dies, the finalizer
+            # unpins and the object becomes evictable again. Values with
+            # no out-of-band buffers drop the pin on return.
+            return serialization.unpack(
+                self._pinned_view(loc.name, off, size.value))
         if loc.kind == "shm":
             # A peer fell back to the pure-Python store; read its segment.
             return self._shm_fallback().get_value(loc)
@@ -251,12 +243,29 @@ class NativeStore:
             return self._shm_fallback().get_bytes(loc)
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
 
+    def get_buffer(self, loc: ObjectLocation):
+        """Packed payload as a buffer for the transfer plane: a pinned
+        zero-copy arena view when resident (the holder streams straight
+        out of shared memory), bytes otherwise (inline / spill)."""
+        if loc.kind == "native":
+            size = ctypes.c_uint64()
+            off = self._lib.rtpu_arena_get(
+                self._handle, loc.name.encode(), ctypes.byref(size))
+            if off >= 0:
+                from ..core.object_store import record_read  # noqa: PLC0415
+                record_read("hit")
+                return self._pinned_view(loc.name, off, size.value)
+        return self.get_bytes(loc)
+
     def put_packed(self, oid: str, data: bytes) -> ObjectLocation:
         """Seal an already-packed payload (cross-node fetch re-hosting)."""
         size = len(data)
         if size <= INLINE_MAX:
             return ObjectLocation(kind="inline", size=size, data=data)
-        key = oid + "c"   # distinct from any locally-created oid entry
+        # pid-suffixed (see ShmStore.put_packed): concurrent re-hosts
+        # from different processes sharing this arena must not race one
+        # unsealed entry
+        key = f"{oid}c{os.getpid():x}"
         off = self._lib.rtpu_arena_create_object(
             self._handle, key.encode(), size)
         if off == -2:

@@ -78,12 +78,33 @@ class NodeAgent:
         # block spawns/frees), bounded so they can't starve the loop.
         self._fetch_sem = threading.Semaphore(4)
 
+        # Peer-to-peer transfer plane (core/object_transfer.py): this
+        # host serves its sealed objects directly to peer nodes, and
+        # pulls remote objects into its own arena on the driver's
+        # request ("pull_object") — object bytes stop transiting the
+        # driver's control connections. Spans per pull buffer here and
+        # ship with the metrics heartbeat.
+        self._spans: list = []
+        self._spans_lock = threading.Lock()
+        from .object_transfer import (PullManager,  # noqa: PLC0415
+                                      TransferServer)
+        self.transfer_server = TransferServer(
+            self.store, spill_dirs=[os.environ["RAY_TPU_SPILL_DIR"]])
+        self.pull_manager = PullManager(
+            self.store, node_id=self.node_id, locate=self._locate,
+            span_sink=self._span_sink)
+        # locate round-trips: rid -> (Event, box)
+        self._locate_lock = threading.Lock()
+        self._locate_counter = 0
+        self._locate_events: Dict[int, tuple] = {}
+
         self.conn = connect_address(driver_address)
         self.conn.send(("register_node", {
             "node_id": self.node_id,
             "hostname": os.uname().nodename,
             "resources": dict(node_res),
             "labels": dict(self.labels),
+            "transfer_address": self.transfer_server.address,
             "pid": os.getpid(),
         }))
         # Metrics plane: this agent's registry (node-local store stats,
@@ -112,10 +133,59 @@ class NodeAgent:
                 payload = exporter.collect()
                 if payload:
                     self.conn.send(("metrics", payload))
+                with self._spans_lock:
+                    spans, self._spans = self._spans, []
+                if spans:
+                    self.conn.send(("spans", spans))
             except ConnectionClosed:
                 return
             except Exception:
                 pass  # telemetry must never kill the agent
+
+    # ---- transfer plane ---------------------------------------------------
+    def _span_sink(self, span: dict) -> None:
+        with self._spans_lock:
+            self._spans.append(span)
+
+    def _locate(self, oid: str):
+        """Ask the driver for fresh location-directory candidates (the
+        PullManager's between-rounds re-resolve). Returns the candidate
+        list, or None on timeout/disconnect."""
+        with self._locate_lock:
+            self._locate_counter += 1
+            rid = self._locate_counter
+            ev = threading.Event()
+            box: dict = {}
+            self._locate_events[rid] = (ev, box)
+        try:
+            self.conn.send(("locate", rid, oid))
+        except ConnectionClosed:
+            with self._locate_lock:
+                self._locate_events.pop(rid, None)
+            return None
+        if not ev.wait(timeout=10.0):
+            with self._locate_lock:
+                self._locate_events.pop(rid, None)
+            return None
+        return box.get("candidates")
+
+    def _serve_pull(self, rid, oid: str, candidates) -> None:
+        """Run one driver-requested pull on a thread and report the
+        local location back (or the failure, so the driver can fall
+        back to its relay path). Bounded by the same semaphore as
+        fetches — each pull buffers a whole object, so unbounded
+        concurrency would be an unbounded memory spike."""
+        with self._fetch_sem:
+            try:
+                loc = self.pull_manager.pull(oid, candidates)
+                self.conn.send(("pulled", rid, oid, loc, None))
+            except ConnectionClosed:
+                pass
+            except BaseException as e:  # noqa: BLE001
+                try:
+                    self.conn.send(("pulled", rid, oid, None, repr(e)))
+                except ConnectionClosed:
+                    pass
 
     # ---- command loop -----------------------------------------------------
     def run(self) -> None:
@@ -133,8 +203,20 @@ class NodeAgent:
     def _handle(self, m) -> None:
         mtype = m[0]
         if mtype == "node_registered":
-            _, _driver_node, job_id = m
-            self.job_id = job_id
+            self.job_id = m[2]
+        elif mtype == "pull_object":
+            _, rid, oid, candidates = m
+            threading.Thread(target=self._serve_pull,
+                             args=(rid, oid, candidates),
+                             daemon=True).start()
+        elif mtype == "locations":
+            _, rid, candidates = m
+            with self._locate_lock:
+                pair = self._locate_events.pop(rid, None)
+            if pair is not None:
+                ev, box = pair
+                box["candidates"] = candidates
+                ev.set()
         elif mtype == "spawn_worker":
             _, wid, tpu_capable, job_id = m
             self.job_id = job_id
@@ -206,6 +288,10 @@ class NodeAgent:
             env=env, cwd=os.getcwd())
 
     def _cleanup(self) -> None:
+        try:
+            self.transfer_server.close()
+        except Exception:
+            pass
         for proc in self.workers.values():
             try:
                 proc.terminate()

@@ -99,6 +99,12 @@ class ShmStore:
         # worker-created segment must not corrupt the owner's accounting.
         self._created: set = set()
         self._lock = threading.Lock()
+        # put_packed re-host synchronization: names this process is
+        # mid-write on; waiters block on the condition until the seal
+        # completes (a FileExistsError alone can't distinguish "sealed"
+        # from "still being written")
+        self._packing: set = set()
+        self._pack_cond = threading.Condition(self._lock)
 
     # -- write path ---------------------------------------------------------
     def put_value(self, oid: str, value: Any) -> ObjectLocation:
@@ -167,25 +173,75 @@ class ShmStore:
             return bytes(seg.buf[:loc.size])
         raise ObjectLostError(f"unknown location kind {loc.kind!r}")
 
+    def get_buffer(self, loc: ObjectLocation):
+        """Packed payload as a buffer for the transfer plane: a
+        zero-copy view of the mapped shm pages when the segment is
+        resident, bytes otherwise (inline / spill fallback)."""
+        if loc.kind == "shm":
+            try:
+                seg = self._attach(loc.name)
+            except ObjectLostError:
+                record_read("spill")
+                return _read_spill_loc(loc)
+            record_read("hit")
+            return seg.buf[:loc.size]
+        return self.get_bytes(loc)
+
     def put_packed(self, oid: str, data: bytes) -> ObjectLocation:
         """Seal an already-packed payload (a cross-node fetch re-hosted
         into this node's store, so local readers get zero-copy shm)."""
         size = len(data)
         if size <= INLINE_MAX:
             return ObjectLocation(kind="inline", size=size, data=data)
-        with self._lock:
+        # pid-suffixed: two PROCESSES re-hosting one object (driver relay
+        # + agent pull on a shared-host topology) must never share a
+        # segment name — a FileExistsError there can't distinguish
+        # "sealed" from "mid-write", and a torn read is silent corruption
+        name = f"rtpu_{oid.replace('-', '')}c{os.getpid():x}"
+        loc = ObjectLocation(kind="shm", size=size, name=name,
+                             node_id=current_node_id())
+        with self._pack_cond:
+            # concurrent re-hosts of the same object (two helper threads
+            # fetching it for two requesters): wait for the writer, then
+            # reuse its sealed segment instead of reading a torn copy —
+            # BEFORE the capacity check, or a repeat seal of a large
+            # already-hosted object would spuriously report a full store
+            while name in self._packing:
+                self._pack_cond.wait(timeout=30)
+            if name in self._segments:
+                return loc
             if self._used + size > self.capacity:
                 raise ObjectStoreFullError(
                     f"object {oid} ({size} B) exceeds store capacity")
-        name = "rtpu_" + oid.replace("-", "") + "c"
-        seg = shared_memory.SharedMemory(name=name, create=True, size=size)
-        seg.buf[:size] = data
-        with self._lock:
-            self._segments[name] = seg
-            self._created.add(name)
-            self._used += size
-        return ObjectLocation(kind="shm", size=size, name=name,
-                              node_id=current_node_id())
+            try:
+                seg = shared_memory.SharedMemory(name=name, create=True,
+                                                 size=size)
+            except FileExistsError:
+                # another PROCESS sealed (or is sealing) it — objects are
+                # immutable, so an existing segment is this payload; the
+                # cross-process mid-write window only exists when two
+                # stores share one host's shm namespace (test topologies)
+                return loc
+            self._packing.add(name)
+        ok = False
+        try:
+            seg.buf[:size] = data
+            ok = True
+        finally:
+            with self._pack_cond:
+                self._packing.discard(name)
+                if ok:
+                    self._segments[name] = seg
+                    self._created.add(name)
+                    self._used += size
+                self._pack_cond.notify_all()
+            if not ok:
+                seg.close()
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+        return loc
 
     def _attach(self, name: str) -> shared_memory.SharedMemory:
         with self._lock:

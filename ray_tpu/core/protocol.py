@@ -56,18 +56,7 @@ class Connection:
                 raise ConnectionClosed(str(e)) from e
 
     def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        got = 0
-        while got < n:
-            try:
-                chunk = self.sock.recv(min(n - got, 1 << 20))
-            except (ConnectionResetError, OSError) as e:
-                raise ConnectionClosed(str(e)) from e
-            if not chunk:
-                raise ConnectionClosed("peer closed")
-            chunks.append(chunk)
-            got += len(chunk)
-        return b"".join(chunks)
+        return read_exact(self.sock, n)
 
     def recv(self) -> Any:
         with self._recv_lock:
@@ -90,6 +79,52 @@ class Connection:
 
     def fileno(self) -> int:
         return self.sock.fileno()
+
+
+# ---------------------------------------------------------------------------
+# Raw byte-frame helpers — the data-plane framing used by the peer-to-peer
+# object transfer protocol (core/object_transfer.py). Unlike Connection
+# messages these frames carry opaque bytes (no pickling on the payload
+# path), so a multi-MB chunk costs one memcpy, not a serialize.
+
+def read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except (ConnectionResetError, OSError) as e:
+            raise ConnectionClosed(str(e)) from e
+        if not chunk:
+            raise ConnectionClosed("peer closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return chunks[0] if len(chunks) == 1 else b"".join(chunks)
+
+
+def write_frame(sock: socket.socket, payload) -> None:
+    """Length-prefixed raw frame; payload is bytes or any buffer."""
+    try:
+        sock.sendall(_HDR.pack(len(payload)))
+        sock.sendall(payload)
+    except (BrokenPipeError, ConnectionResetError, OSError) as e:
+        raise ConnectionClosed(str(e)) from e
+
+
+def read_frame(sock: socket.socket, max_len: int = MAX_MSG) -> bytes:
+    (length,) = _HDR.unpack(read_exact(sock, _HDR.size))
+    if length > max_len:
+        raise ConnectionClosed(f"oversized frame: {length}")
+    return read_exact(sock, length)
+
+
+def write_obj(sock: socket.socket, obj: Any) -> None:
+    """Small pickled control frame (transfer-plane handshakes only)."""
+    write_frame(sock, cloudpickle.dumps(obj, protocol=5))
+
+
+def read_obj(sock: socket.socket, max_len: int = 1 << 20) -> Any:
+    return pickle.loads(read_frame(sock, max_len))
 
 
 def unix_listener(path: str) -> socket.socket:

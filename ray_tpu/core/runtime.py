@@ -319,6 +319,31 @@ class DriverRuntime:
         self.trace_spans: collections.deque = collections.deque(
             maxlen=8192)
 
+        # peer-to-peer object transfer plane (core/object_transfer.py):
+        # the GCS object table is the location directory; this maps each
+        # node to its data-plane listener so requesters pull object
+        # bytes straight from the holder. The driver's own server covers
+        # driver-node objects; relay over the control connections stays
+        # only as an instrumented fallback (relay_bytes counter).
+        self.transfer_addrs: Dict[str, str] = {}
+        self._transfer_server = None
+        self.relay_bytes = 0
+        self._relay_lock = threading.Lock()
+        if self._tcp_listener is not None:
+            from .object_transfer import TransferServer  # noqa: PLC0415
+            try:
+                host = self.tcp_address[len("tcp://"):].rpartition(":")[0]
+                # bind the SAME interface as the control plane: a
+                # loopback-only driver must not expose a wider data plane
+                self._transfer_server = TransferServer(
+                    self.store, host=host or "0.0.0.0",
+                    advertise_host=host or None,
+                    spill_dirs=[spill_dir])
+                self.transfer_addrs[self.node_id] = \
+                    self._transfer_server.address
+            except Exception:
+                self._transfer_server = None
+
         self.report_handlers["sys.lookup_actor"] = self._sys_lookup_actor
         self.report_handlers["sys.kv"] = \
             lambda _wid, payload: self._kv_op(*payload)
@@ -450,10 +475,18 @@ class DriverRuntime:
         elif kind == "object_copied":
             e = self.gcs.objects.get(item[1])
             if e is not None and e.state == "ready":
-                # future readers hit the local copy; the original stays
-                # freed alongside it (ObjectEntry.copies)
-                e.copies.append(e.loc)
-                e.loc = item[2]
+                newloc = item[2]
+                if newloc not in [e.loc, *e.copies]:
+                    if (newloc.node_id or self.node_id) == self.node_id:
+                        # driver-local re-host: promote it so driver-side
+                        # readers hit local shm; the original stays a
+                        # directory candidate and is freed alongside it
+                        e.copies.append(e.loc)
+                        e.loc = newloc
+                    else:
+                        # a peer pull landed a copy on another node:
+                        # directory entry only (the primary stays put)
+                        e.copies.append(newloc)
         elif kind == "api_submit":
             self._register_task(item[1])
         elif kind == "api_submit_many":
@@ -585,6 +618,10 @@ class DriverRuntime:
         self.gcs.nodes[nid] = NodeEntry(
             node_id=nid, hostname=ns.hostname, resources=dict(ns.total),
             labels=dict(ns.labels))
+        if info.get("transfer_address"):
+            self.transfer_addrs[nid] = info["transfer_address"]
+        # the driver's own transfer address travels per-candidate in
+        # pull_object/locations payloads, so the ack stays minimal
         conn.send(("node_registered", self.node_id, self.job_id))
 
     def _handle_node_msg(self, nid: str, m) -> None:
@@ -620,11 +657,44 @@ class DriverRuntime:
                     self._fetch_events.pop(rid, None)
                 box["data"], box["err"] = bytes(buf), None
                 ev.set()
+        elif mtype == "pulled":
+            # a node agent finished (or failed) a peer pull we asked for
+            _, rid, oid, newloc, err = m
+            with self._fetch_lock:
+                pair = self._fetch_events.pop(rid, None)
+            if pair is not None:
+                ev, box = pair
+                box["loc"], box["err"] = newloc, err
+                ev.set()
+            elif newloc is not None:
+                # the requester gave up waiting (timeout -> relay) but
+                # the pull completed: register the copy anyway so the
+                # directory serves it and the free path reclaims it
+                self.inbox.put(("object_copied", oid, newloc))
+        elif mtype == "locate":
+            # agent-side PullManager re-resolving a stale directory
+            # entry between retry rounds
+            _, rid, oid = m
+            ns = self.cluster_nodes.get(nid)
+            if ns is not None and ns.conn is not None:
+                try:
+                    ns.conn.send(("locations", rid,
+                                  self._object_candidates(oid)))
+                except ConnectionClosed:
+                    pass
         elif mtype == "metrics":
             # the node agent's own registry (store stats etc.) ships on
             # the node connection; workers ship on their own conns
             self.cluster_metrics.ingest(
                 {"node_id": nid, "worker_id": "node-agent"}, m[1])
+        elif mtype == "spans":
+            # agent-side trace spans (per-pull transfer spans)
+            for sp in m[1] or ():
+                sp = dict(sp)
+                sp.setdefault("worker_id", "node-agent")
+                if not sp.get("node_id"):
+                    sp["node_id"] = nid
+                self.trace_spans.append(sp)
         elif mtype == "worker_spawn_failed":
             sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
                              f"worker {m[1]}: {m[2]}\n")
@@ -639,6 +709,8 @@ class DriverRuntime:
         if entry is not None:
             entry.alive = False
         self.cluster_metrics.drop_source({"node_id": nid})
+        # location directory upkeep: the dead node serves no more pulls
+        self.transfer_addrs.pop(nid, None)
         # In-flight fetches against this node resolve via their timeout.
         for w in list(self.workers.values()):
             if w.node_id == nid and w.state != "dead":
@@ -684,6 +756,10 @@ class DriverRuntime:
                 continue
             if getattr(e.loc, "kind", None) == "inline":
                 continue  # payload rides in the location itself
+            # location directory upkeep: copies on the dead node must
+            # not be handed to pullers as candidates
+            e.copies = [c for c in e.copies
+                        if getattr(c, "node_id", None) != nid]
             loc_node = getattr(e.loc, "node_id", None)
             if loc_node != nid:
                 continue
@@ -719,9 +795,92 @@ class DriverRuntime:
                     f"object {oid} lived only on dead node {nid} and "
                     "its producing task is not re-executable"))
 
-    def fetch_bytes(self, loc) -> bytes:
-        """Pull a remote object's packed payload through its node agent.
+    def _object_candidates(self, oid: str) -> List[Tuple[Any, Optional[str]]]:
+        """Location-directory entries for one object: every live
+        (location, holder transfer address) pair, primary first. Device
+        locations are excluded — they materialize through the holder
+        worker before any transfer. Dispatcher-thread only."""
+        e = self.gcs.objects.get(oid)
+        if e is None or e.state != "ready":
+            return []
+        out: List[Tuple[Any, Optional[str]]] = []
+        for loc in [e.loc, *e.copies]:
+            if loc is None or getattr(loc, "kind", None) == "device":
+                continue
+            nid = loc.node_id or self.node_id
+            node = self.cluster_nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            out.append((loc, self.transfer_addrs.get(nid)))
+        return out
+
+    def _count_relay(self, n: int) -> None:
+        with self._relay_lock:   # helper threads relay concurrently
+            self.relay_bytes += n
+        try:
+            _mcat().get("ray_tpu_transfer_relay_bytes_total").inc(n)
+        except Exception:
+            pass
+
+    def _request_node_pull(self, requester_nid: str, oid: str,
+                           candidates, timeout: float = 60.0):
+        """Ask `requester_nid`'s agent to pull `oid` from a holder over
+        the transfer plane; returns the fresh local ObjectLocation or
+        None (caller falls back to the relay). Helper threads only."""
+        ns = self.cluster_nodes.get(requester_nid)
+        if ns is None or not ns.alive or ns.conn is None:
+            return None
+        if not any(addr for _loc, addr in candidates):
+            return None  # no holder has a data-plane listener
+        with self._fetch_lock:
+            self._fetch_counter += 1
+            rid = self._fetch_counter
+            ev: threading.Event = threading.Event()
+            box: dict = {}
+            self._fetch_events[rid] = (ev, box)
+        try:
+            ns.conn.send(("pull_object", rid, oid, candidates))
+        except ConnectionClosed:
+            with self._fetch_lock:
+                self._fetch_events.pop(rid, None)
+            return None
+        if not ev.wait(timeout=timeout):
+            with self._fetch_lock:
+                self._fetch_events.pop(rid, None)
+            return None
+        if box.get("err") is not None:
+            return None
+        return box.get("loc")
+
+    def fetch_bytes(self, loc, oid: Optional[str] = None
+                    ) -> "bytes | bytearray":
+        """Pull a remote object's packed payload to this process. Peer
+        path first: a direct TCP pull from the holder node's transfer
+        server (driver sockets untouched); the control-connection relay
+        through the holder's agent remains as the instrumented fallback.
         Called from API/helper threads (never the dispatcher — it blocks)."""
+        addr = self.transfer_addrs.get(loc.node_id or "")
+        if addr is not None:
+            from . import object_transfer  # noqa: PLC0415
+            t0 = time.time()
+            try:
+                data = object_transfer.pull_bytes(addr, oid or loc.name
+                                                  or "?", loc)
+            except Exception:  # fall back to relay (never swallow
+                pass           # KeyboardInterrupt/SystemExit)
+            else:
+                try:
+                    _mcat().get(
+                        "ray_tpu_transfer_bytes_pulled_total").inc(
+                        len(data))
+                    _mcat().get("ray_tpu_transfer_pulls_total").inc(
+                        tags={"result": "ok"})
+                    _mcat().get(
+                        "ray_tpu_transfer_pull_latency_s").observe(
+                        time.time() - t0)
+                except Exception:
+                    pass
+                return data
         ns = self.cluster_nodes.get(loc.node_id or "")
         if ns is None or not ns.alive or ns.conn is None:
             raise ObjectLostError(
@@ -740,15 +899,31 @@ class DriverRuntime:
                 self._fetch_events.pop(rid, None)
             raise ObjectLostError(
                 f"node {loc.node_id} connection lost during fetch") from None
-        if not ev.wait(timeout=60.0):
-            with self._fetch_lock:
-                self._fetch_events.pop(rid, None)
-            raise ObjectLostError(
-                f"fetch of {loc.name} from node {loc.node_id} timed out")
+        # Poll-wait so a holder death mid-fetch surfaces within ~a
+        # second (the first send to a freshly-killed peer often lands in
+        # the TCP buffer, so waiting the full budget would serialize a
+        # dead node's timeout into every reader).
+        deadline = time.time() + 60.0
+        while not ev.wait(timeout=1.0):
+            if not ns.alive:
+                with self._fetch_lock:
+                    self._fetch_events.pop(rid, None)
+                raise ObjectLostError(
+                    f"node {loc.node_id} died during fetch of "
+                    f"{loc.name}")
+            if time.time() > deadline:
+                with self._fetch_lock:
+                    self._fetch_events.pop(rid, None)
+                raise ObjectLostError(
+                    f"fetch of {loc.name} from node {loc.node_id} "
+                    f"timed out")
         if box.get("err") is not None:
             err = box["err"]
             raise err if isinstance(err, BaseException) else \
                 ObjectLostError(str(err))
+        # these bytes crossed the driver's control connection: the peer
+        # path was unavailable (no transfer server, or the pull failed)
+        self._count_relay(len(box["data"]))
         return box["data"]
 
     def _load_location(self, loc) -> Any:
@@ -1467,6 +1642,22 @@ class DriverRuntime:
                 w = self._device_locality_worker(
                     spec, need, task_needs_tpu, allowed,
                     allow_tpu_fallback=not tpu_demand)
+                if w is None:
+                    # store-object locality (transfer-plane hint): prefer
+                    # an idle worker on the node already holding the
+                    # task's dep payloads — the arg fetch then becomes a
+                    # local shm read instead of a peer pull. Soft: falls
+                    # through to normal placement when no such worker is
+                    # free (reference: locality-aware lease targeting).
+                    for lnid in self._dep_locality_nodes(spec):
+                        if allowed and lnid not in allowed:
+                            continue
+                        w = self._find_idle_worker(
+                            needs_tpu=task_needs_tpu,
+                            allow_tpu_fallback=not tpu_demand,
+                            allowed_nodes=[lnid], need=need)
+                        if w is not None:
+                            break
             if w is None and spread:
                 # SPREAD is node-first round-robin: assign the task a
                 # target node once (sticky across scheduling passes —
@@ -1694,6 +1885,26 @@ class DriverRuntime:
             self._spread_rr += 1
             return candidates[self._spread_rr % len(candidates)]
         return candidates[0]
+
+    def _dep_locality_nodes(self, spec) -> List[str]:
+        """Nodes holding this task's dep payloads, largest byte total
+        first — only deps big enough that moving them would cost more
+        than an off-node placement (> inline threshold) count."""
+        from .object_store import INLINE_MAX  # noqa: PLC0415
+        sizes: Dict[str, int] = {}
+        for oid in spec.dep_object_ids:
+            e = self.gcs.objects.get(oid)
+            if e is None or e.state != "ready":
+                continue
+            for loc in [e.loc, *e.copies]:
+                if loc is None or getattr(loc, "kind", None) in (
+                        "inline", "device"):
+                    continue
+                nid = loc.node_id or self.node_id
+                sizes[nid] = sizes.get(nid, 0) + int(
+                    getattr(loc, "size", 0) or 0)
+        big = {n: s for n, s in sizes.items() if s > INLINE_MAX}
+        return sorted(big, key=big.get, reverse=True)
 
     def _device_locality_worker(self, spec, need, needs_tpu: bool,
                                 allowed_nodes,
@@ -2031,28 +2242,57 @@ class DriverRuntime:
             for oid in oids:
                 full[oid] = results.get(
                     oid, ("error", ObjectLostError(f"{oid} unavailable")))
-            # Cross-node payloads can't be read from the requester's shm:
-            # fetch the packed bytes, re-host them in the driver's store
-            # (so same-host readers get zero-copy shm and repeat reads
-            # skip the network), and for workers on other nodes stream
-            # the bytes in chunks under the protocol frame cap. Fetching
-            # can block on another node, so it runs on a helper thread —
-            # never the dispatcher.
+            # Cross-node payloads can't be read from the requester's
+            # shm. Peer path (core/object_transfer.py): the requester's
+            # node agent pulls the bytes STRAIGHT from the holder's
+            # transfer server and re-hosts them in its own arena — the
+            # reply then carries a local location and the driver's
+            # sockets never see the payload. The location directory is
+            # consulted first (a copy may already live on the
+            # requester's node), and the old driver relay remains the
+            # instrumented fallback. Pulls block on other nodes, so they
+            # run on a helper thread — never the dispatcher.
             wnode = w.node_id if w is not None else self.node_id
             cross = [oid for oid, (kind, p) in full.items()
                      if kind == "loc" and p.kind != "inline"
                      and (p.node_id or self.node_id) != wnode]
+            # candidates snapshot on the dispatcher thread (GCS tables
+            # are dispatcher-owned); the helper thread only reads it
+            cand = {oid: self._object_candidates(oid) for oid in cross}
 
-            def finish(full=full, cross=cross, w=w, rid=rid, wnode=wnode):
+            def finish(full=full, cross=cross, w=w, rid=rid, wnode=wnode,
+                       cand=cand):
                 chunk_sz = int(os.environ.get("RAY_TPU_FETCH_CHUNK",
                                               str(64 << 20)))
                 for oid in cross:
                     _, loc = full[oid]
                     try:
+                        # 0. directory: a copy already on the requester's
+                        # node serves as a plain local read
+                        local = next(
+                            (c for c, _a in cand.get(oid, ())
+                             if (c.node_id or self.node_id) == wnode),
+                            None)
+                        if local is not None:
+                            full[oid] = ("loc", local)
+                            continue
+                        if wnode != self.node_id:
+                            # 1. peer path: requester's agent pulls
+                            # direct from the holder
+                            newloc = self._request_node_pull(
+                                wnode, oid, cand.get(oid, []))
+                            if newloc is not None:
+                                self.inbox.put(("object_copied", oid,
+                                                newloc))
+                                full[oid] = ("loc", newloc)
+                                continue
+                        # 2. relay fallback (also the driver-node
+                        # requester path, where fetch_bytes itself pulls
+                        # peer-direct from the holder's server)
                         if (loc.node_id or self.node_id) == self.node_id:
                             data = self.store.get_bytes(loc)
                         else:
-                            data = self.fetch_bytes(loc)
+                            data = self.fetch_bytes(loc, oid=oid)
                             try:
                                 newloc = self.store.put_packed(oid, data)
                             except Exception:
@@ -2070,8 +2310,14 @@ class DriverRuntime:
                                              len(data),
                                              data[off:off + chunk_sz]))
                             full[oid] = ("value_staged", len(data))
+                            if wnode != self.node_id:
+                                self._count_relay(len(data))
                         else:
                             full[oid] = ("value", data)
+                            if wnode != self.node_id:
+                                # payload leaves over the worker's
+                                # control connection: driver relay
+                                self._count_relay(len(data))
                     except BaseException as e:  # noqa: BLE001
                         full[oid] = ("error", e)
                 if w is not None and w.conn is not None:
@@ -2306,8 +2552,40 @@ class DriverRuntime:
                 if isinstance(payload, BaseException):
                     raise payload
                 raise TaskError(str(payload))
-            out.append(self._load_location(payload))
+            try:
+                out.append(self._load_location(payload))
+            except ObjectLostError:
+                # the holder died between the waiter firing and the
+                # read: one fresh round-trip picks up the reconstructed
+                # (or re-hosted) copy — mirrors the worker-side
+                # _get_one_fresh retry
+                out.append(self._reload_one(oid, timeout))
         return out
+
+    def _reload_one(self, oid: str, timeout: Optional[float]) -> Any:
+        """Single-object re-resolve after a stale-location read failed;
+        lineage reconstruction resets the entry to pending, so a fresh
+        waiter round-trip blocks until the re-run reseals it."""
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+
+        def cb(results, ready):
+            box.update(results)
+            ev.set()
+
+        waiter = Waiter([oid], None, cb)
+        self.inbox.put(("api_waiter", waiter))
+        if not ev.wait(timeout):
+            waiter.done = True
+            raise GetTimeoutError(
+                f"get() timed out re-resolving lost object {oid}")
+        kind, payload = box.get(oid, ("error",
+                                      ObjectLostError(f"{oid} missing")))
+        if kind == "error":
+            if isinstance(payload, BaseException):
+                raise payload
+            raise TaskError(str(payload))
+        return self._load_location(payload)
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ev = threading.Event()
@@ -2525,6 +2803,11 @@ class DriverRuntime:
         if self._tcp_listener is not None:
             try:
                 self._tcp_listener.close()
+            except Exception:
+                pass
+        if self._transfer_server is not None:
+            try:
+                self._transfer_server.close()
             except Exception:
                 pass
         if self._log_streamer is not None:

@@ -298,6 +298,13 @@ def run_exchange_distributed(stream, spec: ExchangeSpec, stats,
     n_out = 0
     n_maps = 0
     n_parts = 0
+    # Peer-transfer accounting: pieces travel worker->store->worker, and
+    # on a multi-node cluster the transfer plane moves them holder->
+    # requester directly — any driver-relayed byte during the exchange
+    # shows up in this delta (0 on a healthy peer path).
+    from ..core import runtime as _rt_mod
+    _rt = _rt_mod.get_runtime() if _rt_mod.runtime_initialized() else None
+    relay_before = getattr(_rt, "relay_bytes", 0)
     try:
         block_refs: List[Any] = []
         samples: list = []
@@ -371,4 +378,6 @@ def run_exchange_distributed(stream, spec: ExchangeSpec, stats,
         stats.record(spec.name, time.time() - t0, n_out)
         stats.exchange[spec.name] = {
             "map_tasks": n_maps, "reduce_tasks": n_parts,
-            "max_reduce_in_bytes": int(max_reduce_bytes)}
+            "max_reduce_in_bytes": int(max_reduce_bytes),
+            "relay_bytes": int(getattr(_rt, "relay_bytes", 0)
+                               - relay_before)}

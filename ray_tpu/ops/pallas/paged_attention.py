@@ -34,6 +34,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...util.jax_compat import pallas_tpu_compiler_params \
+    as _CompilerParams
+
 NEG_INF = -1e30
 
 # signature -> bool compile-probe cache (mirrors flash_attention's
@@ -157,7 +160,7 @@ def paged_decode_attention(q, k_flat, v_flat, page_table, lengths,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_n, hq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(page_table, lengths, jnp.asarray(qpos, jnp.int32), q, kp, vp)

@@ -1673,21 +1673,31 @@ class LLMEngine:
         self._set_page_row(slot, [])
 
     def _release(self, req: _Request):
-        req.out_queue.put(_END)
-        if req.first_token_ts is not None and req.generated > 1:
-            try:
-                self._m["tpot"].observe(
-                    (time.time() - req.first_token_ts)
-                    / (req.generated - 1), tags=self._mtags)
-            except Exception:
-                pass
-        if req.slot >= 0:
-            self._free_slot_pages(req.slot)
-            self._free_slots.append(req.slot)
-            self._active.pop(req.slot, None)
-            self._mask_dirty = True
-            self._pen_coef_dirty = True
-            req.slot = -1
+        # Slot bookkeeping FIRST, end marker LAST: putting _END wakes the
+        # consumer thread, and _set_page_row's jax dispatch below drops
+        # the GIL — publishing completion before the slot leaves _active
+        # let clients observe (and act on) a request that looked finished
+        # while still holding engine state (soak regression: a drained
+        # request lingering in _active with its slot already re-freed).
+        # The finally guarantees the consumer ALWAYS unblocks, even if a
+        # bookkeeping dispatch raises.
+        try:
+            if req.slot >= 0:
+                self._free_slot_pages(req.slot)
+                self._free_slots.append(req.slot)
+                self._active.pop(req.slot, None)
+                self._mask_dirty = True
+                self._pen_coef_dirty = True
+                req.slot = -1
+            if req.first_token_ts is not None and req.generated > 1:
+                try:
+                    self._m["tpot"].observe(
+                        (time.time() - req.first_token_ts)
+                        / (req.generated - 1), tags=self._mtags)
+                except Exception:
+                    pass
+        finally:
+            req.out_queue.put(_END)
 
     def _decode_window_pages(self) -> int:
         """Power-of-2 page window covering every slot that holds KV

@@ -646,7 +646,10 @@ def json_schema_to_regex(schema: dict, *, _depth: int = 0) -> str:
         return r"\[" + body + r"\]"
     if t == "object" or "properties" in schema:
         props = schema.get("properties", {})
-        required = set(schema.get("required", list(props)))
+        # JSON-Schema semantics: a missing `required` key means NO
+        # property is required (the old default of all-of-them silently
+        # inverted that and forced optional fields into every output)
+        required = set(schema.get("required", ()))
         parts = []
         import json as _json
         for key, sub in props.items():

@@ -27,6 +27,39 @@ from . import LLMServer, build_llm_deployment
 
 _req_ids = itertools.count()
 
+# SentencePiece word-boundary marker (U+2581 LOWER ONE EIGHTH BLOCK)
+_SP_SPACE = "▁"
+
+
+def _token_strings(tokenizer, vocab_size: int) -> List[str]:
+    """Per-token appended text for guided-regex compilation.
+
+    Prefers tokenizer PIECES (convert_ids_to_tokens) with the
+    SentencePiece `▁` word-boundary marker mapped to a literal space:
+    `decode([i])` strips the marker, so "model" and "▁model" both
+    decoded to "model" and space-crossing guided regexes compiled
+    against the wrong per-token text. Pieces without the marker (and
+    tokenizers without a piece API) keep the decode([i]) byte-level
+    approximation — byte-level BPEs encode spaces as other markers
+    (Ġ, Ċ) that only their decoder maps correctly."""
+    convert = getattr(tokenizer, "convert_ids_to_tokens", None)
+    pieces: List[Optional[str]] = [None] * vocab_size
+    if convert is not None:
+        try:
+            got = convert(list(range(vocab_size)))
+            if got is not None and len(got) == vocab_size:
+                pieces = list(got)
+        except Exception:
+            pass
+    out = []
+    for i in range(vocab_size):
+        p = pieces[i]
+        if isinstance(p, str) and _SP_SPACE in p:
+            out.append(p.replace(_SP_SPACE, " "))
+        else:
+            out.append(tokenizer.decode([i]))
+    return out
+
 
 class OpenAIServer(LLMServer):
     """LLMServer speaking the OpenAI REST schema."""
@@ -128,10 +161,7 @@ class OpenAIServer(LLMServer):
                                  tokenize=tokenize)
         else:
             if self._token_strings is None:
-                # one-time: text each token id appends (decode([i]) is
-                # the standard byte-level approximation)
-                self._token_strings = [
-                    self.tokenizer.decode([i]) for i in range(vs)]
+                self._token_strings = _token_strings(self.tokenizer, vs)
             fsm = compile_guided(GuidedSpec(regex=regex), vocab_size=vs,
                                  eos_id=eos,
                                  token_strings=self._token_strings)

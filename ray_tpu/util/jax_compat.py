@@ -1,0 +1,30 @@
+"""Version-compat shims for jax API drift.
+
+The kernels and parallel ops are written against current jax names;
+this module maps them onto older releases (this image ships a jax where
+shard_map still lives in jax.experimental and Pallas' TPU compiler
+params are TPUCompilerParams) so one rename is fixed in ONE place.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map on new releases (replication check spelled
+    check_vma); jax.experimental.shard_map with check_rep on old."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(*args, **kwargs)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams (new name) / TPUCompilerParams (old name) —
+    identical fields either way."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)

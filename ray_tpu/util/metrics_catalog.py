@@ -49,6 +49,28 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_object_store_reads_total": (
         "counter", "object reads by outcome "
         "(inline / hit / spill fallback)", ("result",), "reads", None),
+    # ---- peer-to-peer object transfer plane (core/object_transfer.py) ----
+    "ray_tpu_transfer_bytes_pulled_total": (
+        "counter", "object bytes pulled directly from holder nodes",
+        (), "bytes", None),
+    "ray_tpu_transfer_bytes_served_total": (
+        "counter", "object bytes served to peer nodes by the local "
+        "transfer server", (), "bytes", None),
+    "ray_tpu_transfer_chunks_total": (
+        "counter", "transfer chunks moved by direction (in = pulled, "
+        "out = served)", ("dir",), "chunks", None),
+    "ray_tpu_transfer_pulls_total": (
+        "counter", "pull requests by outcome (ok / error / dedup "
+        "wait / local hit)", ("result",), "pulls", None),
+    "ray_tpu_transfer_pull_retries_total": (
+        "counter", "pull retry rounds (backoff + alternate holders)",
+        (), "retries", None),
+    "ray_tpu_transfer_pull_latency_s": (
+        "histogram", "single successful pull wall time", (), "seconds",
+        None),
+    "ray_tpu_transfer_relay_bytes_total": (
+        "counter", "object bytes that fell back to the driver-relay "
+        "path (peer path unavailable or failed)", (), "bytes", None),
     # ---- worker processes (shipped to the driver exposition) ----
     "ray_tpu_worker_task_run_s": (
         "histogram", "task execution latency measured IN the worker",

@@ -443,3 +443,27 @@ def test_regex_engine_matches_python_re():
             assert got == want, (pat, cand, got, want)
             checked += 1
     assert checked > 800  # the fuzz actually exercised many pairs
+
+
+def test_json_schema_missing_required_means_optional():
+    """JSON-Schema semantics: absent `required` = NO property required
+    (regression: the old default treated every property as required,
+    so schema-valid docs omitting optional fields were masked out)."""
+    import json as j
+    from ray_tpu.serve.llm.guided import json_schema_to_regex
+    rx = json_schema_to_regex({"type": "object",
+                               "properties": {"note": {"type":
+                                                       "integer"}}})
+    fsm = TokenFSM.from_regex(rx, ascii_vocab(), eos_id=0)
+    # the empty object is in the language (note is optional)...
+    assert fsm.is_accepting(walk(fsm, tok("{}")))
+    # ...and so is the fully-populated one
+    s = walk(fsm, tok(j.dumps({"note": 7}, separators=(",", ":"))))
+    assert fsm.is_accepting(s)
+    # multi-property objects without a required first property stay an
+    # explicit error (the canonical grammar needs a required anchor),
+    # never a silent all-required reinterpretation
+    with pytest.raises(ValueError, match="first property required"):
+        json_schema_to_regex({"type": "object",
+                              "properties": {"a": {"type": "integer"},
+                                             "b": {"type": "integer"}}})

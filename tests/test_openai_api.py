@@ -400,3 +400,37 @@ def test_n_choices_submit_failure_aborts_siblings():
         assert sorted(aborted) == sorted(submitted)
     finally:
         server.engine.shutdown()
+
+
+def test_token_strings_preserve_sentencepiece_spaces():
+    """Guided-regex token text keeps SentencePiece word boundaries:
+    decode([i]) strips the ▁ marker, so "hi" and "▁hi" looked identical
+    and space-crossing patterns compiled against the wrong text. Pieces
+    carrying ▁ map it to a literal space; everything else (and
+    tokenizers with no piece API, or a broken one) keeps the decode
+    fallback."""
+    from ray_tpu.serve.llm.openai_api import _token_strings
+
+    class SPTok:
+        pieces = ["<pad>", "▁", "▁hi", "lo", "▁wo"]
+
+        def convert_ids_to_tokens(self, ids):
+            return [self.pieces[i] for i in ids]
+
+        def decode(self, ids):
+            return "".join(self.pieces[i].replace("▁", "") for i in ids)
+
+    assert _token_strings(SPTok(), 5) == ["<pad>", " ", " hi", "lo",
+                                          " wo"]
+
+    class NoPieces:
+        def decode(self, ids):
+            return "".join(chr(65 + i) for i in ids)
+
+    assert _token_strings(NoPieces(), 3) == ["A", "B", "C"]
+
+    class BrokenPieces(NoPieces):
+        def convert_ids_to_tokens(self, ids):
+            raise RuntimeError("no piece vocab")
+
+    assert _token_strings(BrokenPieces(), 2) == ["A", "B"]
