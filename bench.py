@@ -776,6 +776,59 @@ def phase_core() -> dict:
             "n_calls": n, "transfer": transfer, "platform": "cpu"}
 
 
+def phase_events() -> dict:
+    """Event-plane overhead A/B (no jax in the measured path): no-op
+    task round-trips/s over a warm pool with the structured event plane
+    ON vs OFF (RAY_TPU_EVENTS kill switch). The acceptance bar is < 5%
+    throughput overhead; the result also lands in BENCH_EVENTS.json."""
+    import ray_tpu
+    from ray_tpu.util import events as events_mod
+
+    n = int(os.environ.get("RAY_TPU_BENCH_EVENTS_TASKS", "600"))
+
+    def measure(label: str) -> float:
+        rt = ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def _noop():
+            return None
+
+        ray_tpu.get([_noop.remote() for _ in range(32)], timeout=120)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.time()
+            ray_tpu.get([_noop.remote() for _ in range(n)], timeout=600)
+            best = max(best, n / (time.time() - t0))
+        del rt
+        ray_tpu.shutdown()
+        _progress(f"events: {best:.0f} noop tasks/s ({label}, n={n}, "
+                  "best of 3)")
+        return best
+
+    events_mod.set_enabled(True)
+    on = measure("event plane ON")
+    events_mod.set_enabled(False)
+    try:
+        off = measure("event plane OFF")
+    finally:
+        events_mod.set_enabled(True)
+    overhead_pct = round((off - on) / off * 100.0, 2) if off else None
+    result = {
+        "noop_tasks_per_s_events_on": round(on, 1),
+        "noop_tasks_per_s_events_off": round(off, 1),
+        "overhead_pct": overhead_pct,
+        "n_calls": n, "platform": "cpu",
+        "note": "overhead_pct < 0 means the ON run measured faster "
+                "(noise floor)",
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_EVENTS.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        _progress(f"BENCH_EVENTS.json write failed (non-fatal): {e}")
+    return result
+
+
 def phase_serve() -> dict:
     """Serve req/s + p50 TTFT (BASELINE metric) on the continuous-batching
     LLM engine with a llama-family model."""
@@ -1061,7 +1114,8 @@ def main():
     ap.add_argument("--measure-torch-baseline", action="store_true")
     ap.add_argument("--phase",
                     choices=["kernels", "train", "train-llama", "serve",
-                             "flash-ab", "probe-8b", "data", "core"])
+                             "flash-ab", "probe-8b", "data", "core",
+                             "events"])
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
 
@@ -1078,7 +1132,8 @@ def main():
                  "flash-ab": phase_flash_ab,
                  "probe-8b": phase_probe_8b,
                  "data": phase_data,
-                 "core": phase_core}[args.phase]()
+                 "core": phase_core,
+                 "events": phase_events}[args.phase]()
         except BaseException as e:  # noqa: BLE001
             _progress(f"phase {args.phase} failed: {e!r}")
             raise SystemExit(3)

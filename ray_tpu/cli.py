@@ -187,6 +187,108 @@ def cmd_metrics(args):
     sys.stdout.write(_format_metrics(text, needle=args.grep or ""))
 
 
+def _events_query(args, since: int = 0) -> str:
+    from urllib.parse import urlencode
+    params = [("limit", args.limit)]
+    if since:
+        params.append(("since", since))
+    for key, flag in (("task_id", "task"), ("actor_id", "actor"),
+                      ("object_id", "object"), ("node_id", "node"),
+                      ("worker_id", "worker")):
+        v = getattr(args, flag, None)
+        if v:
+            params.append((key, v))
+    for t in args.type or ():
+        params.append(("type", t))
+    for s in args.severity or ():
+        params.append(("severity", s))
+    return "/api/events?" + urlencode(params)
+
+
+def _print_events(rows) -> None:
+    import datetime
+    for ev in rows:
+        ts = datetime.datetime.fromtimestamp(
+            ev.get("ts", 0)).strftime("%H:%M:%S.%f")[:-3]
+        ids = " ".join(
+            f"{k}={ev[k]}" for k in ("task_id", "actor_id", "object_id",
+                                     "node_id", "worker_id")
+            if ev.get(k))
+        msg = ev.get("message") or ""
+        line = (f"{ev.get('seq', '?'):>6} {ts} "
+                f"{ev.get('severity', 'info'):<7} "
+                f"{ev.get('type', '?'):<26} {ids}")
+        print(line + (f"  | {msg}" if msg else ""))
+
+
+def cmd_events(args):
+    """`ray_tpu events` — cluster lifecycle event log, filterable by
+    id/type/severity; --follow tails new events; -o exports JSONL."""
+    data = _fetch(args.address, _events_query(args))
+    rows = data["events"]
+    if args.output:
+        with open(args.output, "w") as f:
+            for ev in rows:
+                f.write(json.dumps(ev, default=str) + "\n")
+        print(f"wrote {len(rows)} events to {args.output}"
+              + (f" (truncated; {data['total']} matched)"
+                 if data.get("truncated") else ""))
+        return
+    if args.json:
+        print(json.dumps(data, indent=2, default=str))
+        return
+    _print_events(rows)
+    if data.get("truncated"):
+        print(f"... truncated: showing {len(rows)} of {data['total']} "
+              f"matching events (raise --limit)")
+    if not args.follow:
+        return
+    last = max((ev.get("seq", 0) for ev in rows), default=0)
+    try:
+        while True:
+            time.sleep(args.interval)
+            data = _fetch(args.address, _events_query(args, since=last))
+            fresh = data["events"]
+            if fresh:
+                if data.get("truncated"):
+                    # a burst bigger than --limit landed between polls:
+                    # the server kept only the newest window — say so
+                    # instead of silently skipping the gap
+                    print(f"... gap: {data['total'] - len(fresh)} "
+                          f"events since seq {last} not shown "
+                          f"(raise --limit)")
+                _print_events(fresh)
+                last = max(ev.get("seq", last) for ev in fresh)
+    except KeyboardInterrupt:
+        return
+
+
+def cmd_post_mortem(args):
+    """`ray_tpu post-mortem <task_id|actor_id>` — assemble the failure
+    bundle (event chain + span subtree + tagged log tail + metrics
+    snapshot) from the live driver and write one JSON artifact."""
+    from urllib.parse import urlencode
+    bundle = _fetch(args.address,
+                    "/api/post_mortem?" + urlencode({"id": args.id}))
+    out = args.output or f"post-mortem-{args.id}.json"
+    with open(out, "w") as f:
+        json.dump(bundle, f, indent=1, default=str)
+    subj = bundle.get("subject", {})
+    logs = bundle.get("log_tail", {}) or {}
+    print(f"wrote {out}: kind={subj.get('kind')} "
+          f"events={len(bundle.get('events', []))} "
+          f"spans={len(bundle.get('spans', []))} "
+          f"log_lines={len(logs.get('lines', []))}")
+    if subj.get("kind") == "task":
+        t = subj["task"]
+        print(f"  task {t['name']} state={t['state']} "
+              f"worker={t['worker_id']}")
+    elif subj.get("kind") == "actor":
+        a = subj["actor"]
+        print(f"  actor {a['class_name']} state={a['state']} "
+              f"death_cause={a['death_cause'] or '-'}")
+
+
 def cmd_job(args):
     from .core.jobs import JobSubmissionClient
     # submit runs the entrypoint as a local child unless --remote sends
@@ -322,6 +424,41 @@ def main(argv=None):
     tp = sub.add_parser("timeline", help="export chrome-trace JSON")
     tp.add_argument("-o", "--output", default="timeline.json")
     tp.set_defaults(fn=cmd_timeline)
+
+    ep = sub.add_parser(
+        "events", help="cluster lifecycle event log (filter by "
+                       "id/type/severity; --follow tails)")
+    ep.add_argument("--task", help="filter: events referencing task id")
+    ep.add_argument("--actor", help="filter: events referencing actor id")
+    ep.add_argument("--object",
+                    help="filter: events referencing object id")
+    ep.add_argument("--node", help="filter: events referencing node id")
+    ep.add_argument("--worker",
+                    help="filter: events referencing worker id")
+    ep.add_argument("--type", action="append",
+                    help="filter: event type (repeatable), e.g. "
+                         "task.retry")
+    ep.add_argument("--severity", action="append",
+                    choices=["info", "warning", "error"],
+                    help="filter: severity (repeatable)")
+    ep.add_argument("--limit", type=int, default=100)
+    ep.add_argument("--json", action="store_true")
+    ep.add_argument("--follow", action="store_true",
+                    help="keep polling for new events (Ctrl-C stops)")
+    ep.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll interval seconds")
+    ep.add_argument("-o", "--output", default=None,
+                    help="export matching events as JSONL")
+    ep.set_defaults(fn=cmd_events)
+
+    pmp = sub.add_parser(
+        "post-mortem", help="assemble a failure bundle for a task or "
+                            "actor id (events + spans + tagged logs + "
+                            "metrics)")
+    pmp.add_argument("id", help="task_id (tsk-...) or actor_id (act-...)")
+    pmp.add_argument("-o", "--output", default=None,
+                     help="bundle path (default post-mortem-<id>.json)")
+    pmp.set_defaults(fn=cmd_post_mortem)
 
     mp = sub.add_parser(
         "metrics", help="merged cluster metrics (pretty-printed; "

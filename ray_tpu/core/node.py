@@ -115,10 +115,29 @@ class NodeAgent:
         if self._metrics_interval > 0:
             threading.Thread(target=self._metrics_loop, daemon=True,
                              name="node-metrics").start()
+        # Liveness pings for the driver's event plane: a stalled (not
+        # just disconnected) agent surfaces as node.heartbeat_miss
+        # before the socket-level death determination.
+        self._heartbeat_interval = float(os.environ.get(
+            "RAY_TPU_NODE_HEARTBEAT_S", "2.0"))
+        if self._heartbeat_interval > 0:
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="node-heartbeat").start()
+
+    def _heartbeat_loop(self) -> None:
+        while True:
+            time.sleep(self._heartbeat_interval)
+            try:
+                self.conn.send(("heartbeat", time.time()))
+            except ConnectionClosed:
+                return
+            except Exception:
+                pass
 
     def _metrics_loop(self) -> None:
         from ..util.metrics import DeltaExporter  # noqa: PLC0415
         from ..util import metrics_catalog as mcat  # noqa: PLC0415
+        from ..util import events as events_mod  # noqa: PLC0415
         exporter = DeltaExporter()
         while True:
             time.sleep(self._metrics_interval)
@@ -137,6 +156,11 @@ class NodeAgent:
                     spans, self._spans = self._spans, []
                 if spans:
                     self.conn.send(("spans", spans))
+                # event-plane delta batch (anything code on this agent
+                # emitted — memory pressure, engine/data events)
+                evs = events_mod.drain()
+                if evs:
+                    self.conn.send(("events", evs))
             except ConnectionClosed:
                 return
             except Exception:

@@ -49,6 +49,12 @@ def _mcat():
     from ..util import metrics_catalog  # noqa: PLC0415
     return metrics_catalog
 
+
+def _ev():
+    # same lazy-import rationale as _mcat
+    from ..util import events  # noqa: PLC0415
+    return events
+
 _runtime: Optional[Any] = None
 _runtime_lock = threading.Lock()
 
@@ -110,7 +116,8 @@ class NodeState:
     conn=None (reference parity: per-node resource views in
     gcs_node_manager.cc / node_manager.cc)."""
     __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
-                 "alive", "free_tpu_ids")
+                 "alive", "free_tpu_ids", "last_heartbeat",
+                 "heartbeat_missed")
 
     def __init__(self, node_id: str, hostname: str,
                  resources: Dict[str, float],
@@ -123,6 +130,11 @@ class NodeState:
         self.labels = dict(labels or {})
         self.conn = conn
         self.alive = True
+        # liveness plumbing (event plane): agents ping periodically;
+        # the reaper tick flags staleness as a node.heartbeat_miss
+        # event before the socket-level death determination lands
+        self.last_heartbeat = time.time()
+        self.heartbeat_missed = False
         # Specific chip indices handed to tasks/actors (get_tpu_ids):
         # concurrent TPU workloads on one host must see disjoint chips.
         self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
@@ -319,6 +331,15 @@ class DriverRuntime:
         self.trace_spans: collections.deque = collections.deque(
             maxlen=8192)
 
+        # cluster event plane (util/events.py): lifecycle events from
+        # this process and every worker/node-agent merge here, indexed
+        # by task/actor/object/node id for the state API, /api/events,
+        # and post-mortem bundles
+        from ..util.events import ClusterEventStore  # noqa: PLC0415
+        self.cluster_events = ClusterEventStore()
+        self._node_hb_timeout = float(os.environ.get(
+            "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S", "10"))
+
         # peer-to-peer object transfer plane (core/object_transfer.py):
         # the GCS object table is the location directory; this maps each
         # node to its data-plane listener so requesters pull object
@@ -349,6 +370,7 @@ class DriverRuntime:
             lambda _wid, payload: self._kv_op(*payload)
         self.report_handlers["sys.metrics"] = self._on_worker_metrics
         self.report_handlers["sys.spans"] = self._on_worker_spans
+        self.report_handlers["sys.events"] = self._on_worker_events
 
         # Backstop for drivers that exit without calling shutdown() (e.g.
         # a pytest process): workers self-exit on socket close, but the shm
@@ -444,6 +466,8 @@ class DriverRuntime:
         kind = item[0]
         if kind == "tick":
             self._update_builtin_gauges()
+            self._check_node_heartbeats()
+            self.drain_local_events()
             return
         if kind == "register":
             _, wid, conn, pid = item
@@ -477,6 +501,9 @@ class DriverRuntime:
             if e is not None and e.state == "ready":
                 newloc = item[2]
                 if newloc not in [e.loc, *e.copies]:
+                    self._emit("object.transfer", object_id=item[1],
+                               node_id=newloc.node_id or self.node_id,
+                               size=getattr(newloc, "size", None))
                     if (newloc.node_id or self.node_id) == self.node_id:
                         # driver-local re-host: promote it so driver-side
                         # readers hit local shm; the original stays a
@@ -620,13 +647,22 @@ class DriverRuntime:
             labels=dict(ns.labels))
         if info.get("transfer_address"):
             self.transfer_addrs[nid] = info["transfer_address"]
+        self._emit("node.register", node_id=nid, hostname=ns.hostname,
+                   resources=dict(ns.total))
         # the driver's own transfer address travels per-candidate in
         # pull_object/locations payloads, so the ack stays minimal
         conn.send(("node_registered", self.node_id, self.job_id))
 
     def _handle_node_msg(self, nid: str, m) -> None:
         from .protocol import RECV_ERROR  # noqa: PLC0415
+        ns = self.cluster_nodes.get(nid)
+        if ns is not None:
+            # any traffic proves liveness; a flagged miss heals
+            ns.last_heartbeat = time.time()
+            ns.heartbeat_missed = False
         mtype = m[0]
+        if mtype == "heartbeat":
+            return
         if mtype == RECV_ERROR:
             sys.stderr.write(f"[ray_tpu driver] dropped undeserializable "
                              f"message from node {nid}:\n{m[1]}")
@@ -695,6 +731,10 @@ class DriverRuntime:
                 if not sp.get("node_id"):
                     sp["node_id"] = nid
                 self.trace_spans.append(sp)
+        elif mtype == "events":
+            # agent-side lifecycle events (event plane delta batch)
+            self.cluster_events.ingest(
+                {"node_id": nid, "worker_id": "node-agent"}, m[1])
         elif mtype == "worker_spawn_failed":
             sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
                              f"worker {m[1]}: {m[2]}\n")
@@ -704,10 +744,21 @@ class DriverRuntime:
         ns = self.cluster_nodes.get(nid)
         if ns is None or not ns.alive:
             return
+        # determinism for forensics: the causal chain always reads
+        # heartbeat-miss -> death, even when the socket close beat the
+        # staleness check to the determination
+        if not ns.heartbeat_missed:
+            ns.heartbeat_missed = True
+            self._emit("node.heartbeat_miss",
+                       f"connection to node {nid} lost", node_id=nid)
         ns.alive = False
         entry = self.gcs.nodes.get(nid)
         if entry is not None:
             entry.alive = False
+        self._emit("node.death",
+                   f"node {nid} ({ns.hostname}) declared dead; failing "
+                   "over its workers, objects, and placement bundles",
+                   node_id=nid)
         self.cluster_metrics.drop_source({"node_id": nid})
         # location directory upkeep: the dead node serves no more pulls
         self.transfer_addrs.pop(nid, None)
@@ -787,10 +838,19 @@ class DriverRuntime:
                         te.finished_at = None
                     self._respawnable_specs[task_id] = spec
                     self.pending_tasks.append(spec)
+                    self._emit("task.retry",
+                               f"lineage reconstruction: node {nid} "
+                               f"died holding this task's outputs",
+                               task_id=task_id, node_id=nid,
+                               name=spec.name)
                     sys.stderr.write(
                         f"[ray_tpu] node {nid} died; reconstructing "
                         f"{spec.name} ({task_id}) for lost objects\n")
             else:
+                self._emit("object.lost",
+                           f"only copy lived on dead node {nid}; "
+                           "producing task not re-executable",
+                           object_id=oid, task_id=task_id, node_id=nid)
                 self._fail_object(oid, ObjectLostError(
                     f"object {oid} lived only on dead node {nid} and "
                     "its producing task is not re-executable"))
@@ -936,6 +996,10 @@ class DriverRuntime:
     def _seal(self, oid: str, loc) -> None:
         e = self.gcs.seal_object(oid, loc)
         self._materializing.discard(oid)
+        self._emit("object.seal", object_id=oid, task_id=e.owner_task,
+                   node_id=getattr(loc, "node_id", None) or self.node_id,
+                   kind=getattr(loc, "kind", None),
+                   size=getattr(loc, "size", None))
         self._spill.on_seal(oid, e.loc)
         self._notify_object(oid)
 
@@ -1203,10 +1267,19 @@ class DriverRuntime:
                 te.finished_at = None
                 self._respawnable_specs[task_id] = spec
                 self.pending_tasks.append(spec)
+                self._emit("task.retry",
+                           f"device object {oid} lost its holder; "
+                           "re-running producer",
+                           task_id=task_id, object_id=oid,
+                           name=spec.name)
                 sys.stderr.write(
                     f"[ray_tpu] device object {oid} lost its holder; "
                     f"reconstructing {spec.name} ({task_id})\n")
         else:
+            self._emit("object.lost",
+                       "device-resident holder died; producing task "
+                       "not re-executable", object_id=oid,
+                       task_id=task_id)
             self._fail_object(oid, ObjectLostError(
                 f"device-resident object {oid} lost its holding worker "
                 "and its producing task is not re-executable"))
@@ -1268,6 +1341,8 @@ class DriverRuntime:
         self.gcs.tasks[spec.task_id] = te
         _mcat().get("ray_tpu_tasks_submitted_total").inc(tags={
             "kind": "actor_task" if spec.actor_id else "task"})
+        self._emit("task.submit", task_id=spec.task_id,
+                   actor_id=spec.actor_id, name=spec.name)
         for oid in spec.return_ids:
             self.gcs.add_pending_object(oid, owner_task=spec.task_id)
         if getattr(spec, "streaming", False):
@@ -1296,12 +1371,17 @@ class DriverRuntime:
                         max_restarts=acspec.max_restarts,
                         create_spec=acspec)
         self.gcs.actors[acspec.actor_id] = ae
+        self._emit("actor.create", actor_id=acspec.actor_id,
+                   class_name=acspec.class_name, name=acspec.name)
         if acspec.name:
             ok = self.gcs.register_named_actor(
                 acspec.namespace, acspec.name, acspec.actor_id)
             if not ok:
                 ae.state = "DEAD"
                 ae.death_cause = f"name {acspec.name!r} already taken"
+                self._emit("actor.death", ae.death_cause,
+                           actor_id=acspec.actor_id,
+                           class_name=acspec.class_name)
                 return
         self.actor_max_conc[acspec.actor_id] = acspec.max_concurrency
         self.actor_group_conc[acspec.actor_id] = dict(
@@ -1323,6 +1403,11 @@ class DriverRuntime:
                 or now - first < self._PENDING_WARN_S:
             return
         self._pending_warned.add(key)
+        self._emit("scheduler.backpressure",
+                   f"{what} pending {now - first:.0f}s: requires "
+                   f"{need or '{}'} with no feasible placement",
+                   task_id=key if key.startswith("tsk-") else None,
+                   actor_id=key if key.startswith("act-") else None)
         cap = {}
         avail = {}
         for ns in self.cluster_nodes.values():
@@ -1731,6 +1816,9 @@ class DriverRuntime:
             if te.submitted_at:
                 _mcat().get("ray_tpu_task_sched_latency_s").observe(
                     te.started_at - te.submitted_at)
+            self._emit("task.sched", task_id=spec.task_id,
+                       worker_id=w.worker_id, node_id=w.node_id,
+                       name=spec.name)
         self.pending_tasks = still
 
         # 3. actor tasks
@@ -1782,6 +1870,9 @@ class DriverRuntime:
                 if te.submitted_at:
                     _mcat().get("ray_tpu_task_sched_latency_s").observe(
                         te.started_at - te.submitted_at)
+                self._emit("task.sched", task_id=spec.task_id,
+                           worker_id=w.worker_id, node_id=w.node_id,
+                           actor_id=aid, name=spec.name)
                 return True
 
             if not group_limits:
@@ -1792,7 +1883,15 @@ class DriverRuntime:
                     dr = self._deps_ready(q[0].dep_object_ids)
                     if dr is False:
                         break
-                    if dispatch(q.popleft(), None) is None:
+                    spec = q.popleft()
+                    if dispatch(spec, None) is None:
+                        # conn died mid-dispatch: put the spec BACK so
+                        # the actor-death path fails it with
+                        # ActorDiedError — dropping it here leaves its
+                        # return objects pending forever (observed as a
+                        # flaky get() timeout after actor_exit raced a
+                        # method call)
+                        q.appendleft(spec)
                         break
                 continue
             # Group-aware dispatch (reference: python/ray/actor.py
@@ -2007,6 +2106,8 @@ class DriverRuntime:
         acspec = self._actor_create_specs.get(purpose) if purpose else None
         if acspec is not None and acspec.resources.get("TPU", 0) > 0:
             tpu_capable = True
+        self._emit("worker.start", worker_id=wid, node_id=node_id,
+                   actor_id=purpose, tpu_capable=bool(tpu_capable))
         if node.conn is not None:
             # remote node: its agent spawns the worker, which connects
             # straight back to our TCP listener
@@ -2083,6 +2184,19 @@ class DriverRuntime:
         if te.started_at:
             _mcat().get("ray_tpu_task_run_s").observe(
                 te.finished_at - te.started_at)
+        if te.state == "FINISHED":
+            self._emit("task.finish", task_id=task_id, worker_id=wid,
+                       actor_id=te.actor_id, name=te.name,
+                       duration_s=round(
+                           te.finished_at - te.started_at, 6)
+                       if te.started_at else None)
+        elif te.state == "CANCELLED":
+            self._emit("task.cancel", task_id=task_id, worker_id=wid,
+                       actor_id=te.actor_id, name=te.name)
+        else:
+            self._emit("task.fail", repr(error)[:500], task_id=task_id,
+                       worker_id=wid, actor_id=te.actor_id,
+                       name=te.name)
         spec = self._respawnable_specs.pop(task_id, None)
         if spec is not None and error is None and spec.actor_id is None:
             # retain for lineage reconstruction of this task's outputs
@@ -2118,8 +2232,14 @@ class DriverRuntime:
             return
         if ok:
             ae.state, ae.worker_id = "ALIVE", wid
+            self._emit("actor.alive", actor_id=actor_id, worker_id=wid,
+                       class_name=ae.class_name)
         else:
             ae.state, ae.death_cause = "DEAD", repr(err)
+            self._emit("actor.death",
+                       f"constructor failed: {repr(err)[:400]}",
+                       actor_id=actor_id, worker_id=wid,
+                       class_name=ae.class_name)
             w = self.workers.get(wid)
             if w is not None:
                 res_mod.release(self._wnode_avail(w), w.held_resources)
@@ -2153,6 +2273,9 @@ class DriverRuntime:
         w.held_resources = {}
         w.blocked = False
         self._conn_by_wid.pop(wid, None)
+        self._emit("worker.death", task_id=w.current_task,
+                   actor_id=w.actor_id, worker_id=wid,
+                   node_id=w.node_id)
         # running normal task -> retry or fail
         if w.current_task:
             te = self.gcs.tasks.get(w.current_task)
@@ -2165,10 +2288,19 @@ class DriverRuntime:
                     te.retries_left -= 1
                     te.state = "PENDING"
                     self.pending_tasks.append(spec)
+                    self._emit("task.retry",
+                               f"worker {wid} died while running "
+                               f"{te.name}; resubmitting",
+                               task_id=w.current_task, worker_id=wid,
+                               node_id=w.node_id, name=te.name,
+                               retries_left=te.retries_left)
                 else:
                     te.state = "FAILED"
                     err = WorkerCrashedError(
                         f"worker {wid} died while running {te.name}")
+                    self._emit("task.fail", str(err),
+                               task_id=w.current_task, worker_id=wid,
+                               node_id=w.node_id, name=te.name)
                     for oid in self._return_ids_of(w.current_task):
                         self._fail_object(oid, err)
                     self._gen_settle(w.current_task, err)
@@ -2213,6 +2345,8 @@ class DriverRuntime:
             return
         ae.state = "DEAD"
         ae.death_cause = "actor_exit() called"
+        self._emit("actor.death", ae.death_cause, actor_id=aid,
+                   class_name=ae.class_name)
         self._fail_inflight_actor_tasks(aid, "exited via actor_exit()")
         self._drain_actor_queue(aid, "exited via actor_exit()")
 
@@ -2224,6 +2358,11 @@ class DriverRuntime:
         if ae.num_restarts < ae.max_restarts:
             ae.num_restarts += 1
             ae.state = "RESTARTING"
+            self._emit("actor.restart",
+                       f"worker {wid} died; restart "
+                       f"{ae.num_restarts}/{ae.max_restarts}",
+                       actor_id=aid, worker_id=wid,
+                       class_name=ae.class_name)
             # Restart placement goes through the scheduler (phase 1.5):
             # spawning here unconditionally could land the actor on a
             # node that lacks its resources (or violate its placement
@@ -2233,6 +2372,8 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or f"worker {wid} died"
+            self._emit("actor.death", ae.death_cause, actor_id=aid,
+                       worker_id=wid, class_name=ae.class_name)
             self._drain_actor_queue(aid, "died")
 
     # ---------------- worker-side blocking verbs ----------------
@@ -2364,6 +2505,8 @@ class DriverRuntime:
         if te.state in ("PENDING", "SCHEDULED"):
             te.state = "CANCELLED"
             self._respawnable_specs.pop(task_id, None)
+            self._emit("task.cancel", "cancelled before dispatch",
+                       task_id=task_id, name=te.name)
             err = TaskCancelledError(f"task {task_id} cancelled")
             for oid in self._return_ids_of(task_id):
                 self._fail_object(oid, err)
@@ -2403,6 +2546,8 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or "killed before start"
+            self._emit("actor.death", ae.death_cause,
+                       actor_id=actor_id, class_name=ae.class_name)
             for spec in self.actor_queues.pop(actor_id, []):
                 self.gcs.tasks[spec.task_id].state = "FAILED"
                 err = ActorDiedError(f"actor {actor_id} was killed")
@@ -2430,6 +2575,8 @@ class DriverRuntime:
             e = self.gcs.objects.pop(oid, None)
             if e is None or e.loc is None:
                 continue
+            self._emit("object.free", object_id=oid,
+                       task_id=e.owner_task)
             for loc in [e.loc, *e.copies]:
                 if loc.kind == "device":
                     holder = self.workers.get(loc.name)
@@ -2681,6 +2828,52 @@ class DriverRuntime:
             if not sp.get("node_id"):
                 sp["node_id"] = node
             self.trace_spans.append(sp)
+
+    # ---------------- event plane ----------------
+    def _emit(self, event_type: str, message: str = "", **fields) -> None:
+        """Driver-side lifecycle event into the process-local buffer
+        (drained into cluster_events on the tick / on query). Never
+        raises — a telemetry failure must not break scheduling."""
+        try:
+            _ev().emit(event_type, message, **fields)
+        except Exception:
+            pass
+
+    def _on_worker_events(self, wid: str, payload) -> None:
+        w = self.workers.get(wid)
+        node = (w.node_id if w is not None and w.node_id else None) \
+            or self.node_id
+        self.cluster_events.ingest(
+            {"node_id": node, "worker_id": wid}, payload or ())
+
+    def drain_local_events(self) -> None:
+        """Move this process's buffered events into the cluster store.
+        Called from the dispatcher tick and lazily by queries (so a
+        just-emitted driver-side event is visible immediately)."""
+        batch = _ev().drain()
+        if batch:
+            self.cluster_events.ingest(
+                {"node_id": self.node_id, "worker_id": "driver"}, batch)
+
+    def _check_node_heartbeats(self) -> None:
+        """Flag remote nodes whose agent stopped pinging: the
+        node.heartbeat_miss event precedes the socket-level death
+        determination (reference: gcs health-check manager)."""
+        if self._node_hb_timeout <= 0:
+            return
+        now = time.time()
+        for ns in self.cluster_nodes.values():
+            if ns.conn is None or not ns.alive:
+                continue
+            if ns.heartbeat_missed:
+                continue
+            if now - ns.last_heartbeat > self._node_hb_timeout:
+                ns.heartbeat_missed = True
+                self._emit(
+                    "node.heartbeat_miss",
+                    f"no heartbeat from node {ns.node_id} for "
+                    f"{now - ns.last_heartbeat:.1f}s",
+                    node_id=ns.node_id)
 
     def _update_builtin_gauges(self) -> None:
         """Periodic (reaper-tick) refresh of the driver-side pool/store

@@ -79,6 +79,13 @@ class SpillManager:
                 f.write(data)
             os.replace(tmp, path)
             loc.spill_path = path
+            try:
+                from ..util import events as events_mod  # noqa: PLC0415
+                events_mod.emit("object.spill", object_id=oid,
+                                node_id=self.node_id,
+                                size=len(data), path=path)
+            except Exception:
+                pass
             # Drop the arena copy: the spill file is now authoritative and
             # the freed space is what keeps the next puts from evicting
             # not-yet-spilled objects.

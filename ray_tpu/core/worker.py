@@ -19,6 +19,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
+from . import logging as logging_mod
 from . import serialization
 from .ids import new_object_id
 from .object_ref import ObjectRef
@@ -26,6 +27,7 @@ from .object_store import ShmStore, ObjectLocation, INLINE_MAX, make_store
 from .protocol import Connection, ConnectionClosed, connect_address
 from .task import TaskSpec, ActorCreationSpec
 from ..exceptions import TaskError, GetTimeoutError, ObjectLostError
+from ..util import events as events_mod
 from ..util import metrics as metrics_mod
 from ..util import metrics_catalog as mcat
 from ..util import tracing
@@ -384,10 +386,16 @@ class WorkerLoop:
             except Exception:
                 payload = None
         try:
+            events = events_mod.drain()
+        except Exception:
+            events = None
+        try:
             if spans:
                 self.conn.send(("report", "sys.spans", spans))
             if payload:
                 self.conn.send(("report", "sys.metrics", payload))
+            if events:
+                self.conn.send(("report", "sys.events", events))
         except Exception:  # ConnectionClosed included: driver is gone
             pass
 
@@ -465,6 +473,7 @@ class WorkerLoop:
         # Dispatcher-assigned chip indices (disjoint across concurrent
         # workloads; placement-group tasks get their bundle's ids)
         self.rt.current_tpu_ids = list(getattr(spec, "tpu_ids", []) or [])
+        logging_mod.mark_current_task(spec.task_id)
         t0 = time.time()
         exec_span = tracing.new_span_id()
         status = "ok"
@@ -493,6 +502,7 @@ class WorkerLoop:
             self.conn.send(("task_done", spec.task_id, [], err))
         finally:
             self.rt.current_task_id = None
+            logging_mod.mark_current_task(None)
             self._finish_task_telemetry(spec, exec_span, t0, status)
 
     def _create_actor(self, acspec: ActorCreationSpec) -> None:
@@ -579,6 +589,7 @@ class WorkerLoop:
         t0 = time.time()
         exec_span = tracing.new_span_id()
         status = "ok"
+        logging_mod.mark_current_task(spec.task_id)
         try:
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
@@ -608,6 +619,7 @@ class WorkerLoop:
                             f"{spec.method_name}")
             self.conn.send(("task_done", spec.task_id, [], err))
         finally:
+            logging_mod.mark_current_task(None)
             self._finish_task_telemetry(spec, exec_span, t0, status)
 
     async def _run_actor_task_asyncgen(self, spec: TaskSpec) -> None:

@@ -77,6 +77,26 @@ def _stage_metrics():
         return None, None, None
 
 
+def _emit_stall_event(stage_name: str, stall_s: float,
+                      peak_bytes: int) -> None:
+    """One cluster event per stage run that actually stalled on the
+    in-flight budget (the counter metric carries the magnitude; the
+    event makes the episode visible in `events` / post-mortems)."""
+    if stall_s <= 0:
+        return
+    try:
+        from ..util import events as events_mod
+        events_mod.emit(
+            "data.executor_stall",
+            f"stage {stage_name!r} stalled {stall_s:.3f}s on the "
+            f"in-flight backpressure budget",
+            stage=stage_name, stall_s=round(stall_s, 4),
+            budget_bytes=MAX_IN_FLIGHT_BYTES,
+            peak_inflight_bytes=peak_bytes)
+    except Exception:
+        pass
+
+
 def _apply_map(fn: Callable[[Block], Block], block: Block,
                index: int = 0) -> Block:
     return call_block_fn(fn, block, index)
@@ -215,6 +235,7 @@ def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
             "budget_bytes": MAX_IN_FLIGHT_BYTES,
             "peak_inflight_bytes": peak,
             "stall_s": stall_s}
+        _emit_stall_event(stage.name, stall_s, peak)
     return distributed()
 
 
@@ -287,6 +308,7 @@ def _actor_pool_map(stream: Iterator[Block], stage: Stage,
             "budget_bytes": MAX_IN_FLIGHT_BYTES,
             "peak_inflight_bytes": peak,
             "stall_s": stall_s}
+        _emit_stall_event(stage.name, stall_s, peak)
         for a in actors:
             try:
                 api.kill(a)

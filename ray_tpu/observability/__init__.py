@@ -4,10 +4,12 @@ Reference counterpart: python/ray/dashboard, ray.timeline,
 _private/memory_monitor.py (SURVEY.md §2.8 O2/O4/O6).
 """
 from .dashboard import Dashboard, start_dashboard, stop_dashboard
+from .forensics import build_post_mortem, write_post_mortem
 from .memory_monitor import MemoryMonitor, memory_summary
 from .timeline import timeline, timeline_events
 from . import profiler  # noqa: F401
 
 __all__ = ["Dashboard", "start_dashboard", "stop_dashboard",
            "MemoryMonitor", "memory_summary", "timeline",
-           "timeline_events", "profiler"]
+           "timeline_events", "profiler", "build_post_mortem",
+           "write_post_mortem"]

@@ -73,9 +73,19 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(obj, default=str).encode())
 
     def do_GET(self):
-        parsed = urlparse(self.path)
-        q = parse_qs(parsed.query)
-        limit = int(q.get("limit", ["100"])[0])
+        try:
+            parsed = urlparse(self.path)
+            q = parse_qs(parsed.query)
+        except ValueError:
+            self._json({"error": "malformed query string"}, 400)
+            return
+        try:
+            limit = int(q.get("limit", ["100"])[0])
+            since_seq = int(q.get("since", ["0"])[0])
+        except (ValueError, TypeError):
+            # a malformed query param is the CLIENT's error, not a 500
+            self._json({"error": "limit/since must be integers"}, 400)
+            return
         route = parsed.path.rstrip("/")
         try:
             if route == "/api/cluster":
@@ -99,6 +109,29 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(state_mod.summarize_actors())
             elif route == "/api/summary/objects":
                 self._json(state_mod.summarize_objects())
+            elif route == "/api/events":
+                ids = [v for key in ("id", "task_id", "actor_id",
+                                     "object_id", "node_id",
+                                     "worker_id")
+                       for v in q.get(key, [])]
+                types = q.get("type") or None
+                sevs = q.get("severity") or None
+                rows = state_mod.list_events(
+                    limit=limit, ids=ids or None, types=types,
+                    severities=sevs, since_seq=since_seq)
+                self._json({"events": list(rows),
+                            "total": rows.total,
+                            "truncated": rows.truncated})
+            elif route == "/api/summary/events":
+                self._json(state_mod.summarize_events())
+            elif route == "/api/post_mortem":
+                sid = (q.get("id") or [""])[0]
+                if not sid:
+                    self._json({"error": "missing ?id=<task|actor id>"},
+                               400)
+                else:
+                    from . import forensics
+                    self._json(forensics.build_post_mortem(sid))
             elif route == "/api/timeline":
                 self._json(timeline_mod.timeline_events())
             elif route == "/api/serve":
@@ -136,14 +169,25 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/summary/tasks",
                                        "/api/summary/actors",
                                        "/api/summary/objects",
+                                       "/api/summary/events",
+                                       "/api/events",
+                                       "/api/post_mortem",
                                        "/api/jobs",
                                        "/api/timeline", "/metrics"]})
             else:
                 self._json({"error": f"no route {route}"}, 404)
+        except (BrokenPipeError, ConnectionResetError):
+            # client hung up mid-response: writing an error body would
+            # raise again and leak a 500 into the server log — just
+            # drop the connection
+            self.close_connection = True
         except ValueError as e:      # unknown job id etc.
             self._json({"error": str(e)}, 404)
         except Exception as e:  # surface errors as JSON, keep serving
-            self._json({"error": repr(e)}, 500)
+            try:
+                self._json({"error": repr(e)}, 500)
+            except OSError:
+                self.close_connection = True
 
     def do_POST(self):
         route = urlparse(self.path).path.rstrip("/")
@@ -164,12 +208,20 @@ class _Handler(BaseHTTPRequestHandler):
                             "stopped": _jobs().stop_job(sid)})
             else:
                 self._json({"error": f"no route {route}"}, 404)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
         except KeyError as e:
             self._json({"error": f"missing field {e}"}, 400)
+        except json.JSONDecodeError as e:
+            # malformed request body is the client's error, not a 404
+            self._json({"error": f"malformed JSON body: {e}"}, 400)
         except ValueError as e:
             self._json({"error": str(e)}, 404)
         except Exception as e:  # noqa: BLE001
-            self._json({"error": repr(e)}, 500)
+            try:
+                self._json({"error": repr(e)}, 500)
+            except OSError:
+                self.close_connection = True
 
     def _stream_logs(self, sid: str) -> None:
         """Chunked text/plain tail of a job's logs until it exits
@@ -243,6 +295,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>Task summary</h2><table id=tasks></table>
 <h2>Serve</h2><table id=serve></table>
 <h2>Jobs</h2><table id=jobs></table>
+<h2>Recent warnings &amp; errors</h2><table id=events></table>
 <script>
 const cell = v => typeof v === 'object' && v !== null
   ? JSON.stringify(v) : String(v);
@@ -266,6 +319,12 @@ async function refresh(){
     const s = await get('/api/serve');
     rows('serve', s.running ? s.applications : {running: false});
     rows('jobs', await get('/api/jobs'));
+    const ev = await get(
+      '/api/events?severity=warning&severity=error&limit=20');
+    rows('events', (ev.events || []).map(e => ({
+      seq: e.seq, type: e.type, severity: e.severity,
+      message: e.message || '',
+      id: e.task_id || e.actor_id || e.object_id || e.node_id || ''})));
     document.getElementById('err').textContent = '';
   } catch (e) {
     document.getElementById('err').textContent = 'refresh failed: ' + e;

@@ -74,15 +74,44 @@ class MemoryMonitor:
                                         name="rtpu-memmon")
         self._thread.start()
 
+    def _gauge(self, frac: float) -> None:
+        """Publish host memory headroom so pressure is visible on the
+        dashboard BEFORE the watchdog kills anything (cataloged gauge:
+        1 - available/total, i.e. rises toward 1.0 under pressure)."""
+        try:
+            from ..util import metrics_catalog as mcat
+            mcat.get("ray_tpu_node_memory_pressure").set(
+                round(1.0 - frac, 6))
+        except Exception:
+            pass
+
     def _loop(self) -> None:
         from ..core.runtime import get_runtime
+        from ..util import events as events_mod
+        pressured = False
         while not self._stop.wait(self.poll_interval_s):
             host = _host_memory()
             if not host["total"]:
                 continue
             frac = host["available"] / host["total"]
+            self._gauge(frac)
             if frac >= self.min_available_frac:
+                pressured = False
                 continue
+            if not pressured:
+                # one event per pressure episode, emitted whether or
+                # not a kill follows (there may be nothing to kill)
+                pressured = True
+                try:
+                    events_mod.emit(
+                        "node.memory_pressure",
+                        f"host available memory {frac:.1%} below "
+                        f"threshold {self.min_available_frac:.1%}",
+                        node_id=os.environ.get("RAY_TPU_NODE_ID"),
+                        available_frac=round(frac, 4),
+                        threshold=self.min_available_frac)
+                except Exception:
+                    pass
             try:
                 rt = get_runtime()
             except Exception:

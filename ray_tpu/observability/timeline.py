@@ -119,6 +119,23 @@ def timeline_events() -> List[Dict[str, Any]]:
     return events
 
 
+def span_subtree(trace_id: str = "",
+                 subject_id: str = "") -> List[Dict[str, Any]]:
+    """The timeline events belonging to one trace (driver submit spans
+    + worker execution spans sharing `trace_id`), plus any event whose
+    args reference `subject_id` as its task/actor — the span slice a
+    post-mortem bundle carries (observability/forensics.py)."""
+    out = []
+    for e in timeline_events():
+        args = e.get("args") or {}
+        if trace_id and args.get("trace_id") == trace_id:
+            out.append(e)
+        elif subject_id and (args.get("task_id") == subject_id
+                             or args.get("actor_id") == subject_id):
+            out.append(e)
+    return out
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Export the trace; returns the event list, optionally writing JSON
     loadable in chrome://tracing / Perfetto."""

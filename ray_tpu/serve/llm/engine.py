@@ -313,6 +313,22 @@ class LLMEngine:
         self._mtags = {"engine": f"llm-{next(_engine_ids)}"}
         self._m = _engine_metrics()
 
+        # lifecycle events (util/events.py): request admit / preempt /
+        # finish / abort land on the cluster event plane — when this
+        # engine runs inside an actor the worker's telemetry flush
+        # ships them to the driver like sys.metrics
+        def _event(etype, message="", req=None, **attrs):
+            try:
+                from ...util import events as events_mod  # noqa: PLC0415
+                events_mod.emit(
+                    etype, message,
+                    request_id=req.request_id if req is not None
+                    else None,
+                    engine=self._mtags["engine"], **attrs)
+            except Exception:
+                pass
+        self._event = _event
+
         # prefix cache: per layer (n_prefixes, L, Hkv, D) k/v + host-side
         # token records; written by register_prefix, read (copied into a
         # slot) at admission of prefix-carrying requests
@@ -1095,6 +1111,8 @@ class LLMEngine:
         if req is None:
             return
         req.aborted = True
+        self._event("llm_engine.request_abort", req=req,
+                    generated=req.generated)
         if req.generated == 0 and req.slot == -1:
             # still in _waiting: the loop discards it at admission;
             # unblock the consumer immediately (a duplicate end marker
@@ -1360,9 +1378,17 @@ class LLMEngine:
                     # hold the head request (FIFO — Queue has no
                     # push-front) until releases replenish the pool
                     self._pending_head = req
+                    if not getattr(req, "preempt_emitted", False):
+                        req.preempt_emitted = True
+                        self._event("llm_engine.request_preempt",
+                                    "KV page pool exhausted; holding "
+                                    "at admission", req=req)
                     break
                 if outcome == "failed":
                     continue
+                self._event("llm_engine.request_admit", req=req,
+                            slot=req.slot, prompt_len=int(
+                                req.prompt.size))
                 if req.prefix_id >= 0 or self._use_chunked(
                         req.prompt.size):
                     self._prefilling.append(req)
@@ -1373,6 +1399,8 @@ class LLMEngine:
             slot = self._free_slots.pop()
             req.slot = slot
             req.admit_ts = time.time()
+            self._event("llm_engine.request_admit", req=req, slot=slot,
+                        prompt_len=int(req.prompt.size))
             if req.prefix_id >= 0:
                 # adopt the registered prefix's KV with ONE on-device
                 # copy, then chunk-prefill only the suffix
@@ -1697,6 +1725,8 @@ class LLMEngine:
                 except Exception:
                     pass
         finally:
+            self._event("llm_engine.request_finish", req=req,
+                        generated=req.generated, aborted=req.aborted)
             req.out_queue.put(_END)
 
     def _decode_window_pages(self) -> int:

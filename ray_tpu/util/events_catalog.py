@@ -1,0 +1,107 @@
+"""Catalog of every built-in cluster lifecycle event type.
+
+One place declares type / default severity / help for the structured
+event plane (`util/events.py`), mirroring `metrics_catalog.py` for
+metrics: docs/OBSERVABILITY.md renders this table and a tier-1 lint
+test asserts every event type emitted by package code is cataloged and
+follows the `<subsystem>.<event>` naming rule.
+
+Reference counterpart: the task-event/export subsystem behind
+`ray list tasks --detail` (src/ray/gcs task events + the export API) —
+collapsed to a single catalog because the single-controller driver is
+the only consumer-facing store.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+# type -> (default_severity, help)
+_SPEC = Tuple[str, str]
+
+SEVERITIES = ("info", "warning", "error")
+
+NAME_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+
+BUILTIN: Dict[str, _SPEC] = {
+    # ---- task lifecycle (driver dispatcher) ----
+    "task.submit": (
+        "info", "task registered with the scheduler"),
+    "task.sched": (
+        "info", "task dispatched to a worker (submit -> running)"),
+    "task.retry": (
+        "warning", "task re-queued after a worker/node death or "
+        "lineage reconstruction (message holds the cause)"),
+    "task.finish": (
+        "info", "task completed successfully"),
+    "task.fail": (
+        "error", "task reached FAILED (message holds the error)"),
+    "task.cancel": (
+        "warning", "task cancelled"),
+    # ---- actor lifecycle ----
+    "actor.create": (
+        "info", "actor creation registered"),
+    "actor.alive": (
+        "info", "actor constructor finished; actor is ALIVE"),
+    "actor.restart": (
+        "warning", "actor worker died; restart scheduled "
+        "(restart budget remaining)"),
+    "actor.death": (
+        "error", "actor reached DEAD (message holds death_cause)"),
+    # ---- object lifecycle ----
+    "object.seal": (
+        "info", "object payload sealed into a store"),
+    "object.spill": (
+        "info", "object copied to disk by the watermark spiller"),
+    "object.transfer": (
+        "info", "object copy landed on another node "
+        "(peer pull or relay re-host)"),
+    "object.free": (
+        "info", "object freed and its payloads reclaimed"),
+    "object.lost": (
+        "error", "object payload lost and not reconstructable"),
+    # ---- node lifecycle ----
+    "node.register": (
+        "info", "node agent joined the cluster"),
+    "node.heartbeat_miss": (
+        "warning", "node stopped heartbeating (stale or connection "
+        "lost); death determination may follow"),
+    "node.death": (
+        "error", "node declared dead; its work fails over"),
+    "node.memory_pressure": (
+        "warning", "host available memory crossed the pressure "
+        "threshold (the RSS watchdog may kill a worker next)"),
+    # ---- worker pool ----
+    "worker.start": (
+        "info", "worker process spawned"),
+    "worker.death": (
+        "warning", "worker process died or was terminated"),
+    # ---- scheduler ----
+    "scheduler.backpressure": (
+        "warning", "task/actor pending past the stuck-warning window "
+        "with nowhere to place it"),
+    # ---- serve LLM engine ----
+    "llm_engine.request_admit": (
+        "info", "request took a decode slot (prefill dispatching)"),
+    "llm_engine.request_preempt": (
+        "warning", "request held back at admission (KV page pool "
+        "exhausted); re-admitted when pages free"),
+    "llm_engine.request_finish": (
+        "info", "request released its slot (finished or errored)"),
+    "llm_engine.request_abort": (
+        "warning", "request aborted by the client"),
+    # ---- event plane itself ----
+    "events.dropped": (
+        "warning", "a process's local event buffer overflowed between "
+        "flushes; this many events were lost before shipping"),
+    # ---- data executor ----
+    "data.executor_stall": (
+        "warning", "streaming stage producer stalled on the in-flight "
+        "backpressure budget"),
+}
+
+
+def spec(event_type: str) -> Tuple[str, str]:
+    """(default_severity, help) for a cataloged type; uncataloged user
+    types default to ("info", "")."""
+    return BUILTIN.get(event_type, ("info", ""))

@@ -49,6 +49,10 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_object_store_reads_total": (
         "counter", "object reads by outcome "
         "(inline / hit / spill fallback)", ("result",), "reads", None),
+    "ray_tpu_node_memory_pressure": (
+        "gauge", "host memory pressure (1 - available/total); the RSS "
+        "watchdog kills a worker as it approaches 1.0", (), "ratio",
+        None),
     # ---- peer-to-peer object transfer plane (core/object_transfer.py) ----
     "ray_tpu_transfer_bytes_pulled_total": (
         "counter", "object bytes pulled directly from holder nodes",

@@ -1,9 +1,15 @@
 """State API: live introspection of the running cluster.
 
 Reference counterpart: python/ray/util/state (list_actors/list_tasks/
-list_objects/list_nodes/list_workers, summarize_*) backed by
+list_objects/list_nodes/list_workers/list_events, summarize_*) backed by
 python/ray/_private/state.py. Here the driver IS the control store, so
 these read GCS tables directly and return plain dicts.
+
+Filters are (key, op, value) triples; supported ops: "=", "==", "!=",
+numeric "<", "<=", ">", ">=", and substring "contains". Every list_*
+returns a `ListResult` (a list subclass): when `limit` clips rows,
+`.truncated` is True and `.total` holds the full match count instead of
+rows silently disappearing.
 """
 from __future__ import annotations
 
@@ -11,6 +17,30 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..core.runtime import get_runtime
+
+
+class ListResult(list):
+    """A plain list plus truncation metadata (`.truncated`, `.total`).
+    Serializes like a list, so HTTP/JSON consumers are unchanged."""
+
+    def __init__(self, rows, total: Optional[int] = None):
+        super().__init__(rows)
+        self.total = len(self) if total is None else total
+        self.truncated = self.total > len(self)
+
+
+def _numeric(op: str, have: Any, val: Any) -> bool:
+    try:
+        a, b = float(have), float(val)
+    except (TypeError, ValueError):
+        return False
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
 
 
 def _match(row: Dict[str, Any], filters) -> bool:
@@ -23,12 +53,23 @@ def _match(row: Dict[str, Any], filters) -> bool:
         elif op == "!=":
             if str(have) == str(val):
                 return False
+        elif op in ("<", "<=", ">", ">="):
+            if not _numeric(op, have, val):
+                return False
+        elif op == "contains":
+            if have is None or str(val) not in str(have):
+                return False
         else:
             raise ValueError(f"unsupported filter op {op!r}")
     return True
 
 
-def list_actors(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+def _clip(rows, filters, limit: int) -> ListResult:
+    matched = [r for r in rows if _match(r, filters)]
+    return ListResult(matched[:limit], total=len(matched))
+
+
+def list_actors(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for ae in list(rt.gcs.actors.values()):
@@ -40,10 +81,10 @@ def list_actors(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
             "death_cause": ae.death_cause,
             "resources": dict(ae.resources),
         })
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
 
 
-def list_tasks(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+def list_tasks(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for te in list(rt.gcs.tasks.values()):
@@ -55,10 +96,10 @@ def list_tasks(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
             "duration_s": (te.finished_at - te.started_at
                            if te.finished_at and te.started_at else None),
         })
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
 
 
-def list_objects(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+def list_objects(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for oe in list(rt.gcs.objects.values()):
@@ -70,10 +111,10 @@ def list_objects(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
             "store_kind": getattr(loc, "kind", None),
             "created_at": oe.created_at,
         })
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
 
 
-def list_nodes(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+def list_nodes(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for ne in list(rt.gcs.nodes.values()):
@@ -85,10 +126,10 @@ def list_nodes(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
             "labels": dict(ne.labels),
             "is_driver": ne.node_id == rt.node_id,
         })
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
 
 
-def list_workers(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+def list_workers(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for w in list(rt.workers.values()):
@@ -98,18 +139,56 @@ def list_workers(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
             "tpu_capable": w.tpu_capable,
             "uptime_s": time.time() - w.started_at,
         })
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
 
 
-def list_placement_groups(filters=None, limit: int = 100
-                          ) -> List[Dict[str, Any]]:
+def list_placement_groups(filters=None, limit: int = 100) -> ListResult:
     rt = get_runtime()
     rows = []
     for pg in list(rt.placement_groups.values()):
         rows.append({"placement_group_id": pg.pg_id, "name": pg.name,
                      "strategy": pg.strategy, "state": pg.state,
                      "bundles": list(pg.bundles)})
-    return [r for r in rows if _match(r, filters)][:limit]
+    return _clip(rows, filters, limit)
+
+
+def list_events(filters=None, limit: int = 100,
+                ids: Optional[List[str]] = None,
+                types: Optional[List[str]] = None,
+                severities: Optional[List[str]] = None,
+                since_seq: int = 0) -> ListResult:
+    """Cluster lifecycle events from the driver's ClusterEventStore
+    (util/events.py), oldest first. `ids` restricts to events that
+    reference any of the given task/actor/object/node/worker ids via
+    the store's causal index; `filters` then applies the generic
+    (key, op, value) predicates on the event rows (attrs are flattened
+    into the row for filtering)."""
+    rt = get_runtime()
+    rt.drain_local_events()   # just-emitted driver events are queryable
+    # no generic filters -> the store's own newest-window clip serves
+    # directly (no full-log copy per dashboard/CLI poll); with filters
+    # the clip must happen after them, so fetch everything matching
+    rows, total = rt.cluster_events.query(
+        ids=ids, types=types, severities=severities,
+        since_seq=since_seq, limit=0 if filters else limit)
+    if filters:
+        flat = []
+        for ev in rows:
+            r = dict(ev)
+            for k, v in (ev.get("attrs") or {}).items():
+                r.setdefault(k, v)
+            flat.append((r, ev))
+        rows = [ev for r, ev in flat if _match(r, filters)]
+        total = len(rows)
+        if limit and len(rows) > limit:
+            rows = rows[-limit:]   # the newest window
+    return ListResult(rows, total=total)
+
+
+def summarize_events() -> Dict[str, Any]:
+    rt = get_runtime()
+    rt.drain_local_events()
+    return rt.cluster_events.summarize()
 
 
 def summarize_tasks() -> Dict[str, Any]:

@@ -1,0 +1,518 @@
+"""Cluster event plane + failure forensics.
+
+Covers: event-type catalog lint (naming + every emitted literal
+cataloged, mirroring the metrics naming test), EventBuffer /
+ClusterEventStore bounds + causal indexing, driver-side lifecycle
+chains, worker->driver event shipping, state-API filter ops + the
+truncation marker, dashboard /api/events + malformed-param hardening,
+the events / post-mortem CLI, memory-pressure events, and the
+failure-injection acceptance: kill a node agent mid-task and assert
+heartbeat-miss -> node.death -> task.retry -> task.finish plus a
+complete post-mortem bundle for the retried task.
+"""
+import io
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events as events_mod
+from ray_tpu.util import events_catalog
+from ray_tpu.util import state as state_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poll(fn, timeout=15.0, interval=0.2):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+# ---------- catalog lint (satellite: CI/tooling) ----------
+
+def test_event_catalog_naming_rules():
+    assert events_catalog.BUILTIN, "catalog must not be empty"
+    for name, (sev, help_) in events_catalog.BUILTIN.items():
+        assert events_catalog.NAME_RE.match(name), \
+            f"event type {name!r} must be <subsystem>.<event> snake_case"
+        assert sev in events_catalog.SEVERITIES
+        assert help_, f"event type {name!r} needs a help string"
+
+
+def test_no_uncataloged_event_literals():
+    """Lint: every dotted event-type literal passed to an emit-style
+    call inside the package must be cataloged (mirrors the metrics
+    catalog lint)."""
+    pkg = os.path.join(REPO, "ray_tpu")
+    call = re.compile(
+        r"(?:emit|_emit|_event|_emit_event)\(\s*"
+        r"['\"]([a-z0-9_]+\.[a-z0-9_]+)['\"]")
+    offenders = []
+    for root, _dirs, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py") or f == "events_catalog.py":
+                continue
+            path = os.path.join(root, f)
+            with open(path) as fh:
+                for name in call.findall(fh.read()):
+                    if name not in events_catalog.BUILTIN:
+                        offenders.append((path, name))
+    assert not offenders, offenders
+
+
+# ---------- buffer / store units ----------
+
+def test_event_buffer_bounded_drain_and_disable():
+    buf = events_mod.EventBuffer(maxlen=4)
+    for i in range(7):
+        buf.emit("task.submit", task_id=f"t{i}")
+    assert len(buf) == 4 and buf.dropped == 3
+    batch = buf.drain()
+    # overflow ships as a synthetic events.dropped record so the loss
+    # is visible at the driver, not just in this process
+    assert [e.get("task_id") for e in batch[:-1]] == \
+        ["t3", "t4", "t5", "t6"]
+    assert batch[-1]["type"] == "events.dropped"
+    assert batch[-1]["attrs"]["dropped"] == 3
+    assert len(buf) == 0 and buf.drain() == []
+    # severity defaults come from the catalog
+    buf.emit("task.fail", "boom", task_id="x")
+    assert buf.drain()[0]["severity"] == "error"
+    # the kill switch turns emit into a no-op
+    events_mod.set_enabled(False)
+    try:
+        buf.emit("task.submit", task_id="nope")
+        assert len(buf) == 0
+    finally:
+        events_mod.set_enabled(True)
+
+
+def test_cluster_event_store_index_query_summarize():
+    store = events_mod.ClusterEventStore(maxlen=100)
+    src = {"node_id": "nodeA", "worker_id": "w1"}
+    store.ingest(src, [
+        {"type": "task.submit", "ts": 1.0, "severity": "info",
+         "message": "", "task_id": "t1"},
+        {"type": "task.sched", "ts": 2.0, "severity": "info",
+         "message": "", "task_id": "t1", "worker_id": "w9"},
+        {"type": "task.fail", "ts": 3.0, "severity": "error",
+         "message": "boom", "task_id": "t2"},
+    ])
+    # causal index: both t1 events, in order, with source tags stamped
+    chain = store.for_id("t1")
+    assert [e["type"] for e in chain] == ["task.submit", "task.sched"]
+    assert chain[0]["node_id"] == "nodeA"
+    assert chain[1]["worker_id"] == "w9"     # explicit id wins over src
+    # the worker id indexes too
+    assert [e["type"] for e in store.for_id("w9")] == ["task.sched"]
+    # severity + type filters, limit clipping reports the true total
+    rows, total = store.query(severities=["error"], limit=10)
+    assert total == 1 and rows[0]["task_id"] == "t2"
+    rows, total = store.query(limit=2)
+    assert total == 3 and len(rows) == 2
+    assert [r["type"] for r in rows] == ["task.sched", "task.fail"]
+    s = store.summarize()
+    assert s["total"] == 3 and s["by_severity"]["error"] == 1
+    assert s["by_type"]["task.submit"] == 1
+
+
+def test_cluster_event_store_bounded():
+    store = events_mod.ClusterEventStore(maxlen=10)
+    store.ingest({}, [{"type": "object.seal", "ts": float(i),
+                       "object_id": f"o{i}"} for i in range(25)])
+    s = store.summarize()
+    assert s["total"] == 10 and s["dropped"] == 15
+
+
+# ---------- state API filters + truncation (satellite) ----------
+
+@ray_tpu.remote
+def _sq(x):
+    return x * x
+
+
+@ray_tpu.remote
+def _boom():
+    raise ValueError("kaboom-for-events")
+
+
+def test_state_filter_ops_and_truncation(rt):
+    ray_tpu.get([_sq.remote(i) for i in range(5)])
+    rows = state_mod.list_tasks(
+        filters=[("name", "contains", "_sq"),
+                 ("duration_s", ">=", 0)], limit=1000)
+    assert len(rows) >= 5
+    assert all("_sq" in r["name"] for r in rows)
+    # numeric ops reject non-numeric rows instead of raising
+    assert state_mod.list_tasks(
+        filters=[("name", ">", 5)], limit=10) == []
+    with pytest.raises(ValueError):
+        state_mod.list_tasks(filters=[("name", "~", "x")])
+    # truncation marker instead of silent clipping
+    clipped = state_mod.list_tasks(limit=2)
+    assert len(clipped) == 2
+    assert clipped.truncated and clipped.total >= 5
+    full = state_mod.list_tasks(limit=10_000)
+    assert not full.truncated and full.total == len(full)
+
+
+# ---------- live lifecycle chains ----------
+
+def test_task_lifecycle_event_chain(rt):
+    ref = _sq.remote(7)
+    assert ray_tpu.get(ref) == 49
+    tid = next(t["task_id"] for t in state_mod.list_tasks(limit=10_000)
+               if t["name"].startswith("_sq") and t["state"] == "FINISHED")
+    chain = state_mod.list_events(ids=[tid], limit=100)
+    types = [e["type"] for e in chain]
+    for expected in ("task.submit", "task.sched", "task.finish"):
+        assert expected in types, types
+    # causal order by store seq
+    assert types.index("task.submit") < types.index("task.sched") \
+        < types.index("task.finish")
+    sched = next(e for e in chain if e["type"] == "task.sched")
+    assert sched["worker_id"] and sched["node_id"]
+
+
+def test_task_fail_event_and_severity_filter(rt):
+    ref = _boom.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref)
+    fails = _poll(lambda: state_mod.list_events(
+        types=["task.fail"], limit=100))
+    assert fails, "no task.fail event"
+    assert any("kaboom-for-events" in (e.get("message") or "")
+               for e in fails)
+    errors = state_mod.list_events(severities=["error"], limit=100)
+    assert all(e["severity"] == "error" for e in errors)
+    assert any(e["type"] == "task.fail" for e in errors)
+
+
+def test_worker_emitted_events_ship_to_driver(rt):
+    @ray_tpu.remote
+    def emits():
+        from ray_tpu.util import events
+        events.emit("data.executor_stall", "synthetic", stage="t",
+                    stall_s=0.1)
+        return 1
+
+    assert ray_tpu.get(emits.remote()) == 1
+    got = _poll(lambda: [
+        e for e in state_mod.list_events(
+            types=["data.executor_stall"], limit=200)
+        if (e.get("message") == "synthetic")])
+    assert got, "worker-side event never reached the driver store"
+    assert got[0]["worker_id"].startswith("w")
+    assert got[0]["attrs"]["stage"] == "t"
+
+
+def test_actor_lifecycle_events(rt):
+    @ray_tpu.remote
+    class _A:
+        def f(self):
+            return 1
+
+    a = _A.remote()
+    assert ray_tpu.get(a.f.remote()) == 1
+    aid = next(x["actor_id"] for x in state_mod.list_actors(limit=1000)
+               if x["class_name"] == "_A" and x["state"] == "ALIVE")
+    ray_tpu.kill(a)
+    chain = _poll(lambda: (
+        lambda c: c if any(e["type"] == "actor.death" for e in c)
+        else None)(state_mod.list_events(ids=[aid], limit=100)))
+    assert chain, "no actor.death event after kill"
+    types = [e["type"] for e in chain]
+    assert types.index("actor.create") < types.index("actor.alive") \
+        < types.index("actor.death")
+
+
+def test_summarize_events(rt):
+    ray_tpu.get(_sq.remote(1))
+    s = state_mod.summarize_events()
+    assert s["total"] > 0
+    assert s["by_type"].get("task.finish", 0) >= 1
+    assert set(s["by_severity"]) <= set(events_catalog.SEVERITIES)
+
+
+# ---------- post-mortem bundle (local) ----------
+
+def test_post_mortem_bundle_for_failed_task(rt):
+    @ray_tpu.remote
+    def noisy_fail():
+        print("forensic-breadcrumb-217")
+        raise RuntimeError("forensic-crash-217")
+
+    ref = noisy_fail.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref)
+    tid = next(t["task_id"] for t in state_mod.list_tasks(limit=10_000)
+               if "noisy_fail" in t["name"])
+    from ray_tpu.observability import build_post_mortem
+
+    def complete():
+        b = build_post_mortem(tid)
+        types = {e["type"] for e in b["events"]}
+        if "task.fail" not in types:
+            return None
+        if not b["log_tail"]["lines"]:
+            return None   # marker write may lag the fd flush
+        if not b["spans"]:
+            return None
+        return b
+    b = _poll(complete)
+    assert b, "post-mortem bundle never completed"
+    assert b["subject"]["kind"] == "task"
+    assert b["subject"]["task"]["state"] == "FAILED"
+    assert any("forensic-breadcrumb-217" in ln["line"]
+               for ln in b["log_tail"]["lines"])
+    assert "ray_tpu_tasks_submitted_total" in b["metrics"]
+    assert b["event_summary"]["total"] > 0
+    # the chain is causally widened: the executing worker's events ride
+    # along with the task's own
+    assert any(e.get("worker_id") for e in b["events"])
+
+
+# ---------- dashboard routes + hardening (satellite) ----------
+
+def test_api_events_and_param_hardening(rt):
+    ray_tpu.get(_sq.remote(3))
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/events?limit=5",
+                                    timeout=5) as r:
+            data = json.loads(r.read())
+        assert set(data) == {"events", "total", "truncated"}
+        assert data["events"] and data["total"] >= len(data["events"])
+        # filter by type over HTTP
+        with urllib.request.urlopen(
+                dash.url + "/api/events?type=task.finish", timeout=5) as r:
+            rows = json.loads(r.read())["events"]
+        assert rows and all(e["type"] == "task.finish" for e in rows)
+        # malformed query params are 400s, not 500s
+        for bad in ("/api/tasks?limit=abc", "/api/events?limit=1e3",
+                    "/api/events?since=xyz", "/api/post_mortem"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(dash.url + bad, timeout=5)
+            assert ei.value.code == 400, bad
+        # a client that hangs up mid-request must not wedge the server
+        import socket
+        host, port = dash.host, dash.port
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.close()                      # disconnect before reading
+        with urllib.request.urlopen(dash.url + "/api/cluster",
+                                    timeout=5) as r:
+            assert r.status == 200     # still serving
+    finally:
+        stop_dashboard()
+
+
+# ---------- memory pressure (satellite) ----------
+
+def test_memory_pressure_gauge_and_event(rt):
+    from ray_tpu.observability import MemoryMonitor
+    from ray_tpu.util import metrics_catalog as mcat
+    # threshold above 1.0: every poll is a pressure episode, no kill
+    mon = MemoryMonitor(min_available_frac=1.5, poll_interval_s=0.05,
+                        kill=False)
+    try:
+        ev = _poll(lambda: state_mod.list_events(
+            types=["node.memory_pressure"], limit=10))
+        assert ev, "no node.memory_pressure event"
+        assert ev[-1]["severity"] == "warning"
+        assert 0 < ev[-1]["attrs"]["threshold"]
+        g = mcat.get("ray_tpu_node_memory_pressure")
+        assert 0.0 <= g.get() <= 1.0
+    finally:
+        mon.stop()
+
+
+# ---------- CLI ----------
+
+def test_cli_events_and_post_mortem(rt, tmp_path):
+    from ray_tpu.cli import main as cli_main
+    from ray_tpu.observability import start_dashboard, stop_dashboard
+    ray_tpu.get(_sq.remote(4))
+    tid = next(t["task_id"] for t in state_mod.list_tasks(limit=10_000)
+               if t["name"].startswith("_sq"))
+    dash = start_dashboard()
+    try:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "events",
+                      "--type", "task.finish", "--limit", "500"])
+        out = buf.getvalue()
+        assert "task.finish" in out
+        # JSONL export
+        path = str(tmp_path / "events.jsonl")
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "events", "--task", tid,
+                      "-o", path])
+        lines = [json.loads(ln) for ln in open(path)]
+        assert lines and all(ln.get("task_id") == tid or
+                             ln.get("type") for ln in lines)
+        # post-mortem artifact
+        pm_path = str(tmp_path / "pm.json")
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            cli_main(["--address", dash.url, "post-mortem", tid,
+                      "-o", pm_path])
+        bundle = json.load(open(pm_path))
+        assert bundle["subject_id"] == tid
+        assert {"events", "spans", "log_tail", "metrics"} <= set(bundle)
+    finally:
+        stop_dashboard()
+
+
+# ---------- failure injection acceptance (multi-node) ----------
+
+@ray_tpu.remote(max_retries=1)
+def _survivor(tag, sleep_s):
+    print(f"forensic-survivor-{tag}")
+    time.sleep(sleep_s)
+    return f"done-{tag}"
+
+
+def _start_agent(rt, extra_res):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, os.path.dirname(os.path.abspath(__file__)),
+         *env.get("PYTHONPATH", "").split(os.pathsep)])
+    from ray_tpu.util.jaxenv import subprocess_env_cpu
+    subprocess_env_cpu(env)
+    before = set(rt.cluster_nodes)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node", rt.tcp_address,
+         "--num-cpus", "2", "--resources", json.dumps(extra_res)],
+        env=env, cwd=REPO)
+    deadline = time.time() + 30
+    while time.time() < deadline and len(rt.cluster_nodes) == len(before):
+        time.sleep(0.05)
+    new = set(rt.cluster_nodes) - before
+    assert new, "agent failed to register"
+    return proc, new.pop()
+
+
+def test_node_death_event_chain_and_post_mortem():
+    """Acceptance: kill a node agent mid-task; the driver's event chain
+    records heartbeat-miss -> node.death -> task.retry -> task.finish,
+    /api/events + the events CLI serve the causally-indexed chain, and
+    the retried task's post-mortem bundle is complete."""
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+    try:
+        proc, nid = _start_agent(rt, {"doomed_ev": 1.0})
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        # soft pin: first run lands on the doomed node, the retry can
+        # fall back to the driver node
+        ref = _survivor.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                nid, soft=True)).remote("ev1", 8.0)
+        # wait until it is RUNNING on the doomed node
+        deadline = time.time() + 30
+        started_remote = False
+        while time.time() < deadline:
+            te = next(iter(rt.gcs.tasks.values()), None)
+            if te is not None and te.state == "RUNNING":
+                w = rt.workers.get(te.worker_id or "")
+                started_remote = w is not None and w.node_id == nid
+                break
+            time.sleep(0.05)
+        assert started_remote, "task never started on the remote node"
+        task_id = te.task_id
+        proc.kill()
+        proc.wait(timeout=10)
+        assert ray_tpu.get(ref, timeout=90) == "done-ev1"
+
+        def full_chain():
+            evs = state_mod.list_events(limit=10_000)
+            by_type = {}
+            for e in evs:
+                by_type.setdefault(e["type"], []).append(e)
+            need = ("node.heartbeat_miss", "node.death", "task.retry",
+                    "task.finish")
+            if not all(t in by_type for t in need):
+                return None
+            return by_type
+        by_type = _poll(full_chain, timeout=20)
+        assert by_type, "event chain incomplete: " + str(
+            sorted({e['type'] for e in state_mod.list_events(
+                limit=10_000)}))
+        hb = next(e for e in by_type["node.heartbeat_miss"]
+                  if e.get("node_id") == nid)
+        death = next(e for e in by_type["node.death"]
+                     if e.get("node_id") == nid)
+        retry = next(e for e in by_type["task.retry"]
+                     if e.get("task_id") == task_id)
+        fin = max((e for e in by_type["task.finish"]
+                   if e.get("task_id") == task_id),
+                  key=lambda e: e["seq"])
+        assert hb["seq"] < death["seq"] < retry["seq"] < fin["seq"]
+        assert "died" in retry["message"]
+
+        # causal index serves the whole story from the task id alone
+        chain = state_mod.list_events(ids=[task_id], limit=1000)
+        ctypes = [e["type"] for e in chain]
+        assert "task.retry" in ctypes and "task.finish" in ctypes
+
+        # /api/events + CLI over the dashboard (multi-node acceptance)
+        from ray_tpu.observability import start_dashboard, stop_dashboard
+        from ray_tpu.cli import main as cli_main
+        dash = start_dashboard()
+        try:
+            with urllib.request.urlopen(
+                    dash.url + f"/api/events?task_id={task_id}",
+                    timeout=5) as r:
+                rows = json.loads(r.read())["events"]
+            assert any(e["type"] == "task.retry" for e in rows)
+            with urllib.request.urlopen(
+                    dash.url + f"/api/events?node_id={nid}"
+                    "&type=node.death", timeout=5) as r:
+                assert json.loads(r.read())["events"]
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                cli_main(["--address", dash.url, "events",
+                          "--node", nid])
+            assert "node.death" in buf.getvalue()
+
+            # post-mortem for the retried task: chain + spans + the
+            # re-run's tagged log tail + metrics snapshot
+            from ray_tpu.observability import build_post_mortem
+
+            def complete():
+                b = build_post_mortem(task_id)
+                types = {e["type"] for e in b["events"]}
+                if not {"task.retry", "node.death"} <= types:
+                    return None
+                if not b["log_tail"]["lines"]:
+                    return None
+                if not b["spans"]:
+                    return None
+                return b
+            b = _poll(complete, timeout=20)
+            assert b, "post-mortem for the retried task incomplete"
+            assert any("forensic-survivor-ev1" in ln["line"]
+                       for ln in b["log_tail"]["lines"])
+            assert "ray_tpu_tasks_finished_total" in b["metrics"]
+            assert b["subject"]["task"]["state"] == "FINISHED"
+        finally:
+            stop_dashboard()
+    finally:
+        ray_tpu.shutdown()
