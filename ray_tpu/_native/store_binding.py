@@ -15,6 +15,7 @@ hand numpy views over shared pages until release().
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import threading
 from typing import Any
@@ -23,6 +24,9 @@ from .build import build_library
 from ..core import serialization
 from ..core.object_store import INLINE_MAX, ObjectLocation
 from ..exceptions import ObjectLostError, ObjectStoreFullError
+
+# nonce for reseal-under-pin fallback names (see put_value)
+_RESEAL_SEQ = itertools.count()
 
 _ENV_NAME = "RAY_TPU_ARENA_NAME"
 
@@ -156,8 +160,26 @@ class NativeStore:
         if size <= INLINE_MAX:
             return ObjectLocation(kind="inline", size=size,
                                   data=serialization.pack_parts(meta, bufs))
+        name = oid
         off = self._lib.rtpu_arena_create_object(
-            self._handle, oid.encode(), size)
+            self._handle, name.encode(), size)
+        if off == -2:
+            # lineage re-execution resealing an oid whose stale segment
+            # survives in this arena (same-node re-run after a loss, or
+            # a rejoined host): drop the old copy (refcount-safe — a
+            # pinned reader defers the free) and seal fresh
+            self._lib.rtpu_arena_delete(self._handle, name.encode())
+            off = self._lib.rtpu_arena_create_object(
+                self._handle, name.encode(), size)
+        if off == -2:
+            # the stale entry is pin-held (delete pending): seal under a
+            # nonce-suffixed name instead, like put_packed — the nonce
+            # keeps REPEATED reseals of one oid from colliding with
+            # their own earlier suffixed entries (those are unpinned
+            # once read, so the arena LRU reclaims them)
+            name = f"{oid}r{os.getpid():x}x{next(_RESEAL_SEQ)}"
+            off = self._lib.rtpu_arena_create_object(
+                self._handle, name.encode(), size)
         if off == -2:
             raise ValueError(f"object {oid} already exists in the arena")
         if off < 0:
@@ -168,12 +190,12 @@ class NativeStore:
         try:
             serialization.pack_into(self._data[off:off + size], meta, bufs)
         except BaseException:
-            self._lib.rtpu_arena_seal(self._handle, oid.encode())
-            self._lib.rtpu_arena_delete(self._handle, oid.encode())
+            self._lib.rtpu_arena_seal(self._handle, name.encode())
+            self._lib.rtpu_arena_delete(self._handle, name.encode())
             raise
-        self._lib.rtpu_arena_seal(self._handle, oid.encode())
+        self._lib.rtpu_arena_seal(self._handle, name.encode())
         from ..core.object_store import current_node_id  # noqa: PLC0415
-        return ObjectLocation(kind="native", size=size, name=oid,
+        return ObjectLocation(kind="native", size=size, name=name,
                               node_id=current_node_id())
 
     # -- read path ----------------------------------------------------------

@@ -207,7 +207,8 @@ def remote(*args, **kwargs):
                        "max_concurrency", "concurrency_groups", "name",
                        "namespace", "lifetime", "runtime_env",
                        "placement_group", "bundle_index",
-                       "scheduling_strategy", "get_if_exists")
+                       "scheduling_strategy", "get_if_exists",
+                       "checkpoint_interval_s")
             return ActorClass(target,
                               **{k: v for k, v in opts.items()
                                  if k in allowed})

@@ -124,7 +124,8 @@ class ActorClass:
                  max_restarts=0, max_concurrency=1, concurrency_groups=None,
                  name=None, namespace=None, lifetime=None, runtime_env=None,
                  placement_group=None, bundle_index=-1,
-                 scheduling_strategy=None, get_if_exists=False):
+                 scheduling_strategy=None, get_if_exists=False,
+                 checkpoint_interval_s=None):
         from . import runtime_env as renv_mod
         runtime_env = renv_mod.validate(runtime_env) or None
         self._cls = cls
@@ -139,7 +140,8 @@ class ActorClass:
             runtime_env=runtime_env, placement_group=placement_group,
             bundle_index=bundle_index,
             scheduling_strategy=scheduling_strategy,
-            get_if_exists=get_if_exists)
+            get_if_exists=get_if_exists,
+            checkpoint_interval_s=checkpoint_interval_s)
         self._class_bytes: Optional[bytes] = None
 
     def options(self, **opts) -> "ActorClass":
@@ -194,6 +196,7 @@ class ActorClass:
             concurrency_groups=dict(opts.get("concurrency_groups") or {}),
             name=opts["name"],
             namespace=opts["namespace"] or getattr(rt, "namespace", "default"),
+            checkpoint_interval_s=opts.get("checkpoint_interval_s"),
             placement_group_id=getattr(pg, "pg_id", None),
             bundle_index=opts.get("bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy"),

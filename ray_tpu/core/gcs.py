@@ -25,6 +25,13 @@ class ObjectEntry:
     # Additional locations (e.g. the original remote copy after a fetch
     # re-hosted the payload locally); all are freed together.
     copies: List[Any] = dataclasses.field(default_factory=list)
+    # The producing task's spec was evicted from the driver's lineage
+    # table (RAY_TPU_LINEAGE_BYTES): this object can no longer be
+    # reconstructed and loss reports must say so.
+    lineage_evicted: bool = False
+    # Bumped on every seal (initial + lineage reseals); locations are
+    # stamped with it so stale unreachable reports are ignorable.
+    seal_seq: int = 0
 
 
 @dataclasses.dataclass
@@ -70,6 +77,10 @@ class NodeEntry:
     resources: Dict[str, float]
     alive: bool = True
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Bumped each time the same node_id re-registers after a declared
+    # death (preempted host rejoining); messages from older incarnations
+    # are fenced by the driver.
+    incarnation: int = 0
 
 
 class GCS:
@@ -92,6 +103,11 @@ class GCS:
     def seal_object(self, oid: str, loc: Any) -> ObjectEntry:
         e = self.objects.get(oid) or self.add_pending_object(oid)
         e.state, e.loc = "ready", loc
+        e.seal_seq += 1
+        try:
+            loc.seal_seq = e.seal_seq
+        except Exception:
+            pass
         return e
 
     def fail_object(self, oid: str, error: Any) -> ObjectEntry:

@@ -98,15 +98,14 @@ class NodeAgent:
         self._locate_counter = 0
         self._locate_events: Dict[int, tuple] = {}
 
+        # Bumped on every re-registration after a lost driver connection
+        # (network blip, or the driver fenced us after a heartbeat-
+        # declared death): the driver fences traffic from older
+        # incarnations, so a stalled-then-recovered agent can't corrupt
+        # the failover that already happened.
+        self.incarnation = 0
         self.conn = connect_address(driver_address)
-        self.conn.send(("register_node", {
-            "node_id": self.node_id,
-            "hostname": os.uname().nodename,
-            "resources": dict(node_res),
-            "labels": dict(self.labels),
-            "transfer_address": self.transfer_server.address,
-            "pid": os.getpid(),
-        }))
+        self.conn.send(("register_node", self._register_info()))
         # Metrics plane: this agent's registry (node-local store stats,
         # any user metrics recorded here) ships delta snapshots on the
         # node connection; the driver merges them tagged with node_id.
@@ -124,13 +123,27 @@ class NodeAgent:
             threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="node-heartbeat").start()
 
+    def _register_info(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "hostname": os.uname().nodename,
+            "resources": dict(self.resources),
+            "labels": dict(self.labels),
+            "transfer_address": self.transfer_server.address,
+            "incarnation": self.incarnation,
+            "pid": os.getpid(),
+        }
+
     def _heartbeat_loop(self) -> None:
         while True:
             time.sleep(self._heartbeat_interval)
             try:
                 self.conn.send(("heartbeat", time.time()))
-            except ConnectionClosed:
-                return
+            except (ConnectionClosed, OSError):
+                # driver connection down: run() is either reconnecting
+                # (self.conn gets swapped) or exiting (daemon thread
+                # dies with the process) — keep ticking either way
+                continue
             except Exception:
                 pass
 
@@ -139,6 +152,11 @@ class NodeAgent:
         from ..util import metrics_catalog as mcat  # noqa: PLC0415
         from ..util import events as events_mod  # noqa: PLC0415
         exporter = DeltaExporter()
+        # Collected-but-unsent messages: collect()/drain() are
+        # DESTRUCTIVE reads, so a send failure during the rejoin window
+        # must re-queue them (bounded) rather than drop a blip's worth
+        # of deltas and lifecycle events on the floor.
+        pending: list = []
         while True:
             time.sleep(self._metrics_interval)
             try:
@@ -151,18 +169,24 @@ class NodeAgent:
                         float(cap))
                 payload = exporter.collect()
                 if payload:
-                    self.conn.send(("metrics", payload))
+                    pending.append(("metrics", payload))
                 with self._spans_lock:
                     spans, self._spans = self._spans, []
                 if spans:
-                    self.conn.send(("spans", spans))
+                    pending.append(("spans", spans))
                 # event-plane delta batch (anything code on this agent
                 # emitted — memory pressure, engine/data events)
                 evs = events_mod.drain()
                 if evs:
-                    self.conn.send(("events", evs))
-            except ConnectionClosed:
-                return
+                    pending.append(("events", evs))
+                while pending:
+                    self.conn.send(pending[0])
+                    pending.pop(0)
+            except (ConnectionClosed, OSError):
+                # reconnecting (or exiting) — see heartbeat loop; keep
+                # the backlog bounded while the driver is away
+                del pending[:-64]
+                continue
             except Exception:
                 pass  # telemetry must never kill the agent
 
@@ -215,14 +239,58 @@ class NodeAgent:
     def run(self) -> None:
         try:
             while True:
-                m = self.conn.recv()
-                self._handle(m)
+                try:
+                    m = self.conn.recv()
+                    self._handle(m)
+                except ConnectionClosed:
+                    # Driver connection lost — noticed at recv OR at a
+                    # send inside a handler (e.g. worker_spawn_failed):
+                    # a preempted/stalled host (or a network blip) tries
+                    # to REJOIN under a new incarnation instead of dying
+                    # — the driver already failed our work over;
+                    # rejoining just puts this host's capacity back in
+                    # the pool.
+                    if not self._reconnect():
+                        return
+                    continue
                 if m[0] == "shutdown":
                     break
-        except ConnectionClosed:
-            pass  # driver gone: fall through to cleanup
         finally:
             self._cleanup()
+
+    def _reconnect(self) -> bool:
+        """Re-register with the driver under a new incarnation, within
+        the RAY_TPU_NODE_REJOIN_S window (0 disables). Old workers are
+        terminated first: the driver marked them dead at our death
+        determination, and a zombie from the fenced incarnation must
+        not double-execute anything."""
+        window = float(os.environ.get("RAY_TPU_NODE_REJOIN_S", "30"))
+        if window <= 0:
+            return False
+        for proc in self.workers.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        self.workers.clear()
+        deadline = time.time() + window
+        delay = 0.2
+        while time.time() < deadline:
+            try:
+                conn = connect_address(self.driver_address)
+                self.incarnation += 1
+                conn.send(("register_node", self._register_info()))
+            except Exception:
+                time.sleep(min(delay,
+                               max(0.05, deadline - time.time())))
+                delay = min(delay * 2, 2.0)
+                continue
+            self.conn = conn
+            print(f"ray_tpu node {self.node_id} rejoined "
+                  f"{self.driver_address} as incarnation "
+                  f"{self.incarnation}", flush=True)
+            return True
+        return False
 
     def _handle(self, m) -> None:
         mtype = m[0]

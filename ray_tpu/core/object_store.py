@@ -56,6 +56,11 @@ class ObjectLocation:
     # Disk copy written by the SpillManager; readers fall back to it when
     # the arena copy has been evicted (core/spilling.py).
     spill_path: Optional[str] = None
+    # Which seal GENERATION of the object this location belongs to
+    # (stamped by GCS.seal_object): a reader's unreachable report names
+    # the generation it failed against, so a report that raced a
+    # lineage reseal can't prune the fresh copy.
+    seal_seq: Optional[int] = None
 
 
 def current_node_id() -> Optional[str]:
@@ -114,13 +119,48 @@ class ShmStore:
         if size <= INLINE_MAX:
             return ObjectLocation(kind="inline", size=size,
                                   data=serialization.pack_parts(meta, bufs))
+        name = "rtpu_" + oid.replace("-", "")
         with self._lock:
-            if self._used + size > self.capacity:
+            # a reseal of an oid THIS process already holds replaces the
+            # stale segment (see the FileExistsError path below), so its
+            # size must not count against the new copy's admission
+            old_seg = self._segments.get(name)
+            stale_sz = old_seg.size \
+                if old_seg is not None and name in self._created else 0
+            if self._used - stale_sz + size > self.capacity:
                 raise ObjectStoreFullError(
                     f"object {oid} ({size} B) exceeds store capacity "
                     f"({self._used}/{self.capacity} B used)")
-        name = "rtpu_" + oid.replace("-", "")
-        seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
+        except FileExistsError:
+            # lineage re-execution resealing an oid whose stale segment
+            # still lives on this node (same-node re-run after a loss,
+            # or a rejoined host): unlink the old copy — readers already
+            # attached keep their mappings — and seal fresh
+            with self._lock:
+                old = self._segments.pop(name, None)
+                if name in self._created:
+                    self._created.discard(name)
+                    self._used -= old.size if old is not None else 0
+            # unlink via a FRESH attach handle, never via `old`: the old
+            # handle may hold exported zero-copy views whose close()
+            # raises BufferError and would skip the unlink. The old
+            # mapping (and any readers') stays valid after unlink.
+            try:
+                stale = shared_memory.SharedMemory(name=name)
+                stale.unlink()
+                stale.close()
+            except Exception:
+                pass
+            if old is not None:
+                try:
+                    old.close()   # release this process's stale mmap/fd
+                except BufferError:
+                    pass  # live zero-copy exports: mapping must stay
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
         try:
             serialization.pack_into(seg.buf, meta, bufs)
         except BaseException:

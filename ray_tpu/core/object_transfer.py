@@ -37,6 +37,7 @@ read the local copy.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -61,6 +62,13 @@ def _timeout_s() -> float:
 
 def _backoff_s() -> float:
     return float(os.environ.get("RAY_TPU_TRANSFER_BACKOFF_S", "0.05"))
+
+
+def _deadline_s() -> float:
+    """Total wall-clock cap across ALL pull retry rounds: a dead holder
+    must not stall a reader for the full retry budget before lineage
+    reconstruction can kick in (0 disables the cap)."""
+    return float(os.environ.get("RAY_TPU_PULL_DEADLINE_S", "30"))
 
 
 def _mcat():
@@ -342,12 +350,23 @@ class PullManager:
     def _pull_with_retry(self, oid, candidates, chunk_size):
         last_err: Optional[BaseException] = None
         t0 = time.monotonic()
+        cap = _deadline_s()
+        deadline = t0 + cap if cap > 0 else float("inf")
+        rounds = 0
         for attempt in range(_retries() + 1):
             if attempt > 0:
+                if time.monotonic() >= deadline:
+                    break  # total-deadline cap: stop retrying early
                 self.stats["retries"] += 1
                 _record(lambda m: m.get(
                     "ray_tpu_transfer_pull_retries_total").inc())
-                time.sleep(_backoff_s() * (2 ** (attempt - 1)))
+                # jittered exponential backoff (retrying peers must not
+                # thundering-herd one recovering holder), clipped so the
+                # sleep never overruns the deadline
+                delay = _backoff_s() * (2 ** (attempt - 1)) \
+                    * (0.5 + random.random())
+                time.sleep(min(delay,
+                               max(0.0, deadline - time.monotonic())))
                 if self._locate is not None:
                     try:
                         fresh = self._locate(oid)
@@ -360,13 +379,23 @@ class PullManager:
                         if local is not None:
                             self.stats["local_hits"] += 1
                             return local
+            rounds = attempt + 1
             for loc, addr in candidates or ():
                 if addr is None:
                     continue
+                # enforce the deadline WITHIN a round too, and clip the
+                # socket timeout to the remaining budget — several
+                # black-holed holders in one round must not stack full
+                # socket timeouts past the cap
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 start = time.monotonic()
                 try:
-                    data = pull_bytes(addr, oid, loc,
-                                      chunk_size=chunk_size)
+                    data = pull_bytes(
+                        addr, oid, loc, chunk_size=chunk_size,
+                        timeout=min(_timeout_s(), max(0.5, remaining))
+                        if cap > 0 else None)
                 except BaseException as e:  # noqa: BLE001
                     last_err = e
                     continue
@@ -387,8 +416,9 @@ class PullManager:
         self._span(oid, None, 0, t0, "error")
         raise TransferError(
             f"pull of {oid} failed against every holder "
-            f"({len(candidates or ())} candidates, "
-            f"{_retries() + 1} rounds): {last_err!r}")
+            f"({len(candidates or ())} candidates, {rounds} rounds, "
+            f"{time.monotonic() - t0:.1f}s elapsed, deadline "
+            f"{cap:.0f}s): {last_err!r}")
 
     def _host_locally(self, oid: str, data):
         """Re-host pulled bytes in the local store so sibling readers on

@@ -117,7 +117,7 @@ class NodeState:
     gcs_node_manager.cc / node_manager.cc)."""
     __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
                  "alive", "free_tpu_ids", "last_heartbeat",
-                 "heartbeat_missed")
+                 "heartbeat_missed", "incarnation")
 
     def __init__(self, node_id: str, hostname: str,
                  resources: Dict[str, float],
@@ -135,6 +135,8 @@ class NodeState:
         # event before the socket-level death determination lands
         self.last_heartbeat = time.time()
         self.heartbeat_missed = False
+        # bumped on rejoin; messages from older incarnations are fenced
+        self.incarnation = 0
         # Specific chip indices handed to tasks/actors (get_tpu_ids):
         # concurrent TPU workloads on one host must see disjoint chips.
         self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
@@ -203,8 +205,8 @@ class PlacementGroupState:
 
 class DriverRuntime:
     is_driver = True
-    # finished task specs retained for lineage reconstruction; the oldest
-    # drop first once past this many (func_bytes dominate the footprint)
+    # count backstop for the lineage table (the primary bound is
+    # accumulated bytes, RAY_TPU_LINEAGE_BYTES — see _retain_lineage)
     _LINEAGE_RETAIN = 4096
 
     def __init__(self, *, num_cpus=None, num_tpus=None, resources=None,
@@ -304,8 +306,26 @@ class DriverRuntime:
         self._actor_create_specs: Dict[str, ActorCreationSpec] = {}
         self._respawnable_specs: Dict[str, TaskSpec] = {}
         # finished non-actor task specs for lineage reconstruction
-        # (insertion-ordered; bounded)
+        # (insertion-ordered; bounded by accumulated bytes AND count —
+        # evicting a producer pins its surviving outputs as
+        # non-reconstructable via ObjectEntry.lineage_evicted)
         self._lineage_specs: Dict[str, TaskSpec] = {}
+        self._lineage_sizes: Dict[str, int] = {}
+        self._lineage_bytes = 0
+        self._lineage_cap = int(os.environ.get(
+            "RAY_TPU_LINEAGE_BYTES", str(64 << 20)))
+        self._lineage_enabled = os.environ.get(
+            "RAY_TPU_LINEAGE", "1") not in ("0", "false")
+        # how long a reader blocks for a reconstruction it triggered
+        # before giving up on the object
+        self._reconstruct_wait = float(os.environ.get(
+            "RAY_TPU_RECONSTRUCTION_WAIT_S", "60"))
+        # latest __ray_save__ checkpoint per actor, handed back to the
+        # replacement worker for __ray_restore__ around a restart
+        self._actor_checkpoints: Dict[str, bytes] = {}
+        # (node_id, conn id) pairs already reported as fenced, so a
+        # chatty stale incarnation logs one node.fence, not thousands
+        self._fenced_seen: set = set()
         # device-resident objects with an in-flight materialize request
         # (core/device_store.py); cleared when the holder's re-seal lands
         self._materializing: set = set()
@@ -339,6 +359,14 @@ class DriverRuntime:
         self.cluster_events = ClusterEventStore()
         self._node_hb_timeout = float(os.environ.get(
             "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S", "10"))
+        # heartbeat-DECLARED death: a node silent past this long is
+        # declared dead without waiting for its socket to close (a
+        # SIGSTOPped/preempted host can hold a socket open for minutes);
+        # its object copies are pruned and reconstruction starts
+        # immediately. The fenced agent rejoins under a new incarnation.
+        self._node_death_timeout = float(os.environ.get(
+            "RAY_TPU_NODE_DEATH_TIMEOUT_S",
+            str(2.0 * self._node_hb_timeout)))
 
         # peer-to-peer object transfer plane (core/object_transfer.py):
         # the GCS object table is the location directory; this maps each
@@ -424,14 +452,16 @@ class DriverRuntime:
                 self.inbox.put(("register_node", msg[1], conn))
                 while True:
                     m = conn.recv()
-                    self.inbox.put(("node_msg", nid, m))
+                    # the conn travels with the message so the dispatcher
+                    # can fence traffic from a superseded incarnation
+                    self.inbox.put(("node_msg", nid, m, conn))
             else:
                 conn.close()
         except ConnectionClosed:
             if wid is not None:
                 self.inbox.put(("worker_dead", wid))
             if nid is not None:
-                self.inbox.put(("node_dead", nid))
+                self.inbox.put(("node_dead", nid, conn))
 
     def _reap_loop(self):
         while not self._shutdown.is_set():
@@ -482,7 +512,11 @@ class DriverRuntime:
                 acspec = self._actor_create_specs.get(w.purpose)
                 if acspec is not None:
                     w.actor_id = acspec.actor_id
-                    conn.send(("create_actor", acspec))
+                    # a restart hands back the latest __ray_save__
+                    # checkpoint so the actor resumes instead of resetting
+                    conn.send(("create_actor", acspec,
+                               self._actor_checkpoints.get(
+                                   acspec.actor_id)))
             else:
                 w.state = "idle"
         elif kind == "worker_msg":
@@ -493,14 +527,24 @@ class DriverRuntime:
         elif kind == "register_node":
             self._on_register_node(item[1], item[2])
         elif kind == "node_msg":
-            self._handle_node_msg(item[1], item[2])
+            self._handle_node_msg(item[1], item[2],
+                                  item[3] if len(item) > 3 else None)
         elif kind == "node_dead":
-            self._on_node_dead(item[1])
+            self._on_node_dead(item[1],
+                               conn=item[2] if len(item) > 2 else None)
+        elif kind == "object_unreachable":
+            self._on_object_unreachable(
+                item[1], item[2], item[3] if len(item) > 3 else None)
         elif kind == "object_copied":
             e = self.gcs.objects.get(item[1])
             if e is not None and e.state == "ready":
                 newloc = item[2]
                 if newloc not in [e.loc, *e.copies]:
+                    # copies belong to the CURRENT seal generation
+                    try:
+                        newloc.seal_seq = e.seal_seq
+                    except Exception:
+                        pass
                     self._emit("object.transfer", object_id=item[1],
                                node_id=newloc.node_id or self.node_id,
                                size=getattr(newloc, "size", None))
@@ -557,6 +601,15 @@ class DriverRuntime:
                 f"[ray_tpu driver] dropped undeserializable message from "
                 f"{wid}:\n{m[1]}")
             return
+        if w is not None and w.state == "dead" and mtype in (
+                "task_done", "gen_item", "actor_created", "actor_exit",
+                "put", "materialized", "actor_ckpt",
+                "object_unreachable"):
+            # incarnation fence: a worker already declared dead (its node
+            # was heartbeat-declared dead, or it was terminated) may still
+            # be alive and sending — results from the fenced life must not
+            # race the retried/reconstructed one
+            return
         if mtype == "task_done":
             self._on_task_done(wid, m[1], m[2], m[3])
         elif mtype == "gen_item":
@@ -607,6 +660,11 @@ class DriverRuntime:
             self._worker_wait(w, rid, oids, num_returns, timeout)
         elif mtype == "kill_actor":
             self._kill_actor(m[1], m[2])
+        elif mtype == "actor_ckpt":
+            self._on_actor_ckpt(wid, m[1], m[2])
+        elif mtype == "object_unreachable":
+            self._on_object_unreachable(m[1], m[2],
+                                        m[3] if len(m) > 3 else None)
         elif mtype == "cancel":
             # Workers cancel by OBJECT id (mirroring ray.cancel(ref));
             # resolve to the producing task like the driver's
@@ -639,23 +697,73 @@ class DriverRuntime:
     # ---------------- nodes ----------------
     def _on_register_node(self, info: dict, conn: Connection) -> None:
         nid = info["node_id"]
+        inc = int(info.get("incarnation", 0))
+        prev = self.cluster_nodes.get(nid)
+        if prev is not None and prev.alive and prev.conn is not None:
+            if inc <= prev.incarnation:
+                # duplicate/stale registration for a live node
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return
+            # a NEWER incarnation arrived before the old socket's death
+            # was determined: declare the old one dead first so its
+            # workers, objects, and bundles fail over exactly once
+            self._on_node_dead(nid)
+        # the fence-report dedup is per (nid, conn) pair: reset on each
+        # (re)registration so the set stays bounded and an id()-reused
+        # future connection can still report once
+        self._fenced_seen = {k for k in self._fenced_seen
+                             if k[0] != nid}
         ns = NodeState(nid, info.get("hostname", "?"), info["resources"],
                        labels=info.get("labels"), conn=conn)
+        ns.incarnation = inc
         self.cluster_nodes[nid] = ns
         self.gcs.nodes[nid] = NodeEntry(
             node_id=nid, hostname=ns.hostname, resources=dict(ns.total),
-            labels=dict(ns.labels))
+            labels=dict(ns.labels), incarnation=inc)
         if info.get("transfer_address"):
             self.transfer_addrs[nid] = info["transfer_address"]
-        self._emit("node.register", node_id=nid, hostname=ns.hostname,
-                   resources=dict(ns.total))
+        if prev is not None:
+            # elastic rejoin (preempted/stalled host back): queued work
+            # may flow to it again; everything it held was failed over
+            # at death determination and is NOT resurrected
+            self._emit("node.rejoin",
+                       f"node {nid} ({ns.hostname}) re-registered as "
+                       f"incarnation {inc}; stale messages from the old "
+                       "incarnation are fenced",
+                       node_id=nid)
+        else:
+            self._emit("node.register", node_id=nid,
+                       hostname=ns.hostname, resources=dict(ns.total))
         # the driver's own transfer address travels per-candidate in
         # pull_object/locations payloads, so the ack stays minimal
         conn.send(("node_registered", self.node_id, self.job_id))
 
-    def _handle_node_msg(self, nid: str, m) -> None:
+    def _handle_node_msg(self, nid: str, m, conn=None) -> None:
         from .protocol import RECV_ERROR  # noqa: PLC0415
         ns = self.cluster_nodes.get(nid)
+        if ns is not None and (not ns.alive or (
+                conn is not None and ns.conn is not None
+                and ns.conn is not conn)):
+            # incarnation fence: traffic from a heartbeat-declared-dead
+            # node, or over a connection a rejoin superseded, must not
+            # heal liveness or mutate state. Closing the stale socket
+            # prompts that agent to re-register under a new incarnation.
+            key = (nid, id(conn))
+            if key not in self._fenced_seen:
+                self._fenced_seen.add(key)
+                self._emit("node.fence",
+                           f"dropping {m[0]!r} (and any further traffic) "
+                           f"from a superseded incarnation of node {nid}",
+                           node_id=nid)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            return
         if ns is not None:
             # any traffic proves liveness; a flagged miss heals
             ns.last_heartbeat = time.time()
@@ -740,9 +848,13 @@ class DriverRuntime:
                              f"worker {m[1]}: {m[2]}\n")
             self.inbox.put(("worker_dead", m[1]))
 
-    def _on_node_dead(self, nid: str) -> None:
+    def _on_node_dead(self, nid: str, conn=None) -> None:
         ns = self.cluster_nodes.get(nid)
         if ns is None or not ns.alive:
+            return
+        if conn is not None and ns.conn is not None and ns.conn is not conn:
+            # socket-close of a SUPERSEDED incarnation: the rejoined
+            # node stays alive
             return
         # determinism for forensics: the causal chain always reads
         # heartbeat-miss -> death, even when the socket close beat the
@@ -792,16 +904,15 @@ class DriverRuntime:
     def _reconstruct_lost_objects(self, nid: str) -> None:
         """Lineage reconstruction (reference:
         core_worker/reference_count.cc + task resubmission): when a node
-        dies, every ready object whose payload lived there either fails
-        over to a surviving copy, is re-created by re-running its
-        producing task (kept in the bounded lineage log), or fails with
-        ObjectLostError. Runs in the dispatcher BEFORE readers chase the
-        stale location."""
+        dies — socket-close OR heartbeat-declared — every ready object
+        whose payload lived there either fails over to a surviving copy,
+        is re-created by re-running its producing task (kept in the
+        bounded lineage log), or fails. Runs in the dispatcher BEFORE
+        readers chase the stale location."""
         def alive(node_id) -> bool:
             n = self.cluster_nodes.get(node_id)
             return n is not None and n.alive
 
-        resubmitted = set()
         for oid, e in list(self.gcs.objects.items()):
             if e.state != "ready":
                 continue
@@ -815,45 +926,305 @@ class DriverRuntime:
             if loc_node != nid:
                 continue
             survivors = [c for c in e.copies
-                         if getattr(c, "node_id", None) != nid
-                         and (getattr(c, "node_id", None) is None
-                              or alive(c.node_id))]
+                         if getattr(c, "node_id", None) is None
+                         or alive(c.node_id)]
             if survivors:
                 e.loc = survivors[0]
                 e.copies = [c for c in survivors if c is not e.loc]
                 continue
-            task_id = e.owner_task
-            spec = self._lineage_specs.get(task_id) if task_id else None
-            if (spec is not None and spec.actor_id is None
-                    and not getattr(spec, "streaming", False)):
-                # Reset ONLY this lost object — sibling returns that are
-                # inline or still have live payloads keep serving reads;
-                # the re-run's seal simply refreshes their location.
-                e.state, e.loc, e.error = "pending", None, None
-                if task_id not in resubmitted:
-                    resubmitted.add(task_id)
-                    te = self.gcs.tasks.get(task_id)
-                    if te is not None:
-                        te.state = "PENDING"
-                        te.finished_at = None
-                    self._respawnable_specs[task_id] = spec
-                    self.pending_tasks.append(spec)
-                    self._emit("task.retry",
-                               f"lineage reconstruction: node {nid} "
-                               f"died holding this task's outputs",
-                               task_id=task_id, node_id=nid,
-                               name=spec.name)
-                    sys.stderr.write(
-                        f"[ray_tpu] node {nid} died; reconstructing "
-                        f"{spec.name} ({task_id}) for lost objects\n")
-            else:
-                self._emit("object.lost",
-                           f"only copy lived on dead node {nid}; "
-                           "producing task not re-executable",
-                           object_id=oid, task_id=task_id, node_id=nid)
-                self._fail_object(oid, ObjectLostError(
-                    f"object {oid} lived only on dead node {nid} and "
-                    "its producing task is not re-executable"))
+            self._handle_lost_object(
+                oid, e, cause=f"only copy lived on dead node {nid}",
+                node_id=nid)
+
+    # ---------------- lineage / reconstruction ----------------
+    @staticmethod
+    def _max_reconstruction_depth() -> int:
+        return int(os.environ.get(
+            "RAY_TPU_MAX_RECONSTRUCTION_DEPTH", "16"))
+
+    @staticmethod
+    def _max_reconstructions() -> int:
+        """Per-task cap on REPEAT re-executions (distinct from the
+        recursion depth cap): a flapping node must not re-run the same
+        producer forever while a reader blocks."""
+        return int(os.environ.get("RAY_TPU_MAX_RECONSTRUCTIONS", "20"))
+
+    def _lineage_cost(self, spec) -> int:
+        """Rough retained footprint of one lineage entry: func_bytes
+        usually dominates; by-VALUE args are estimated by walking a few
+        container levels (getsizeof alone counts a list's pointer
+        array, not the gigabytes of ndarrays inside it). Args passed by
+        ObjectRef cost nothing — the ref IS the lineage edge."""
+        def est(a, depth=0):
+            if isinstance(a, ObjectRef):
+                return 64
+            nb = getattr(a, "nbytes", None)
+            if isinstance(nb, int):
+                return nb
+            if isinstance(a, (bytes, bytearray, memoryview, str)):
+                return len(a)
+            if depth < 3 and isinstance(a, (list, tuple, set)):
+                return 64 + sum(est(x, depth + 1) for x in a)
+            if depth < 3 and isinstance(a, dict):
+                return 64 + sum(est(k, depth + 1) + est(v, depth + 1)
+                                for k, v in a.items())
+            try:
+                return sys.getsizeof(a)
+            except Exception:
+                return 64
+        n = len(spec.func_bytes or b"") + 256
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            n += est(a)
+        return n
+
+    def _retain_lineage(self, task_id: str, spec) -> None:
+        """Keep a finished task's spec so its outputs can name their
+        recipe. Bounded by accumulated bytes (RAY_TPU_LINEAGE_BYTES) and
+        entry count; evicting a producer pins its surviving outputs as
+        non-reconstructable (the newest entry is always kept, even when
+        alone over the cap)."""
+        if not self._lineage_enabled:
+            return
+        cost = self._lineage_cost(spec)
+        # move-to-end on re-retain (a reconstructed producer finishing
+        # again): eviction pops oldest-INSERTED, and a hot re-executed
+        # spec must not sit at the head of the line
+        self._lineage_specs.pop(task_id, None)
+        self._lineage_specs[task_id] = spec
+        self._lineage_bytes += cost - self._lineage_sizes.get(task_id, 0)
+        self._lineage_sizes[task_id] = cost
+        # the spec is (back) in the table: un-pin outputs a concurrent
+        # eviction may have flagged while this re-run was in flight
+        for oid in spec.return_ids:
+            e = self.gcs.objects.get(oid)
+            if e is not None:
+                e.lineage_evicted = False
+        while len(self._lineage_specs) > 1 and (
+                self._lineage_bytes > self._lineage_cap
+                or len(self._lineage_specs) > self._LINEAGE_RETAIN):
+            old_id = next(iter(self._lineage_specs))
+            old = self._lineage_specs.pop(old_id)
+            self._lineage_bytes -= self._lineage_sizes.pop(old_id, 0)
+            for ooid in old.return_ids:
+                oe = self.gcs.objects.get(ooid)
+                if oe is not None:
+                    oe.lineage_evicted = True
+
+    def _object_live(self, e) -> bool:
+        """At least one recorded payload location is still servable
+        (inline / alive node / alive holding worker)."""
+        if e.state != "ready":
+            return False
+        for loc in [e.loc, *e.copies]:
+            if loc is None:
+                continue
+            kind = getattr(loc, "kind", None)
+            if kind == "inline":
+                return True
+            if kind == "device":
+                w = self.workers.get(loc.name)
+                if w is not None and w.state != "dead" \
+                        and w.conn is not None:
+                    return True
+                continue
+            nid = getattr(loc, "node_id", None) or self.node_id
+            n = self.cluster_nodes.get(nid)
+            if n is not None and n.alive:
+                return True
+        return False
+
+    def _lost_object_error(self, oid: str, e, detail: str):
+        """The user-facing error for a lost, non-reconstructable object.
+        An object produced by a dead actor's task reports the ACTOR's
+        death (with its death_cause), not a bare ObjectLostError — the
+        two used to race on worker-death ordering."""
+        te = self.gcs.tasks.get(e.owner_task) if e.owner_task else None
+        aid = te.actor_id if te is not None else None
+        if aid:
+            ae = self.gcs.actors.get(aid)
+            if ae is not None and ae.state in ("DEAD", "RESTARTING"):
+                cause = ae.death_cause or "worker died"
+                return ActorDiedError(
+                    f"object {oid} was produced by actor {aid} "
+                    f"({ae.class_name}), which died: {cause} [{detail}]")
+        return ObjectLostError(f"object {oid} {detail}")
+
+    def _handle_lost_object(self, oid: str, e, *, cause: str,
+                            node_id=None) -> bool:
+        """An object's last payload copy is gone: re-execute its
+        producer from the lineage table when possible, else fail it.
+        Returns True when a reconstruction is in flight."""
+        why = self._reconstruct_object(oid, cause=cause, node_id=node_id)
+        if why is None:
+            return True
+        detail = f"{cause}; {why}"
+        self._emit("object.lost", detail, object_id=oid,
+                   task_id=e.owner_task or None, node_id=node_id)
+        self._fail_object(oid, self._lost_object_error(oid, e, detail))
+        return False
+
+    def _reconstruct_object(self, oid: str, *, depth: int = 0,
+                            cause: str = "", node_id=None,
+                            _seen=None) -> Optional[str]:
+        """Queue a lineage re-execution of `oid`'s producing task — and,
+        recursively, of any lost arguments up to
+        RAY_TPU_MAX_RECONSTRUCTION_DEPTH. Returns None when a re-run is
+        (now or already) in flight; otherwise a human-readable reason
+        why the object cannot be reconstructed. Dispatcher-thread only;
+        concurrent triggers dedupe on the entry/task state."""
+        e = self.gcs.objects.get(oid)
+        if e is None:
+            return "object entry was freed"
+        task_id = e.owner_task
+        te = self.gcs.tasks.get(task_id) if task_id else None
+        if e.state == "pending" and te is not None \
+                and te.state in ("PENDING", "SCHEDULED", "RUNNING"):
+            return None  # a concurrent reconstruction is already running
+        if not self._lineage_enabled:
+            return "lineage recording is disabled (RAY_TPU_LINEAGE=0)"
+        if not task_id:
+            return ("has no producing task (ray_tpu.put / driver-created "
+                    "objects are not reconstructable)")
+        if getattr(e, "lineage_evicted", False):
+            return ("its producing task's spec was evicted from the "
+                    "lineage table (RAY_TPU_LINEAGE_BYTES cap)")
+        spec = self._lineage_specs.get(task_id) \
+            or self._respawnable_specs.get(task_id)
+        if spec is None:
+            return "its producing task's spec is not in the lineage table"
+        if spec.actor_id is not None:
+            return ("its producer was an actor method and is not "
+                    "re-executable")
+        if getattr(spec, "streaming", False):
+            return ("its producer was a streaming generator (consumed "
+                    "items cannot replay)")
+        if getattr(spec, "reconstructions", 0) \
+                >= self._max_reconstructions():
+            return (f"its producer already re-executed "
+                    f"{spec.reconstructions} times "
+                    f"(RAY_TPU_MAX_RECONSTRUCTIONS cap)")
+        _seen = _seen if _seen is not None else set()
+        if task_id in _seen:
+            return None  # this producer is already part of the chain
+        _seen.add(task_id)
+        maxd = self._max_reconstruction_depth()
+        # lost ARGUMENTS first: every dep must be present or recoverable,
+        # or the re-run would either hang pending or fail on an errored
+        # dep — the recursion is what re-executes a whole producer chain
+        for d in spec.dep_object_ids:
+            de = self.gcs.objects.get(d)
+            if de is None:
+                return (f"argument {d} of {spec.name} was freed; cannot "
+                        "re-execute")
+            lost_dep = de.state == "error" and isinstance(
+                de.error, ObjectLostError)
+            if de.state == "error" and not lost_dep:
+                return (f"argument {d} of {spec.name} failed to "
+                        f"produce: {de.error!r}")
+            if de.state == "pending" or (de.state == "ready"
+                                         and self._object_live(de)):
+                continue
+            if depth + 1 > maxd:
+                return (f"argument {d} of {spec.name} is lost and "
+                        f"re-creating it would exceed "
+                        f"RAY_TPU_MAX_RECONSTRUCTION_DEPTH={maxd}")
+            why = self._reconstruct_object(
+                d, depth=depth + 1,
+                cause=f"lost argument of {spec.name}",
+                node_id=node_id, _seen=_seen)
+            if why is not None:
+                return (f"argument {d} of {spec.name} is lost and not "
+                        f"reconstructable: {why}")
+        resubmit = te is None or te.state not in ("PENDING", "SCHEDULED",
+                                                  "RUNNING")
+        self._emit("object.lost",
+                   f"{cause or 'payload lost'}; reconstructing via "
+                   "recorded lineage",
+                   severity="warning", object_id=oid, task_id=task_id,
+                   node_id=node_id)
+        # Reset ONLY this lost object — sibling returns with live
+        # payloads keep serving reads; the re-run's seal refreshes them.
+        e.state, e.loc, e.error, e.copies = "pending", None, None, []
+        self._emit("object.reconstruct",
+                   f"re-executing producer {spec.name} "
+                   f"({'resubmitted' if resubmit else 'already queued'}"
+                   f", depth {depth})",
+                   object_id=oid, task_id=task_id, node_id=node_id,
+                   name=spec.name, depth=depth)
+        try:
+            _mcat().get("ray_tpu_object_reconstructions_total").inc()
+        except Exception:
+            pass
+        if resubmit:
+            spec.reconstructions = getattr(spec, "reconstructions", 0) + 1
+            if te is not None:
+                te.state = "PENDING"
+                te.finished_at = None
+            self._respawnable_specs[task_id] = spec
+            self.pending_tasks.append(spec)
+            self._emit("task.retry",
+                       f"lineage reconstruction of {oid}: "
+                       f"{cause or 'payload lost'}",
+                       task_id=task_id, object_id=oid, node_id=node_id,
+                       name=spec.name)
+            sys.stderr.write(
+                f"[ray_tpu] reconstructing {spec.name} ({task_id}) for "
+                f"lost object {oid}: {cause or 'payload lost'}\n")
+        return None
+
+    def _on_object_unreachable(self, oid: str, nid=None,
+                               seq=None) -> None:
+        """A reader exhausted the pull/relay paths against `oid`'s
+        recorded locations (PullManager failover exhaustion, fetch
+        timeout, holder gone): prune the copies it failed against and
+        reconstruct unless a live candidate remains. Dispatcher only."""
+        e = self.gcs.objects.get(oid)
+        if e is None or e.state != "ready":
+            return  # already reconstructing / freed / failed
+        if seq is not None and seq != e.seal_seq:
+            # the reader failed against an OLDER seal generation and a
+            # reseal has landed since (e.g. a reconstruction that
+            # finished while this report was in flight, possibly back
+            # on the same rejoined node): don't prune the fresh copy —
+            # the reader's retry will pick it up
+            return
+        if nid is not None:
+            keep = [c for c in [e.loc, *e.copies]
+                    if c is not None
+                    and (getattr(c, "node_id", None)
+                         or self.node_id) != nid]
+        else:
+            keep = [c for c in [e.loc, *e.copies] if c is not None]
+        if keep:
+            e.loc, e.copies = keep[0], keep[1:]
+            if self._object_live(e):
+                return  # a failover candidate remains; readers retry
+        self._handle_lost_object(
+            oid, e,
+            cause="every recorded copy is unreachable"
+                  + (f" (holder node {nid} did not serve the read)"
+                     if nid else ""),
+            node_id=nid)
+
+    def _await_object(self, oid: str,
+                      timeout: Optional[float] = 60.0):
+        """Block until `oid` settles again; returns the waiter-style
+        ("loc"|"error", payload) pair, or ("timeout", None). Helper/API
+        threads only (never the dispatcher) — the shared wait behind
+        _reload_one and the reconstruction retries in _worker_get."""
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+
+        def cb(results, ready):
+            box.update(results)
+            ev.set()
+
+        waiter = Waiter([oid], None, cb)
+        self.inbox.put(("api_waiter", waiter))
+        if not ev.wait(timeout):
+            waiter.done = True
+            return ("timeout", None)
+        return box.get(oid, ("error", ObjectLostError(f"{oid} missing")))
 
     def _object_candidates(self, oid: str) -> List[Tuple[Any, Optional[str]]]:
         """Location-directory entries for one object: every live
@@ -1243,46 +1614,20 @@ class DriverRuntime:
         try:
             w.conn.send(("materialize", oid))
         except ConnectionClosed:
+            # the holder is plainly dead even if its socket-close event
+            # hasn't landed yet: run the FULL death handling (actor
+            # death first, then device-object loss) so a dead actor's
+            # objects fail with ActorDiedError, not ObjectLostError
             self._materializing.discard(oid)
-            self._device_object_lost(oid, e)
+            self._on_worker_dead(w.worker_id)
 
     def _device_object_lost(self, oid: str, e) -> None:
         """A device-resident object's holder is gone (or refused):
         re-run the producing task from the lineage log, or fail the
         object — the single-object analog of _reconstruct_lost_objects."""
         self._materializing.discard(oid)
-        task_id = e.owner_task
-        spec = self._lineage_specs.get(task_id) if task_id else None
-        if (spec is not None and spec.actor_id is None
-                and not getattr(spec, "streaming", False)
-                # every dep must still exist: a freed dep would leave
-                # the resubmitted task pending forever (_deps_ready
-                # treats a missing entry as not-yet-ready)
-                and all(d in self.gcs.objects
-                        for d in spec.dep_object_ids)):
-            e.state, e.loc, e.error = "pending", None, None
-            te = self.gcs.tasks.get(task_id)
-            if te is not None and te.state != "PENDING":
-                te.state = "PENDING"
-                te.finished_at = None
-                self._respawnable_specs[task_id] = spec
-                self.pending_tasks.append(spec)
-                self._emit("task.retry",
-                           f"device object {oid} lost its holder; "
-                           "re-running producer",
-                           task_id=task_id, object_id=oid,
-                           name=spec.name)
-                sys.stderr.write(
-                    f"[ray_tpu] device object {oid} lost its holder; "
-                    f"reconstructing {spec.name} ({task_id})\n")
-        else:
-            self._emit("object.lost",
-                       "device-resident holder died; producing task "
-                       "not re-executable", object_id=oid,
-                       task_id=task_id)
-            self._fail_object(oid, ObjectLostError(
-                f"device-resident object {oid} lost its holding worker "
-                "and its producing task is not re-executable"))
+        self._handle_lost_object(
+            oid, e, cause="device-resident holder worker died")
 
     def _add_waiter(self, w: Waiter, timeout: Optional[float] = None):
         self.waiters[w.waiter_id] = w
@@ -2200,10 +2545,8 @@ class DriverRuntime:
         spec = self._respawnable_specs.pop(task_id, None)
         if spec is not None and error is None and spec.actor_id is None:
             # retain for lineage reconstruction of this task's outputs
-            # (bounded: oldest lineage drops first)
-            self._lineage_specs[task_id] = spec
-            while len(self._lineage_specs) > self._LINEAGE_RETAIN:
-                self._lineage_specs.pop(next(iter(self._lineage_specs)))
+            # (byte- and count-bounded: oldest lineage drops first)
+            self._retain_lineage(task_id, spec)
         if te.actor_id is not None:
             gkey = (te.actor_id, getattr(te, "concurrency_group", None))
             self.actor_group_inflight[gkey] = max(
@@ -2236,6 +2579,7 @@ class DriverRuntime:
                        class_name=ae.class_name)
         else:
             ae.state, ae.death_cause = "DEAD", repr(err)
+            self._actor_checkpoints.pop(actor_id, None)
             self._emit("actor.death",
                        f"constructor failed: {repr(err)[:400]}",
                        actor_id=actor_id, worker_id=wid,
@@ -2304,6 +2648,12 @@ class DriverRuntime:
                     for oid in self._return_ids_of(w.current_task):
                         self._fail_object(oid, err)
                     self._gen_settle(w.current_task, err)
+        # actor hosted here -> restart or mark dead FIRST: sealed
+        # objects this worker still held (device-resident returns) must
+        # fail with the actor's death_cause, not a bare ObjectLostError
+        # — the two paths used to race on ordering
+        if w.actor_id:
+            self._on_actor_worker_dead(w.actor_id, wid)
         # device-resident objects held by this worker are gone:
         # reconstruct from lineage or fail (mirrors node-death handling)
         for oid, e in list(self.gcs.objects.items()):
@@ -2311,9 +2661,6 @@ class DriverRuntime:
                     and getattr(e.loc, "kind", None) == "device"
                     and e.loc.name == wid):
                 self._device_object_lost(oid, e)
-        # actor hosted here -> restart or mark dead
-        if w.actor_id:
-            self._on_actor_worker_dead(w.actor_id, wid)
 
     def _fail_inflight_actor_tasks(self, aid: str, cause: str) -> None:
         err = ActorDiedError(f"actor {aid} {cause}")
@@ -2345,10 +2692,21 @@ class DriverRuntime:
             return
         ae.state = "DEAD"
         ae.death_cause = "actor_exit() called"
+        self._actor_checkpoints.pop(aid, None)
         self._emit("actor.death", ae.death_cause, actor_id=aid,
                    class_name=ae.class_name)
         self._fail_inflight_actor_tasks(aid, "exited via actor_exit()")
         self._drain_actor_queue(aid, "exited via actor_exit()")
+
+    def _on_actor_ckpt(self, wid: str, aid: str, blob) -> None:
+        """Latest __ray_save__ state from the actor's worker; handed to
+        the replacement worker's __ray_restore__ around a restart."""
+        ae = self.gcs.actors.get(aid)
+        if ae is None or ae.state == "DEAD" or blob is None:
+            return
+        self._actor_checkpoints[aid] = blob
+        self._emit("actor.checkpoint", actor_id=aid, worker_id=wid,
+                   size=len(blob))
 
     def _on_actor_worker_dead(self, aid: str, wid: str):
         ae = self.gcs.actors.get(aid)
@@ -2372,6 +2730,7 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or f"worker {wid} died"
+            self._actor_checkpoints.pop(aid, None)
             self._emit("actor.death", ae.death_cause, actor_id=aid,
                        worker_id=wid, class_name=ae.class_name)
             self._drain_actor_queue(aid, "died")
@@ -2401,64 +2760,109 @@ class DriverRuntime:
             # are dispatcher-owned); the helper thread only reads it
             cand = {oid: self._object_candidates(oid) for oid in cross}
 
-            def finish(full=full, cross=cross, w=w, rid=rid, wnode=wnode,
-                       cand=cand):
+            def serve_one(oid, loc, cands, w=w, rid=rid, wnode=wnode):
+                """Move one cross-node payload to the requester; returns
+                the reply tuple. Raises (notably ObjectLostError) on an
+                unreachable holder — the caller then triggers lineage
+                reconstruction and retries with the fresh location."""
                 chunk_sz = int(os.environ.get("RAY_TPU_FETCH_CHUNK",
                                               str(64 << 20)))
+                if getattr(loc, "kind", None) == "inline" or \
+                        (loc.node_id or self.node_id) == wnode:
+                    return ("loc", loc)  # reconstructed copy came local
+                # 0. directory: a copy already on the requester's node
+                # serves as a plain local read
+                local = next(
+                    (c for c, _a in cands
+                     if (c.node_id or self.node_id) == wnode), None)
+                if local is not None:
+                    return ("loc", local)
+                if wnode != self.node_id:
+                    # 1. peer path: requester's agent pulls direct from
+                    # the holder
+                    newloc = self._request_node_pull(wnode, oid, cands)
+                    if newloc is not None:
+                        self.inbox.put(("object_copied", oid, newloc))
+                        return ("loc", newloc)
+                # 2. relay fallback (also the driver-node requester
+                # path, where fetch_bytes itself pulls peer-direct from
+                # the holder's server)
+                if (loc.node_id or self.node_id) == self.node_id:
+                    data = self.store.get_bytes(loc)
+                else:
+                    data = self.fetch_bytes(loc, oid=oid)
+                    try:
+                        newloc = self.store.put_packed(oid, data)
+                    except Exception:
+                        newloc = None
+                    if newloc is not None:
+                        self.inbox.put(("object_copied", oid, newloc))
+                        if wnode == self.node_id:
+                            return ("loc", newloc)
+                if (w is not None and w.conn is not None
+                        and len(data) > chunk_sz):
+                    for off in range(0, len(data), chunk_sz):
+                        w.conn.send(("value_chunk", rid, oid, off,
+                                     len(data),
+                                     data[off:off + chunk_sz]))
+                    if wnode != self.node_id:
+                        self._count_relay(len(data))
+                    return ("value_staged", len(data))
+                if wnode != self.node_id:
+                    # payload leaves over the worker's control
+                    # connection: driver relay
+                    self._count_relay(len(data))
+                return ("value", data)
+
+            def finish(full=full, cross=cross, w=w, rid=rid, wnode=wnode,
+                       cand=cand):
+                # First pass: serve what's reachable; report EVERY lost
+                # object up front so the dispatcher reconstructs them
+                # concurrently (a serial report-and-wait would make the
+                # wall clock the SUM of the reconstructions, not the
+                # max).
+                retry: List[str] = []
                 for oid in cross:
                     _, loc = full[oid]
                     try:
-                        # 0. directory: a copy already on the requester's
-                        # node serves as a plain local read
-                        local = next(
-                            (c for c, _a in cand.get(oid, ())
-                             if (c.node_id or self.node_id) == wnode),
-                            None)
-                        if local is not None:
-                            full[oid] = ("loc", local)
-                            continue
-                        if wnode != self.node_id:
-                            # 1. peer path: requester's agent pulls
-                            # direct from the holder
-                            newloc = self._request_node_pull(
-                                wnode, oid, cand.get(oid, []))
-                            if newloc is not None:
-                                self.inbox.put(("object_copied", oid,
-                                                newloc))
-                                full[oid] = ("loc", newloc)
-                                continue
-                        # 2. relay fallback (also the driver-node
-                        # requester path, where fetch_bytes itself pulls
-                        # peer-direct from the holder's server)
-                        if (loc.node_id or self.node_id) == self.node_id:
-                            data = self.store.get_bytes(loc)
-                        else:
-                            data = self.fetch_bytes(loc, oid=oid)
-                            try:
-                                newloc = self.store.put_packed(oid, data)
-                            except Exception:
-                                newloc = None
-                            if newloc is not None:
-                                self.inbox.put(("object_copied", oid,
-                                                newloc))
-                                if wnode == self.node_id:
-                                    full[oid] = ("loc", newloc)
-                                    continue
-                        if (w is not None and w.conn is not None
-                                and len(data) > chunk_sz):
-                            for off in range(0, len(data), chunk_sz):
-                                w.conn.send(("value_chunk", rid, oid, off,
-                                             len(data),
-                                             data[off:off + chunk_sz]))
-                            full[oid] = ("value_staged", len(data))
-                            if wnode != self.node_id:
-                                self._count_relay(len(data))
-                        else:
-                            full[oid] = ("value", data)
-                            if wnode != self.node_id:
-                                # payload leaves over the worker's
-                                # control connection: driver relay
-                                self._count_relay(len(data))
+                        full[oid] = serve_one(oid, loc,
+                                              cand.get(oid, []))
+                    except ObjectLostError:
+                        # every recorded copy failed us: the dispatcher
+                        # prunes the bad copies and re-executes the
+                        # producer from lineage
+                        self.inbox.put((
+                            "object_unreachable", oid,
+                            getattr(loc, "node_id", None)
+                            or self.node_id,
+                            getattr(loc, "seal_seq", None)))
+                        retry.append(oid)
+                    except BaseException as e:  # noqa: BLE001
+                        full[oid] = ("error", e)
+                # Second pass: wait for the re-seals (overlapping — the
+                # first await covers the others' reconstruction time)
+                # and serve each ONCE more.
+                for oid in retry:
+                    kind2, payload2 = self._await_object(
+                        oid, timeout=self._reconstruct_wait)
+                    if kind2 == "timeout":
+                        full[oid] = ("error", ObjectLostError(
+                            f"object {oid} did not reconstruct within "
+                            f"{self._reconstruct_wait}s"))
+                        continue
+                    if kind2 != "loc":
+                        full[oid] = ("error", payload2)
+                        continue
+                    # fresh location; rebuild ONE candidate with its
+                    # holder's transfer address so the peer path (not
+                    # the driver relay) still serves the reconstructed
+                    # payload
+                    loc = payload2
+                    addr = self.transfer_addrs.get(
+                        getattr(loc, "node_id", None) or self.node_id)
+                    try:
+                        full[oid] = serve_one(
+                            oid, loc, [(loc, addr)] if addr else [])
                     except BaseException as e:  # noqa: BLE001
                         full[oid] = ("error", e)
                 if w is not None and w.conn is not None:
@@ -2703,31 +3107,27 @@ class DriverRuntime:
                 out.append(self._load_location(payload))
             except ObjectLostError:
                 # the holder died between the waiter firing and the
-                # read: one fresh round-trip picks up the reconstructed
-                # (or re-hosted) copy — mirrors the worker-side
-                # _get_one_fresh retry
+                # read: report the unreachable copy (the dispatcher
+                # prunes it and re-executes the producer from lineage
+                # when no live copy remains), then one fresh round-trip
+                # picks up the reconstructed/re-hosted copy — mirrors
+                # the worker-side _get_one_fresh retry
+                self.inbox.put(("object_unreachable", oid,
+                                getattr(payload, "node_id", None)
+                                or self.node_id,
+                                getattr(payload, "seal_seq", None)))
                 out.append(self._reload_one(oid, timeout))
         return out
 
     def _reload_one(self, oid: str, timeout: Optional[float]) -> Any:
         """Single-object re-resolve after a stale-location read failed;
         lineage reconstruction resets the entry to pending, so a fresh
-        waiter round-trip blocks until the re-run reseals it."""
-        ev = threading.Event()
-        box: Dict[str, Any] = {}
-
-        def cb(results, ready):
-            box.update(results)
-            ev.set()
-
-        waiter = Waiter([oid], None, cb)
-        self.inbox.put(("api_waiter", waiter))
-        if not ev.wait(timeout):
-            waiter.done = True
+        waiter round-trip (_await_object) blocks until the re-run
+        reseals it."""
+        kind, payload = self._await_object(oid, timeout=timeout)
+        if kind == "timeout":
             raise GetTimeoutError(
                 f"get() timed out re-resolving lost object {oid}")
-        kind, payload = box.get(oid, ("error",
-                                      ObjectLostError(f"{oid} missing")))
         if kind == "error":
             if isinstance(payload, BaseException):
                 raise payload
@@ -2862,18 +3262,29 @@ class DriverRuntime:
         if self._node_hb_timeout <= 0:
             return
         now = time.time()
-        for ns in self.cluster_nodes.values():
+        for ns in list(self.cluster_nodes.values()):
             if ns.conn is None or not ns.alive:
                 continue
-            if ns.heartbeat_missed:
-                continue
-            if now - ns.last_heartbeat > self._node_hb_timeout:
+            stale = now - ns.last_heartbeat
+            if not ns.heartbeat_missed and stale > self._node_hb_timeout:
                 ns.heartbeat_missed = True
                 self._emit(
                     "node.heartbeat_miss",
                     f"no heartbeat from node {ns.node_id} for "
-                    f"{now - ns.last_heartbeat:.1f}s",
+                    f"{stale:.1f}s",
                     node_id=ns.node_id)
+            if 0 < self._node_death_timeout < stale:
+                # heartbeat-DECLARED death: don't wait for the socket to
+                # close — prune the node's object copies and start
+                # lineage reconstruction now. Closing the conn fences a
+                # stalled-but-alive agent and prompts it to rejoin under
+                # a new incarnation.
+                conn = ns.conn
+                self._on_node_dead(ns.node_id)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
 
     def _update_builtin_gauges(self) -> None:
         """Periodic (reaper-tick) refresh of the driver-side pool/store

@@ -48,6 +48,10 @@ class TaskSpec:
     # bookkeeping
     func_id: str = ""                  # cache key for deserialized functions
     dep_object_ids: List[str] = dataclasses.field(default_factory=list)
+    # times this task was re-queued by lineage reconstruction (a lost
+    # output re-executing its producer; args referenced by ObjectRef
+    # stay refs, so the retained spec is cheap unless args are by-value)
+    reconstructions: int = 0
     # cross-process tracing (util/tracing.py): span_id names this task's
     # SUBMIT span; the executing worker opens a child execution span
     # parented to it, so the timeline links driver and worker sides
@@ -73,6 +77,10 @@ class ActorCreationSpec:
         default_factory=dict)
     name: Optional[str] = None
     namespace: str = "default"
+    # min seconds between __ray_save__ checkpoint ships (None = the
+    # RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S default; only actors defining
+    # the hook checkpoint at all)
+    checkpoint_interval_s: Optional[float] = None
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
     scheduling_strategy: Optional[Any] = None

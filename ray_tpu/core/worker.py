@@ -140,7 +140,9 @@ class WorkerRuntime:
                     out.append(self._get_one_fresh(oid, timeout))
         return out
 
-    def _get_one_fresh(self, oid: str, timeout: Optional[float]) -> Any:
+    def _get_one_fresh(self, oid: str, timeout: Optional[float],
+                       _retried: bool = False) -> Any:
+        t0 = time.monotonic()
         rid = self._new_req()
         self.conn.send(("get_request", rid, [oid], timeout))
         kind, payload = self._take_reply(rid, timeout)[oid]
@@ -151,7 +153,23 @@ class WorkerRuntime:
             return serialization.unpack(payload)
         if kind == "value_staged":
             return serialization.unpack(self.take_staged_value(rid, oid))
-        return self.store.get_value(payload)
+        try:
+            return self.store.get_value(payload)
+        except ObjectLostError:
+            if _retried:
+                raise
+            # segment gone without a spill copy: report the unreachable
+            # location (the driver prunes it and reconstructs from
+            # lineage when no live copy remains) and take ONE more
+            # round-trip — on the REMAINING timeout budget, so
+            # get(timeout=T) still bounds at ~T, not 2T
+            self.conn.send(("object_unreachable", oid,
+                            getattr(payload, "node_id", None)
+                            or os.environ.get("RAY_TPU_NODE_ID"),
+                            getattr(payload, "seal_seq", None)))
+            remaining = None if timeout is None else max(
+                0.1, timeout - (time.monotonic() - t0))
+            return self._get_one_fresh(oid, remaining, _retried=True)
 
     def put(self, value: Any) -> ObjectRef:
         from . import device_store  # noqa: PLC0415
@@ -281,6 +299,9 @@ class WorkerLoop:
         self._telemetry_lock = threading.Lock()
         self._last_flush = 0.0
         self._heartbeat_on = True   # set from env in run()
+        # __ray_save__ checkpoint shipping (actors that define the hook)
+        self._ckpt_lock = threading.Lock()
+        self._last_ckpt = 0.0
 
     # ---- main -------------------------------------------------------------
     def run(self) -> None:
@@ -330,7 +351,11 @@ class WorkerLoop:
             if mtype == "exec_task":
                 self._task_q.put(("task", msg[1]))
             elif mtype == "create_actor":
-                self._task_q.put(("create_actor", msg[1]))
+                # (acspec, checkpoint|None) — the checkpoint is the
+                # actor's latest __ray_save__ state around a restart
+                self._task_q.put(("create_actor",
+                                  (msg[1],
+                                   msg[2] if len(msg) > 2 else None)))
             elif mtype == "exec_actor_task":
                 self._task_q.put(("actor_task", msg[1]))
             elif mtype == "get_reply":
@@ -505,7 +530,8 @@ class WorkerLoop:
             logging_mod.mark_current_task(None)
             self._finish_task_telemetry(spec, exec_span, t0, status)
 
-    def _create_actor(self, acspec: ActorCreationSpec) -> None:
+    def _create_actor(self, payload) -> None:
+        acspec, ckpt = payload
         try:
             from . import runtime_env as renv_mod  # noqa: PLC0415
             # dedicated worker: the actor's runtime_env holds for its life
@@ -513,6 +539,17 @@ class WorkerLoop:
             cls = serialization.loads_call(acspec.class_bytes)
             args, kwargs = _resolve_args(self.rt, acspec.args, acspec.kwargs)
             self._actor_instance = cls(*args, **kwargs)
+            if ckpt is not None and hasattr(self._actor_instance,
+                                            "__ray_restore__"):
+                # restart of a checkpointing actor: the constructor ran
+                # with the ORIGINAL args, then state resumes from the
+                # last __ray_save__ snapshot instead of resetting
+                self._actor_instance.__ray_restore__(
+                    serialization.unpack(ckpt))
+                events_mod.emit(
+                    "actor.restore",
+                    f"restored __ray_save__ checkpoint ({len(ckpt)} B)",
+                    actor_id=acspec.actor_id, worker_id=self.worker_id)
             self._actor_spec = acspec
             self.rt.current_actor_id = acspec.actor_id
             self.rt.current_tpu_ids = list(
@@ -584,6 +621,40 @@ class WorkerLoop:
             self._put_gen_item(spec, item)
         return False
 
+    def _maybe_checkpoint(self) -> None:
+        """After a completed actor call: if the actor opted into the
+        checkpoint contract (defines __ray_save__), serialize its state
+        and ship it to the driver for the next restart's
+        __ray_restore__. Throttled by checkpoint_interval_s (actor
+        option, falling back to RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S;
+        0 = after every completed call). Never fails user work."""
+        inst = self._actor_instance
+        save = getattr(inst, "__ray_save__", None)
+        if inst is None or save is None:
+            return
+        interval = getattr(self._actor_spec, "checkpoint_interval_s",
+                           None)
+        if interval is None:
+            interval = float(os.environ.get(
+                "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S", "0"))
+        try:
+            # pack AND send under the lock: with max_concurrency > 1,
+            # an older blob sent after a newer one would roll the
+            # driver's retained state backwards
+            with self._ckpt_lock:
+                now = time.monotonic()
+                if interval > 0 and now - self._last_ckpt < interval:
+                    return
+                blob = serialization.pack(save())
+                self._last_ckpt = now
+                self.conn.send(("actor_ckpt", self.rt.current_actor_id,
+                                blob))
+            mcat.get("ray_tpu_actor_checkpoints_total").inc()
+        except Exception:
+            # a failing checkpoint must not fail the call that
+            # triggered it; the actor just restarts from an older one
+            pass
+
     def _run_actor_task(self, spec: TaskSpec) -> None:
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
         t0 = time.time()
@@ -602,9 +673,11 @@ class WorkerLoop:
                         status = "cancelled"
                     self.conn.send(("task_done", spec.task_id, [],
                                     "cancelled" if cancelled else None))
+                    self._maybe_checkpoint()
                     return
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._maybe_checkpoint()
         except ActorExitRequest:
             # graceful self-exit: this call returns None, then the actor
             # goes down for good (no restart)
@@ -649,6 +722,7 @@ class WorkerLoop:
                 status = "cancelled"
             self.conn.send(("task_done", spec.task_id, [],
                             "cancelled" if cancelled else None))
+            self._maybe_checkpoint()
         except ActorExitRequest:
             self.conn.send(("task_done", spec.task_id, [], None))
             self.conn.send(("actor_exit", self.rt.current_actor_id))
@@ -675,6 +749,7 @@ class WorkerLoop:
             result = await method(*args, **kwargs)
             sealed = self._seal_returns(spec, result)
             self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._maybe_checkpoint()
         except ActorExitRequest:
             sealed = self._seal_returns(spec, None)
             self.conn.send(("task_done", spec.task_id, sealed, None))

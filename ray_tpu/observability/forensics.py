@@ -127,6 +127,50 @@ def _log_tail(rt, subject: Dict[str, Any],
     return {"lines": [], "note": "no log attribution for this subject"}
 
 
+def _reconstruction_chain(rt, subject_id: str) -> List[Dict[str, Any]]:
+    """The lineage walk behind any reconstructions touching the
+    subject: starting from the subject task, follow dep-object edges
+    upstream through their producing tasks (bounded hops) and collect
+    each hop's object.lost / object.reconstruct / task.retry events —
+    so a post-mortem shows WHICH producers re-executed and why, not
+    just that the final task retried."""
+    recon_types = ("object.lost", "object.reconstruct", "task.retry")
+    out: List[Dict[str, Any]] = []
+    seen: set = set()
+    frontier = [subject_id]
+    for hop in range(8):
+        nxt: List[str] = []
+        for tid in frontier:
+            if tid in seen:
+                continue
+            seen.add(tid)
+            spec = rt._lineage_specs.get(tid) \
+                or rt._respawnable_specs.get(tid)
+            events = [ev for ev in rt.cluster_events.for_id(tid)
+                      if ev.get("type") in recon_types]
+            for dep in list(getattr(spec, "dep_object_ids", []) or []):
+                events.extend(
+                    ev for ev in rt.cluster_events.for_id(dep)
+                    if ev.get("type") in recon_types)
+                de = rt.gcs.objects.get(dep)
+                if de is not None and de.owner_task:
+                    nxt.append(de.owner_task)
+            if events:
+                te = rt.gcs.tasks.get(tid)
+                out.append({
+                    "task_id": tid,
+                    "name": te.name if te is not None else None,
+                    "hop": hop,
+                    "reconstructions": getattr(spec, "reconstructions",
+                                               0) if spec else 0,
+                    "events": sorted(events,
+                                     key=lambda ev: ev.get("ts", 0))})
+        if not nxt:
+            break
+        frontier = nxt
+    return out
+
+
 def build_post_mortem(subject_id: str) -> Dict[str, Any]:
     """One JSON artifact: event chain + span subtree + tagged log tail
     + metrics snapshot for a task_id or actor_id."""
@@ -151,6 +195,7 @@ def build_post_mortem(subject_id: str) -> Dict[str, Any]:
         "events": chain,
         "spans": spans,
         "log_tail": logs,
+        "reconstruction": _reconstruction_chain(rt, subject_id),
         "metrics": metrics_text,
         "event_summary": rt.cluster_events.summarize(),
     }

@@ -48,6 +48,12 @@ BUILTIN: Dict[str, _SPEC] = {
         "(restart budget remaining)"),
     "actor.death": (
         "error", "actor reached DEAD (message holds death_cause)"),
+    "actor.checkpoint": (
+        "info", "actor shipped a __ray_save__ state checkpoint to the "
+        "driver (restored via __ray_restore__ around a restart)"),
+    "actor.restore": (
+        "info", "restarted actor resumed from its last __ray_save__ "
+        "checkpoint via __ray_restore__"),
     # ---- object lifecycle ----
     "object.seal": (
         "info", "object payload sealed into a store"),
@@ -59,7 +65,13 @@ BUILTIN: Dict[str, _SPEC] = {
     "object.free": (
         "info", "object freed and its payloads reclaimed"),
     "object.lost": (
-        "error", "object payload lost and not reconstructable"),
+        "error", "object payload lost with no live copy (severity "
+        "warning when lineage reconstruction follows; error when the "
+        "producer is not re-executable)"),
+    "object.reconstruct": (
+        "warning", "lost object's producing task re-queued from the "
+        "driver's lineage table (the Ray-paper availability trick: a "
+        "lost object is a re-execution, not an error)"),
     # ---- node lifecycle ----
     "node.register": (
         "info", "node agent joined the cluster"),
@@ -67,7 +79,15 @@ BUILTIN: Dict[str, _SPEC] = {
         "warning", "node stopped heartbeating (stale or connection "
         "lost); death determination may follow"),
     "node.death": (
-        "error", "node declared dead; its work fails over"),
+        "error", "node declared dead (socket close or heartbeat "
+        "silence past RAY_TPU_NODE_DEATH_TIMEOUT_S); its work fails "
+        "over and its object copies are pruned"),
+    "node.rejoin": (
+        "info", "a dead-declared node re-registered under a new "
+        "incarnation; queued work may flow to it again"),
+    "node.fence": (
+        "warning", "traffic from a superseded node incarnation dropped "
+        "(stalled agent recovered after its death determination)"),
     "node.memory_pressure": (
         "warning", "host available memory crossed the pressure "
         "threshold (the RSS watchdog may kill a worker next)"),

@@ -49,6 +49,12 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_object_store_reads_total": (
         "counter", "object reads by outcome "
         "(inline / hit / spill fallback)", ("result",), "reads", None),
+    "ray_tpu_object_reconstructions_total": (
+        "counter", "lost objects whose producing task was re-queued "
+        "from the lineage table", (), "objects", None),
+    "ray_tpu_actor_checkpoints_total": (
+        "counter", "actor __ray_save__ checkpoints shipped to the "
+        "driver", (), "checkpoints", None),
     "ray_tpu_node_memory_pressure": (
         "gauge", "host memory pressure (1 - available/total); the RSS "
         "watchdog kills a worker as it approaches 1.0", (), "ratio",

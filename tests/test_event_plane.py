@@ -53,6 +53,15 @@ def test_event_catalog_naming_rules():
         assert help_, f"event type {name!r} needs a help string"
 
 
+def test_catalog_requires_recovery_plane_events():
+    """The recovery plane's lifecycle events are part of the contract:
+    forensic chains and the chaos tests key on them, so the catalog
+    must keep carrying them."""
+    for required in ("object.reconstruct", "node.rejoin", "node.fence",
+                     "actor.checkpoint", "actor.restore"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

@@ -170,3 +170,144 @@ def test_lineage_reconstruction_reruns_cpu_task(failover_cluster):
         # genuinely reconstructed (not just read from the driver copy)
         e2 = rt.gcs.objects[ref.id]
         assert getattr(e2.loc, "node_id", None) != nid
+
+
+@ray_tpu.remote
+def _double(d):
+    return {"tag": d["tag"], "data": d["data"] * 2}
+
+
+def _wait_ready(rt, oid, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        e = rt.gcs.objects.get(oid)
+        if e is not None and e.state == "ready":
+            return e
+        time.sleep(0.05)
+    raise AssertionError(f"object {oid} never sealed")
+
+
+def _soft(nid):
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    return NodeAffinitySchedulingStrategy(nid, soft=True)
+
+
+def test_chaos_kill_only_copy_mid_pipeline_event_chain(failover_cluster):
+    """Chaos: the node agent holding the ONLY copy of an intermediate
+    object dies mid-pipeline; the downstream stage still produces the
+    correct value via recorded lineage, and the event plane shows
+    object.lost -> object.reconstruct -> task.retry -> task.finish."""
+    rt = failover_cluster
+    proc, nid = _start_agent(rt, {"chaos": 1.0})
+    mid = _deterministic_blob.options(
+        scheduling_strategy=_soft(nid)).remote(120_000, "mid")
+    e = _wait_ready(rt, mid.id)
+    if getattr(e.loc, "node_id", None) != nid:
+        proc.kill()
+        pytest.skip("intermediate landed on the driver node")
+    proc.kill()
+    proc.wait(timeout=10)
+    out = ray_tpu.get(_double.remote(mid), timeout=90)
+    assert out["tag"] == "mid"
+    assert int(out["data"][123]) == 123 * 2 * 2
+    assert len(out["data"]) == 120_000
+    rt.drain_local_events()
+    obj_types = [ev["type"] for ev in rt.cluster_events.for_id(mid.id)]
+    assert "object.lost" in obj_types
+    assert "object.reconstruct" in obj_types
+    assert obj_types.index("object.lost") \
+        < obj_types.index("object.reconstruct")
+    producer = rt.gcs.objects[mid.id].owner_task
+    task_types = [ev["type"]
+                  for ev in rt.cluster_events.for_id(producer)]
+    assert "task.retry" in task_types
+    assert "task.finish" in task_types
+    # the reconstructed copy no longer names the dead node
+    assert getattr(rt.gcs.objects[mid.id].loc, "node_id", None) != nid
+
+
+def test_recursive_argument_reconstruction(failover_cluster):
+    """A lost object whose producer's ARGUMENT is also lost re-executes
+    the whole producer chain (bounded by the depth cap)."""
+    rt = failover_cluster
+    proc, nid = _start_agent(rt, {"rec": 1.0})
+    a = _deterministic_blob.options(
+        scheduling_strategy=_soft(nid)).remote(110_000, "a")
+    b = _double.options(scheduling_strategy=_soft(nid)).remote(a)
+    ea = _wait_ready(rt, a.id)
+    eb = _wait_ready(rt, b.id)
+    if getattr(ea.loc, "node_id", None) != nid \
+            or getattr(eb.loc, "node_id", None) != nid:
+        proc.kill()
+        pytest.skip("chain did not land on the doomed node")
+    proc.kill()
+    proc.wait(timeout=10)
+    out = ray_tpu.get(b, timeout=120)
+    assert out["tag"] == "a" and int(out["data"][10]) == 10 * 2 * 2
+    rt.drain_local_events()
+    # BOTH levels of the chain reconstructed
+    for oid in (a.id, b.id):
+        types = [ev["type"] for ev in rt.cluster_events.for_id(oid)]
+        assert "object.reconstruct" in types, (oid, types)
+
+
+def test_heartbeat_declared_death_prunes_copies_and_node_rejoins():
+    """A SIGSTOPped agent (socket open, heartbeats silent) is declared
+    dead on the heartbeat path: its object copies are pruned from the
+    directory and reconstruction runs WITHOUT waiting for a socket
+    close. On SIGCONT the fenced agent rejoins under a new incarnation
+    and queued work flows to it again."""
+    import signal as _signal
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S"] = "2"
+    os.environ["RAY_TPU_NODE_DEATH_TIMEOUT_S"] = "4"
+    os.environ["RAY_TPU_NODE_HEARTBEAT_S"] = "0.3"
+    try:
+        rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+        proc, nid = _start_agent(rt, {"hb": 1.0})
+        ref = _deterministic_blob.options(
+            scheduling_strategy=_soft(nid)).remote(100_000, "hb")
+        e = _wait_ready(rt, ref.id)
+        landed = getattr(e.loc, "node_id", None)
+        os.kill(proc.pid, _signal.SIGSTOP)
+        try:
+            deadline = time.time() + 25
+            while time.time() < deadline and rt.cluster_nodes[nid].alive:
+                time.sleep(0.1)
+            assert not rt.cluster_nodes[nid].alive, \
+                "heartbeat silence did not declare the node dead"
+            # copies on the heartbeat-dead node are pruned (satellite:
+            # not only at socket-level death handling)
+            e = rt.gcs.objects[ref.id]
+            if landed == nid:
+                assert all(
+                    getattr(c, "node_id", None) != nid
+                    for c in [e.loc, *e.copies] if c is not None) \
+                    or e.state != "ready"
+            out = ray_tpu.get(ref, timeout=60)
+            assert out["tag"] == "hb"
+        finally:
+            os.kill(proc.pid, _signal.SIGCONT)
+        # the fenced agent rejoins under a new incarnation
+        deadline = time.time() + 40
+        while time.time() < deadline and not rt.cluster_nodes[nid].alive:
+            time.sleep(0.1)
+        assert rt.cluster_nodes[nid].alive, "agent never rejoined"
+        assert rt.cluster_nodes[nid].incarnation >= 1
+        rt.drain_local_events()
+        assert any(ev["type"] == "node.rejoin"
+                   for ev in rt.cluster_events.for_id(nid))
+
+        @ray_tpu.remote(resources={"hb": 1})
+        def where():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        # queued work flows to the rejoined node again
+        assert ray_tpu.get(where.remote(), timeout=60) == nid
+        proc.terminate()
+    finally:
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S", None)
+        os.environ.pop("RAY_TPU_NODE_DEATH_TIMEOUT_S", None)
+        os.environ.pop("RAY_TPU_NODE_HEARTBEAT_S", None)
+        ray_tpu.shutdown()
