@@ -72,3 +72,84 @@ class ActorExitRequest(RayTpuError):
     """Raised by ray_tpu.actor_exit() inside an actor method: the current
     call completes as a normal (None) result and the actor shuts down
     gracefully without restart (reference: ray.actor.exit_actor)."""
+
+
+# ---- serve-plane fault tolerance -------------------------------------------
+# These are RETRIABLE request failures: the serve handle resubmits the
+# request to a different replica (after refreshing the routing table)
+# when it sees one of them, and the HTTP proxy maps them to retriable
+# status codes. Replica-side raises cross process boundaries wrapped in
+# TaskError (repr-string), so the handle matches them by cause name —
+# keep the class names stable.
+
+class EngineWedgedError(RayTpuError):
+    """The LLM engine's generation loop stopped making forward progress
+    past RAY_TPU_ENGINE_WATCHDOG_S while requests were admitted (a hung
+    device call, a deadlocked control command). The replica fails its
+    health check with a `wedged` cause and in-flight requests are
+    aborted with this error so the handle can fail over."""
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The replica is gracefully draining (rolling update / scale-down /
+    shutdown) and admits no new requests; in-flight work completes.
+    Retriable: the handle re-routes to a RUNNING replica."""
+
+
+class NoCapacityError(RayTpuError, TimeoutError):
+    """Every replica of the deployment stayed at max_ongoing_requests
+    for the whole routing wait. The proxy maps this to 503 with
+    Retry-After. Subclasses TimeoutError for callers of the old
+    `_pick_replica` timeout contract."""
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's propagated absolute deadline expired before (or
+    while) it could be admitted; it was shed rather than executed.
+    The proxy maps this to 503 with Retry-After."""
+
+
+def error_cause_is(exc: BaseException, *names: str) -> bool:
+    """True when `exc` is one of the named types, or is a TaskError
+    whose cause_repr names one. Replica-side raises cross the actor
+    boundary wrapped in TaskError (repr string; the original type is
+    lost), so the serve plane matches retriable causes by class name —
+    this is the ONE place that encodes that convention."""
+    if type(exc).__name__ in names:
+        return True
+    cause = getattr(exc, "cause_repr", "") or ""
+    return any(cause.startswith(name + "(") for name in names)
+
+
+def classify_request_failure(exc: BaseException) -> str:
+    """Symbolic failure class of a serve request, shared by every
+    ingress so the retriable/shed/timeout taxonomy can't drift between
+    proxies: "backpressure" (client should back off), "no_capacity"
+    (all replicas saturated; retriable), "shed" (deadline expired
+    before execution; retriable), "timeout" (executed but blew the
+    budget), "error" (everything else). Name-based via error_cause_is,
+    so TaskError-wrapped replica raises classify identically."""
+    if error_cause_is(exc, "BackPressureError"):
+        return "backpressure"
+    if error_cause_is(exc, "NoCapacityError"):
+        return "no_capacity"
+    if error_cause_is(exc, "DeadlineExceededError"):
+        return "shed"
+    if error_cause_is(exc, "StreamInterruptedError"):
+        return "interrupted"   # retriable by contract (post-first-token)
+    if error_cause_is(exc, "GetTimeoutError"):
+        return "timeout"
+    return "error"
+
+
+class StreamInterruptedError(RayTpuError):
+    """A streaming response died AFTER yielding its first chunk (replica
+    death or wedged engine mid-stream). Transparent resubmission would
+    replay already-delivered tokens, so the caller gets this typed,
+    retriable error instead; `cause_repr` names the underlying failure.
+    Streams that die before the first chunk fail over transparently and
+    never surface this."""
+
+    def __init__(self, message: str, cause_repr: str = ""):
+        self.cause_repr = cause_repr
+        super().__init__(message)

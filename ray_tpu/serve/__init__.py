@@ -10,11 +10,15 @@ from __future__ import annotations
 
 import importlib
 
+from ..exceptions import (DeadlineExceededError, EngineWedgedError,
+                          NoCapacityError, ReplicaDrainingError,
+                          StreamInterruptedError)
 from .api import (run, start, status, delete, shutdown, get_app_handle,
                   get_deployment_handle)
 from .asgi import ingress
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .context import get_request_deadline, remaining_budget
 from .deployment import Application, Deployment, deployment_decorator
 from .handle import (BackPressureError, DeploymentHandle,
                      DeploymentResponse, DeploymentResponseGenerator)
@@ -24,9 +28,9 @@ deployment = deployment_decorator
 
 
 def __getattr__(name):
-    if name == "llm":
-        mod = importlib.import_module(".llm", __name__)
-        globals()["llm"] = mod
+    if name in ("llm", "chaos"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module 'ray_tpu.serve' has no attribute {name!r}")
 
@@ -37,5 +41,8 @@ __all__ = [
     "DeploymentConfig", "HTTPOptions", "Application", "Deployment",
     "deployment", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "BackPressureError",
-    "get_multiplexed_model_id", "multiplexed", "llm",
+    "NoCapacityError", "DeadlineExceededError", "EngineWedgedError",
+    "ReplicaDrainingError", "StreamInterruptedError",
+    "get_request_deadline", "remaining_budget",
+    "get_multiplexed_model_id", "multiplexed", "llm", "chaos",
 ]

@@ -174,7 +174,16 @@ def shutdown():
     except Exception:  # noqa: BLE001
         return
     try:
-        ray_tpu.get(ctrl.graceful_shutdown.remote(), timeout=10)
+        # generous bound: graceful_shutdown itself waits up to the
+        # longest per-deployment graceful_shutdown_timeout_s for
+        # in-flight work to drain (returns immediately when idle)
+        ray_tpu.get(ctrl.graceful_shutdown.remote(), timeout=30)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # kill must run even when the drain wait timed out above — a
+        # surviving named controller with a stopped reconcile loop
+        # would be silently reused by the next serve.run()
         ray_tpu.kill(ctrl)
     except Exception:  # noqa: BLE001
         pass

@@ -66,6 +66,7 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 5.0
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 3
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -77,6 +78,18 @@ class DeploymentConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
+
+
+def default_request_timeout_s() -> float:
+    """Per-request budget when the client supplies no deadline (HTTP
+    X-Serve-Timeout-S header / gRPC deadline). Shared by both ingress
+    proxies; replaces the old hardcoded 60s unary timeout."""
+    import os
+    try:
+        return float(os.environ.get(
+            "RAY_TPU_SERVE_REQUEST_TIMEOUT_S", "60"))
+    except ValueError:
+        return 60.0
 
 
 @dataclass
@@ -97,3 +110,10 @@ class ReplicaInfo:
     actor_handle: Any = None
     state: str = "STARTING"  # STARTING | RUNNING | STOPPING | DEAD
     start_ref: Any = None    # ObjectRef of the readiness probe
+    # active health probing (controller reconcile loop)
+    health_ref: Any = None       # outstanding health_check ObjectRef
+    last_probe_ts: float = 0.0   # when the last probe was dispatched
+    health_failures: int = 0     # consecutive probe failures
+    # graceful drain (rolling update / scale-down / shutdown)
+    draining_since: float = 0.0  # 0 = not draining
+    drain_ref: Any = None        # outstanding ongoing-count ObjectRef

@@ -1,0 +1,39 @@
+"""Per-request serve context (deadline propagation).
+
+The proxy stamps every request with an ABSOLUTE deadline (epoch
+seconds); the handle forwards it as the reserved
+`__serve_deadline_ts` kwarg; the replica pops it and exposes it here
+for the user callable — the LLM server reads it and threads it into
+engine admission, so an expired request is shed instead of executed.
+
+Mirrors multiplex.py's contextvar pattern: sync handlers run in
+executor threads that don't inherit the loop's context, so the replica
+sets the var inside the thread actually running the handler frames.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+_request_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_serve_request_deadline", default=None)
+
+
+def _set_request_deadline(deadline_ts: Optional[float]) -> None:
+    _request_deadline.set(deadline_ts)
+
+
+def get_request_deadline() -> Optional[float]:
+    """Absolute deadline (epoch seconds) of the serve request being
+    handled, or None when the caller set no deadline."""
+    return _request_deadline.get()
+
+
+def remaining_budget() -> Optional[float]:
+    """Seconds until the current request's deadline (clamped at 0), or
+    None when no deadline was propagated."""
+    d = _request_deadline.get()
+    if d is None:
+        return None
+    return max(0.0, d - time.time())

@@ -8,6 +8,7 @@ so control-plane progress never depends on incoming calls.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -16,6 +17,26 @@ from .config import DeploymentConfig, ReplicaInfo
 
 CONTROLLER_NAME = "_SERVE_CONTROLLER"
 _LOOP_PERIOD_S = 0.25
+
+
+def _env_float(name: str, default: float) -> float:
+    """Env knob with a per-deployment-config fallback: the serve FT
+    knobs (RAY_TPU_SERVE_HEALTH_PERIOD_S/_TIMEOUT_S/_THRESHOLD) apply
+    cluster-wide when set; otherwise each deployment's config wins."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _emit_serve_event(etype: str, message: str = "", **attrs) -> None:
+    """Serve-plane lifecycle event; ships via the worker telemetry
+    channel like every other event. Never fails control-plane work."""
+    from ..util import events as events_mod
+    events_mod.emit_safe(etype, message, **attrs)
 
 
 class _DeploymentState:
@@ -40,6 +61,10 @@ class _DeploymentState:
         self._ongoing_history: List[tuple] = []  # (ts, total_ongoing)
         self._last_scale_ts = 0.0
         self._start_failures = 0  # consecutive replica-init failures
+        # replica ids killed for unhealthiness/death whose replacement
+        # hasn't started yet: _start_replica pops one per start and
+        # emits serve.replica.replaced linking old -> new
+        self._pending_replacements: List[str] = []
         self.status = "UPDATING"
         self.message = ""
 
@@ -61,6 +86,9 @@ class ServeController:
     def __init__(self, http_options: Optional[dict] = None):
         self._deployments: Dict[str, _DeploymentState] = {}  # key: app/name
         self._apps: Dict[str, List[str]] = {}  # app -> deployment keys
+        # deployment states removed from _deployments that still have
+        # STOPPING replicas draining; the control loop finishes them
+        self._stopping_states: List[_DeploymentState] = []
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._http_options = http_options or {}
@@ -183,10 +211,40 @@ class ServeController:
                     for st in self._deployments.values()
                     if st.is_ingress}
 
+    def list_replicas(self, app_name: str,
+                      deployment_name: str) -> List[dict]:
+        """Full replica-state snapshot (all states, health counters) —
+        chaos tooling and tests introspect through this."""
+        with self._lock:
+            st = self._deployments.get(f"{app_name}/{deployment_name}")
+            if st is None:
+                return []
+            return [{"replica_id": r.replica_id, "state": r.state,
+                     "version": r.version,
+                     "health_failures": r.health_failures,
+                     "actor_id": getattr(r.actor_handle, "actor_id",
+                                         None)}
+                    for r in st.replicas]
+
     def graceful_shutdown(self) -> None:
         with self._lock:
+            # the drain wait must honor the LONGEST configured
+            # per-deployment graceful_shutdown_timeout_s (snapshot
+            # before delete_application moves states to _stopping)
+            max_drain = max(
+                (st.config.graceful_shutdown_timeout_s
+                 for st in self._deployments.values()), default=0.0)
             for app in list(self._apps):
                 self.delete_application(app)
+        # let the control loop finish draining STOPPING replicas before
+        # tearing the loop down (bounded: drains are themselves bounded
+        # by each deployment's graceful_shutdown_timeout_s)
+        deadline = time.time() + max_drain + 2.0
+        while time.time() < deadline:
+            with self._lock:
+                if not self._stopping_states:
+                    break
+            time.sleep(0.05)
         self._shutdown.set()
 
     def ping(self) -> bool:
@@ -203,6 +261,14 @@ class ServeController:
                     # metric collection blocks on replicas -> outside lock
                     self._collect_autoscale_metrics(ray_tpu, key)
                     self._reconcile(ray_tpu, key)
+                # deployments deleted mid-drain: their STOPPING replicas
+                # still need the drain poll until done/timeout
+                with self._lock:
+                    for st in list(self._stopping_states):
+                        self._check_draining(ray_tpu, st)
+                        if not any(r.state == "STOPPING"
+                                   for r in st.replicas):
+                            self._stopping_states.remove(st)
             except Exception:  # noqa: BLE001  control loop must survive
                 import traceback
                 traceback.print_exc()
@@ -219,6 +285,8 @@ class ServeController:
             if st is None:
                 return
             self._check_started(ray_tpu, st)
+            self._probe_health(ray_tpu, st)
+            self._check_draining(ray_tpu, st)
             self._apply_autoscale_decision(st)
             running = [r for r in st.replicas if r.state == "RUNNING"]
             starting = [r for r in st.replicas if r.state == "STARTING"]
@@ -229,6 +297,12 @@ class ServeController:
                 self._stop_replica(ray_tpu, st, stale[0])
             live = [r for r in st.replicas
                     if r.state in ("RUNNING", "STARTING")]
+            if len(live) >= st.target_num and st._pending_replacements:
+                # no deficit: the unhealthy kill was absorbed (e.g. a
+                # concurrent scale-down) and no replacement will start
+                # — drop the pending link so a LATER unrelated start
+                # (autoscale-up) isn't mislabeled serve.replica.replaced
+                st._pending_replacements.clear()
             if len(live) < st.target_num:
                 if st._start_failures < self._MAX_START_FAILURES:
                     for _ in range(st.target_num - len(live)):
@@ -261,6 +335,14 @@ class ServeController:
                            actor_handle=handle, state="STARTING",
                            start_ref=handle.ready.remote())
         st.replicas.append(info)
+        if st._pending_replacements:
+            old = st._pending_replacements.pop(0)
+            _emit_serve_event(
+                "serve.replica.replaced",
+                f"replacement {rid} started for {old}",
+                actor_id=getattr(handle, "actor_id", None),
+                deployment=st.name, app=st.app_name,
+                replaces=old, replica_id=rid)
 
     def _check_started(self, ray_tpu, st: _DeploymentState) -> None:
         for r in st.replicas:
@@ -279,12 +361,140 @@ class ServeController:
                     st.message = repr(e)
 
     def _stop_replica(self, ray_tpu, st: _DeploymentState,
-                      r: ReplicaInfo) -> None:
+                      r: ReplicaInfo, graceful: bool = True) -> None:
+        """Graceful: flip the replica to STOPPING — it stops admitting
+        (prepare_for_shutdown sets its draining flag; routing drops it
+        because get_replicas only returns RUNNING) and the drain poll
+        kills it once its ongoing count (streams included) hits zero or
+        graceful_shutdown_timeout_s passes. Non-graceful (unhealthy /
+        never-started): immediate kill."""
+        if graceful and r.state == "RUNNING":
+            r.state = "STOPPING"
+            r.draining_since = time.time()
+            try:
+                r.drain_ref = r.actor_handle.prepare_for_shutdown.remote()
+            except Exception:  # noqa: BLE001  already dead
+                self._kill_replica(ray_tpu, r)
+            return
+        self._kill_replica(ray_tpu, r)
+
+    def _kill_replica(self, ray_tpu, r: ReplicaInfo) -> None:
         r.state = "DEAD"
         try:
             ray_tpu.kill(r.actor_handle)
         except Exception:  # noqa: BLE001
             pass
+
+    def _check_draining(self, ray_tpu, st: _DeploymentState) -> None:
+        """Drive STOPPING replicas to DEAD: poll the ongoing-request
+        count (never blocking) and kill at zero or at the graceful
+        timeout. Lock held; wait(timeout=0) only."""
+        now = time.time()
+        for r in st.replicas:
+            if r.state != "STOPPING":
+                continue
+            timed_out = (now - r.draining_since
+                         > st.config.graceful_shutdown_timeout_s)
+            done = False
+            if r.drain_ref is not None:
+                ready, _ = ray_tpu.wait([r.drain_ref], timeout=0)
+                if ready:
+                    ref, r.drain_ref = r.drain_ref, None
+                    try:
+                        done = ray_tpu.get(ref) <= 0
+                    except Exception:  # noqa: BLE001  replica died
+                        done = True
+            elif not timed_out:
+                try:
+                    # prepare_for_shutdown doubles as the drain poll
+                    # (idempotent; counts handlers + undrained streams,
+                    # unlike the autoscaler's get_queue_len)
+                    r.drain_ref = \
+                        r.actor_handle.prepare_for_shutdown.remote()
+                except Exception:  # noqa: BLE001  replica died
+                    done = True
+            if done or timed_out:
+                self._kill_replica(ray_tpu, r)
+                _emit_serve_event(
+                    "serve.replica.drain",
+                    f"drain {'timed out' if timed_out and not done else 'completed'}"
+                    f" after {now - r.draining_since:.2f}s",
+                    actor_id=getattr(r.actor_handle, "actor_id", None),
+                    deployment=st.name, app=st.app_name,
+                    replica_id=r.replica_id,
+                    timed_out=bool(timed_out and not done))
+
+    # ---- active health probes ---------------------------------------------
+    def _probe_health(self, ray_tpu, st: _DeploymentState) -> None:
+        """Periodically probe RUNNING replicas via their health_check
+        actor method; RAY_TPU_SERVE_HEALTH_THRESHOLD consecutive
+        failures (error, wedged cause, timeout, or actor death) mark
+        the replica unhealthy: it is killed and the reconcile pass
+        below starts a replacement. Lock held; never blocks (probe
+        results are collected with wait(timeout=0))."""
+        period = _env_float("RAY_TPU_SERVE_HEALTH_PERIOD_S",
+                            st.config.health_check_period_s)
+        if period <= 0:
+            return
+        timeout = _env_float("RAY_TPU_SERVE_HEALTH_TIMEOUT_S",
+                             st.config.health_check_timeout_s)
+        threshold = max(1, int(_env_float(
+            "RAY_TPU_SERVE_HEALTH_THRESHOLD",
+            st.config.health_check_failure_threshold)))
+        now = time.time()
+        for r in list(st.replicas):
+            if r.state != "RUNNING":
+                continue
+            if r.health_ref is not None:
+                ready, _ = ray_tpu.wait([r.health_ref], timeout=0)
+                if ready:
+                    ref, r.health_ref = r.health_ref, None
+                    try:
+                        ray_tpu.get(ref)
+                        r.health_failures = 0
+                    except Exception as e:  # noqa: BLE001
+                        self._health_failure(ray_tpu, st, r, e, threshold)
+                elif now - r.last_probe_ts > timeout:
+                    r.health_ref = None
+                    self._health_failure(
+                        ray_tpu, st, r,
+                        TimeoutError(f"health probe timed out after "
+                                     f"{timeout}s"), threshold)
+            if (r.state == "RUNNING" and r.health_ref is None
+                    and now - r.last_probe_ts >= period):
+                r.last_probe_ts = now
+                try:
+                    r.health_ref = r.actor_handle.health_check.remote()
+                except Exception as e:  # noqa: BLE001
+                    self._health_failure(ray_tpu, st, r, e, threshold)
+
+    def _health_failure(self, ray_tpu, st: _DeploymentState,
+                        r: ReplicaInfo, exc: BaseException,
+                        threshold: int) -> None:
+        from ..exceptions import ActorDiedError
+        from ..util import events as events_mod
+        r.health_failures += 1
+        events_mod.emit_safe(
+            counter="ray_tpu_serve_health_probe_failures_total",
+            counter_tags={"deployment": st.name})
+        # actor death is unambiguous — no flake to tolerate, escalate
+        # on the first observation instead of waiting out the threshold
+        if (r.health_failures < threshold
+                and not isinstance(exc, ActorDiedError)):
+            return
+        cause = repr(exc)
+        if "EngineWedgedError" in cause:
+            cause = f"wedged: {cause}"
+        _emit_serve_event(
+            "serve.replica.unhealthy",
+            f"{r.replica_id} failed {r.health_failures} consecutive "
+            f"health probes: {cause[:300]}",
+            actor_id=getattr(r.actor_handle, "actor_id", None),
+            deployment=st.name, app=st.app_name,
+            replica_id=r.replica_id, cause=cause[:300],
+            failures=r.health_failures)
+        st._pending_replacements.append(r.replica_id)
+        self._kill_replica(ray_tpu, r)
 
     def _stop_deployment(self, key: str) -> None:
         import ray_tpu
@@ -292,7 +502,10 @@ class ServeController:
         if st is None:
             return
         for r in st.replicas:
-            self._stop_replica(ray_tpu, st, r)
+            self._stop_replica(ray_tpu, st, r,
+                               graceful=r.state == "RUNNING")
+        if any(r.state == "STOPPING" for r in st.replicas):
+            self._stopping_states.append(st)
 
     def _collect_autoscale_metrics(self, ray_tpu, key: str) -> None:
         """Poll replica queue lengths WITHOUT holding the controller lock

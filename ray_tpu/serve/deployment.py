@@ -69,6 +69,7 @@ class Deployment:
                 route_prefix: Optional[str] = "__unset__",
                 health_check_period_s: Optional[float] = None,
                 health_check_timeout_s: Optional[float] = None,
+                health_check_failure_threshold: Optional[int] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
                 ray_actor_options: Optional[dict] = None) -> "Deployment":
         cfg = DeploymentConfig(**self._config.to_dict())
@@ -95,6 +96,9 @@ class Deployment:
             cfg.health_check_period_s = health_check_period_s
         if health_check_timeout_s is not None:
             cfg.health_check_timeout_s = health_check_timeout_s
+        if health_check_failure_threshold is not None:
+            cfg.health_check_failure_threshold = \
+                health_check_failure_threshold
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
@@ -132,6 +136,7 @@ def deployment_decorator(target=None, *, name: Optional[str] = None,
                          autoscaling_config=None, version=None,
                          route_prefix="/", health_check_period_s=None,
                          health_check_timeout_s=None,
+                         health_check_failure_threshold=None,
                          graceful_shutdown_timeout_s=None,
                          ray_actor_options=None, **_compat):
     """@serve.deployment — wraps a class or function into a Deployment."""
@@ -146,6 +151,7 @@ def deployment_decorator(target=None, *, name: Optional[str] = None,
             version=version,
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
+            health_check_failure_threshold=health_check_failure_threshold,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options)
 

@@ -63,7 +63,44 @@ class GrpcProxy:
                 return result.encode()
             return json.dumps(result).encode()
 
+        def _status_for(e):
+            """Typed serve-FT failures map to retriable gRPC codes —
+            one shared classifier with the HTTP ingress, per-protocol
+            code table here."""
+            from ..exceptions import classify_request_failure
+            return {
+                "backpressure": grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "no_capacity": grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "shed": grpc.StatusCode.UNAVAILABLE,        # retriable
+                "interrupted": grpc.StatusCode.UNAVAILABLE,  # retriable
+                "timeout": grpc.StatusCode.DEADLINE_EXCEEDED,
+                "error": grpc.StatusCode.INTERNAL,
+            }[classify_request_failure(e)]
+
+        def _deadline(context):
+            """Absolute deadline from the client's gRPC timeout, else
+            the proxy default (shared with the HTTP ingress)."""
+            import time as _time
+
+            from .config import default_request_timeout_s as \
+                _default_timeout_s
+            budget = context.time_remaining()
+            if budget is None or budget > 86400:
+                # no client deadline: grpc reports None or a huge
+                # sentinel (which would overflow downstream waits).
+                # Only the OPERATOR default may disable the bound.
+                budget = _default_timeout_s()
+                if budget <= 0:
+                    return None
+            elif budget <= 0:
+                # client deadline ALREADY expired at read time: stamp
+                # a now-deadline so the request is shed downstream, not
+                # executed unbounded for a caller that is already gone
+                budget = 1e-4
+            return _time.time() + budget
+
         def predict(request: bytes, context) -> bytes:
+            import time as _time
             handle = _resolve(context)
             try:
                 # ValueError covers JSONDecodeError AND the
@@ -71,10 +108,15 @@ class GrpcProxy:
                 body = _decode(request)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+            deadline_ts = _deadline(context)
             try:
-                return _encode(handle.remote(body).result(timeout_s=60))
+                return _encode(handle.remote(
+                    body, __serve_deadline_ts=deadline_ts).result(
+                    timeout_s=(None if deadline_ts is None
+                               else max(0.1,
+                                        deadline_ts - _time.time()))))
             except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                context.abort(_status_for(e), repr(e))
 
         def predict_stream(request: bytes, context):
             handle = _resolve(context)
@@ -82,12 +124,13 @@ class GrpcProxy:
                 body = _decode(request)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
-            gen = handle.options(stream=True).remote(body)
+            gen = handle.options(stream=True).remote(
+                body, __serve_deadline_ts=_deadline(context))
             try:
                 for chunk in gen:
                     yield _encode(chunk)
             except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                context.abort(_status_for(e), repr(e))
             finally:
                 # client cancellation raises GeneratorExit here (not
                 # Exception): release the stream's replica accounting

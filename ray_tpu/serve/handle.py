@@ -12,9 +12,43 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..exceptions import RayTpuError
+from ..exceptions import (ActorDiedError, ActorUnavailableError,
+                          EngineWedgedError, NoCapacityError, RayTpuError,
+                          ReplicaDrainingError, StreamInterruptedError,
+                          TaskError, error_cause_is)
 
 _REPLICA_REFRESH_S = 1.0
+# a replica that just failed a request is skipped by routing for this
+# long (the controller usually replaces it well within the window)
+_SUSPECT_TTL_S = 10.0
+
+# Replica-side raises cross the actor boundary wrapped in TaskError
+# (repr string, original type lost) — match retriable causes by name.
+_RETRIABLE_CAUSE_NAMES = ("EngineWedgedError", "ReplicaDrainingError",
+                          "ActorDiedError", "ActorUnavailableError")
+
+
+def _retriable_failure(exc: BaseException) -> bool:
+    """True when resubmitting to a DIFFERENT replica can succeed: the
+    serving replica died, its engine wedged, or it started draining."""
+    if isinstance(exc, (ActorDiedError, ActorUnavailableError,
+                        EngineWedgedError, ReplicaDrainingError)):
+        return True
+    return isinstance(exc, TaskError) and error_cause_is(
+        exc, *_RETRIABLE_CAUSE_NAMES)
+
+
+def _note_failover(kind: str, deployment: str, replica_id: str,
+                   exc: BaseException) -> None:
+    """serve.request.failover event + counter; never fails the retry."""
+    from ..util import events as events_mod
+    events_mod.emit_safe("serve.request.failover",
+                         f"resubmitting after {type(exc).__name__} "
+                         f"on {replica_id}",
+                         counter="ray_tpu_serve_failovers_total",
+                         counter_tags={"kind": kind},
+                         deployment=deployment, replica_id=replica_id,
+                         cause=repr(exc)[:200], kind=kind)
 
 
 class BackPressureError(RayTpuError):
@@ -46,24 +80,58 @@ class DeploymentResponse:
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
-        from ..exceptions import ActorDiedError
+        deadline = (None if timeout_s is None
+                    else time.time() + timeout_s)
         try:
             return ray_tpu.get(self._ref, timeout=timeout_s)
-        except ActorDiedError:
-            if self._resubmit is None or self._max_retries <= 0:
+        except Exception as e:  # noqa: BLE001  typed check below
+            if (self._resubmit is None or self._max_retries <= 0
+                    or not _retriable_failure(e)):
                 raise
-            retry = self._resubmit()
+            # retries share the ORIGINAL wait budget — restarting
+            # timeout_s per attempt would stretch the caller's bound
+            # to retries x budget. The deadline also rides into the
+            # resubmit so the retry's replica-pick wait is bounded too.
+            retry = self._resubmit(e, deadline_override=deadline)
             retry._max_retries = self._max_retries - 1
             self._ref = retry._ref
-            return retry.result(timeout_s=timeout_s)
+            return retry.result(timeout_s=(
+                None if deadline is None
+                else max(0.1, deadline - time.time())))
         finally:
             self._settle()
 
+    # Bound on the SYNCHRONOUS replica-pick wait a failover retry may
+    # spend inside __await__: the pick loop's sleeps run on the event
+    # loop thread (this runtime's handle is poll-based), so an open-
+    # ended 30s wait would freeze every other coroutine and defeat
+    # asyncio.wait_for. Requests that carry a propagated deadline are
+    # bounded by it instead.
+    _AWAIT_RETRY_PICK_BUDGET_S = 5.0
+
     def __await__(self):
-        def _done(v):
-            self._settle()
-            return v
-        return (yield from self._ref.__await__())
+        # same failover contract as result(): async callers get the
+        # transparent re-route too
+        while True:
+            try:
+                v = yield from self._ref.__await__()
+                self._settle()
+                return v
+            except Exception as e:  # noqa: PERF203  typed check below
+                if (self._resubmit is None or self._max_retries <= 0
+                        or not _retriable_failure(e)):
+                    self._settle()
+                    raise
+                retry = self._resubmit(
+                    e, deadline_override=(
+                        time.time() + self._AWAIT_RETRY_PICK_BUDGET_S))
+                self._max_retries -= 1
+                self._ref = retry._ref
+                # adopt the retry's resubmit closure (it captured the
+                # NEW replica id) — keeping ours would suspect the
+                # ORIGINAL replica again on a second failover, same as
+                # the stream-adoption fix
+                self._resubmit = retry._resubmit
 
     @property
     def object_ref(self):
@@ -74,36 +142,83 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Streaming response: iterate to pull chunks from the replica."""
+    """Streaming response: iterate to pull chunks from the replica.
 
-    def __init__(self, replica_handle, stream_id_ref, on_done=None):
+    Failover contract: if the serving replica dies/wedges/drains BEFORE
+    this consumer has received any chunk, the stream is transparently
+    resubmitted to a healthy replica (up to `max_retries` times). Once
+    a chunk has been received, resubmission would replay delivered
+    tokens, so the failure surfaces as the typed, retriable
+    StreamInterruptedError instead.
+    """
+
+    def __init__(self, replica_handle, stream_id_ref, on_done=None,
+                 resubmit=None, max_retries=3):
         self._replica = replica_handle
         self._stream_id_ref = stream_id_ref
         self._stream_id = None
         self._buffer: List[Any] = []
         self._finished = False
         self._on_done = on_done
+        self._resubmit = resubmit
+        self._max_retries = max_retries
+        self._got_first = False   # any chunk received from the replica
 
     def __iter__(self):
         return self
 
-    def __next__(self):
+    def _pull(self):
         import ray_tpu
-        if self._buffer:
-            return self._buffer.pop(0)
-        if self._finished:
-            raise StopIteration
         if self._stream_id is None:
             self._stream_id = ray_tpu.get(self._stream_id_ref)
         while not self._buffer:
             chunks, done = ray_tpu.get(
                 self._replica.stream_next.remote(self._stream_id))
             self._buffer.extend(chunks)
+            if chunks:
+                self._got_first = True
             if done:
                 self._finished = True
                 if self._on_done is not None:
                     self._on_done()
                 break
+
+    def __next__(self):
+        if self._buffer:
+            return self._buffer.pop(0)
+        if self._finished:
+            raise StopIteration
+        try:
+            self._pull()
+        except Exception as e:  # noqa: BLE001  typed check below
+            if (self._resubmit is None or self._max_retries <= 0
+                    or not _retriable_failure(e)):
+                raise
+            if self._got_first:
+                # post-first-token: surface a typed retriable error —
+                # the caller decides whether replaying is acceptable
+                self._finished = True
+                if self._on_done is not None:
+                    self._on_done()
+                raise StreamInterruptedError(
+                    f"stream lost its replica after first token: "
+                    f"{e!r}", cause_repr=repr(e)) from e
+            fresh = self._resubmit(e)
+            # release the dead replica's in-flight count, then adopt
+            # the fresh generator's replica/stream/accounting wholesale
+            # — INCLUDING its resubmit closure, which captured the NEW
+            # replica id (keeping ours would suspect the original
+            # replica again on a second failover and leave the one
+            # that just died routable)
+            if self._on_done is not None:
+                self._on_done()
+            self._replica = fresh._replica
+            self._stream_id_ref = fresh._stream_id_ref
+            self._stream_id = None
+            self._on_done = fresh._on_done
+            self._resubmit = fresh._resubmit
+            self._max_retries -= 1
+            return next(self)
         if self._buffer:
             return self._buffer.pop(0)
         raise StopIteration
@@ -153,11 +268,35 @@ class _RouterState:
         self.replicas: List[tuple] = []  # (replica_id, actor_handle)
         self.pending: Dict[str, list] = {}   # replica_id -> [ObjectRef]
         self.manual: Dict[str, int] = {}     # replica_id -> stream count
+        self.suspects: Dict[str, float] = {}  # replica_id -> marked ts
         self.last_refresh = 0.0
         self.lock = threading.Lock()
         self.max_ongoing = 5
         self.max_queued = -1
         self.queued = 0
+
+    def mark_suspect(self, replica_id: str) -> None:
+        """A request just failed on this replica (death/wedge/drain):
+        skip it in routing for _SUSPECT_TTL_S and drop its in-flight
+        accounting so p2c doesn't keep favoring/avoiding a ghost."""
+        with self.lock:
+            self.suspects[replica_id] = time.time()
+            self.pending.pop(replica_id, None)
+            self.manual.pop(replica_id, None)
+
+    def live_candidates(self) -> List[tuple]:
+        """Routing candidates minus recently-failed replicas. Caller
+        must hold lock. When EVERY replica is suspect the result is
+        empty and the pick loop keeps waiting — the controller is
+        usually seconds from delivering a replacement, and routing
+        straight back to the replica that just failed (the old
+        _resubmit bug) only burns the retry budget. Suspicion expires
+        after _SUSPECT_TTL_S in case the controller disagrees."""
+        now = time.time()
+        for rid in [rid for rid, ts in self.suspects.items()
+                    if now - ts > _SUSPECT_TTL_S]:
+            del self.suspects[rid]
+        return [c for c in self.replicas if c[0] not in self.suspects]
 
     def prune(self):
         """Drop refs whose tasks completed. Caller must NOT hold lock."""
@@ -185,29 +324,33 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__", stream: bool = False,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 deadline_s: Optional[float] = None):
         self._deployment = deployment_name
         self._app = app_name
         self._method = method_name
         self._stream = stream
         self._multiplexed_model_id = multiplexed_model_id
+        self._deadline_s = deadline_s
         self._router = _RouterState()
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._deployment, self._app, self._method, self._stream,
-                 self._multiplexed_model_id))
+                 self._multiplexed_model_id, self._deadline_s))
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
+                deadline_s: Optional[float] = None,
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._deployment, self._app,
             method_name if method_name is not None else self._method,
             stream if stream is not None else self._stream,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._multiplexed_model_id)
+            else self._multiplexed_model_id,
+            deadline_s if deadline_s is not None else self._deadline_s)
         h._router = self._router  # share in-flight accounting
         return h
 
@@ -240,16 +383,24 @@ class DeploymentHandle:
                 r.max_ongoing = info["max_ongoing_requests"]
                 r.max_queued = info["max_queued_requests"]
 
-    def _pick_replica(self, deadline_s: float = 30.0):
-        """Power-of-two-choices on pending-request counts; blocks
-        (bounded) when every replica is at max_ongoing_requests."""
+    def _pick_replica(self, deadline_ts: Optional[float] = None):
+        """Power-of-two-choices on pending-request counts over live
+        (non-suspect) replicas; waits with exponential backoff + jitter
+        (not a hot loop) when every replica is at max_ongoing_requests.
+        The wait is bounded by the request's propagated deadline when
+        one is set, else 30s; exhaustion raises the typed
+        NoCapacityError the proxy maps to 503."""
         r = self._router
         start = time.time()
+        budget = (30.0 if deadline_ts is None
+                  else max(0.0, deadline_ts - start))
+        sleep_s = 0.002
         while True:
             self._refresh_replicas(force=not r.replicas)
             r.prune()
             with r.lock:
-                candidates = r.replicas
+                candidates = r.live_candidates()
+                total = len(r.replicas)
                 if candidates:
                     if len(candidates) == 1:
                         chosen = candidates[0]
@@ -258,13 +409,34 @@ class DeploymentHandle:
                         chosen = a if r.load(a[0]) <= r.load(b[0]) else b
                     if r.load(chosen[0]) < r.max_ongoing:
                         return chosen
-            if time.time() - start > deadline_s:
-                raise TimeoutError(
-                    f"no capacity on {self._deployment} after {deadline_s}s")
-            time.sleep(0.02)
+            if time.time() - start > budget:
+                # name the REAL cause: "saturated" vs "all replicas just
+                # failed" point an operator at opposite remediations
+                if total == 0:
+                    why = "no replicas in the routing table"
+                elif not candidates:
+                    why = (f"all {total} replicas recently failed "
+                           "(suspect-listed) and no replacement became "
+                           "available in time")
+                else:
+                    why = (f"every replica at max_ongoing_requests="
+                           f"{r.max_ongoing}")
+                raise NoCapacityError(
+                    f"no capacity on {self._deployment} after "
+                    f"{budget:.1f}s: {why}")
+            # backoff with jitter: spinning at a fixed 20ms hammered the
+            # router lock and the refresh path under saturation
+            time.sleep(sleep_s * (0.5 + random.random()))
+            sleep_s = min(sleep_s * 2, 0.05)
 
     def remote(self, *args, **kwargs):
         r = self._router
+        # absolute deadline: explicit kwarg (proxy-stamped; retries keep
+        # the ORIGINAL deadline) or this handle's relative deadline_s
+        deadline_ts = kwargs.get("__serve_deadline_ts")
+        if deadline_ts is None and self._deadline_s is not None:
+            deadline_ts = time.time() + self._deadline_s
+            kwargs["__serve_deadline_ts"] = deadline_ts
         with r.lock:
             if r.max_queued >= 0 and r.queued >= r.max_queued:
                 raise BackPressureError(
@@ -272,7 +444,7 @@ class DeploymentHandle:
                     f"({r.max_queued}) exceeded")
             r.queued += 1
         try:
-            replica_id, handle = self._pick_replica()
+            replica_id, handle = self._pick_replica(deadline_ts)
         finally:
             with r.lock:
                 r.queued -= 1
@@ -281,24 +453,45 @@ class DeploymentHandle:
         if self._multiplexed_model_id:
             kwargs["__serve_multiplexed_model_id"] = \
                 self._multiplexed_model_id
+
+        def resubmit(exc, kind, a=args, kw=dict(kwargs),
+                     failed=replica_id, deadline_override=None):
+            # the fix for routing straight back to the dead replica:
+            # suspect-list it AND force the routing table to re-resolve
+            # from the controller before the retry picks a target
+            r.mark_suspect(failed)
+            r.last_refresh = 0.0
+            _note_failover(kind, self._deployment, failed, exc)
+            if (deadline_override is not None
+                    and "__serve_deadline_ts" not in kw):
+                # a deadline-less request retried from result(timeout_s=)
+                # inherits the caller's remaining budget, so the retry's
+                # replica-pick wait can't exceed the original bound
+                kw = {**kw, "__serve_deadline_ts": deadline_override}
+            return self.remote(*a, **kw)
+
         if self._stream:
             with r.lock:
                 r.manual[replica_id] = r.manual.get(replica_id, 0) + 1
 
             def done():
                 with r.lock:
-                    r.manual[replica_id] = max(
-                        0, r.manual.get(replica_id, 1) - 1)
+                    # decrement only while the key exists: after
+                    # mark_suspect popped a dead replica's count, a
+                    # late done() must not resurrect a ghost entry
+                    if replica_id in r.manual:
+                        r.manual[replica_id] = max(
+                            0, r.manual[replica_id] - 1)
             sid_ref = handle.stream_start.remote(self._method, args, kwargs)
-            return DeploymentResponseGenerator(handle, sid_ref, on_done=done)
+            return DeploymentResponseGenerator(
+                handle, sid_ref, on_done=done,
+                resubmit=lambda exc: resubmit(exc, "stream"))
         ref = handle.handle_request.remote(self._method, args, kwargs)
         with r.lock:
             r.pending.setdefault(replica_id, []).append(ref)
-
-        def resubmit(a=args, kw=dict(kwargs)):
-            r.last_refresh = 0.0  # force a routing-table refresh
-            return self.remote(*a, **kw)
-        return DeploymentResponse(ref, resubmit=resubmit)
+        return DeploymentResponse(
+            ref, resubmit=lambda exc, deadline_override=None: resubmit(
+                exc, "unary", deadline_override=deadline_override))
 
 
 class _BoundMethod:

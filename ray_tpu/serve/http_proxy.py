@@ -10,13 +10,32 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..exceptions import classify_request_failure
 from .asgi import START_KEY
+from .config import default_request_timeout_s as _default_timeout_s
 from .handle import DeploymentHandle
 
 PROXY_NAME = "_SERVE_PROXY"
+
+# symbolic failure class (exceptions.classify_request_failure — shared
+# with the gRPC ingress) -> (http_status, retry_after_s | None).
+# Shed/no-capacity outcomes are RETRIABLE: 429/503 with Retry-After so
+# well-behaved clients back off and resubmit; a deadline that expired
+# mid-execution is the client's budget running out: 504.
+_STATUS_BY_CLASS = {"backpressure": (429, 1),
+                    "no_capacity": (503, 1),
+                    "shed": (503, 1),         # never executed
+                    "interrupted": (503, 1),  # retriable mid-stream loss
+                    "timeout": (504, None),   # executed, budget blown
+                    "error": (500, None)}
+
+
+def _status_for(exc: BaseException):
+    return _STATUS_BY_CLASS[classify_request_failure(exc)]
 
 
 class HTTPProxy:
@@ -59,13 +78,56 @@ class HTTPProxy:
                     return json.loads(raw)
                 return raw.decode() if raw else None
 
-            def _respond(self, code, body, ctype="application/json"):
+            def _respond(self, code, body, ctype="application/json",
+                         retry_after=None):
                 data = body if isinstance(body, bytes) else body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _deadline(self):
+                """Absolute deadline for this request: client-supplied
+                X-Serve-Timeout-S budget, else the proxy default. It
+                propagates proxy -> handle -> replica -> engine
+                admission; retries keep the ORIGINAL deadline. Only
+                the OPERATOR env knob may disable the bound (<= 0 →
+                no deadline); a non-positive client header falls back
+                to the default — an untrusted header must not be able
+                to pin proxy threads forever."""
+                raw = self.headers.get("X-Serve-Timeout-S")
+                budget = None
+                if raw:
+                    try:
+                        # cap: an untrusted header may shrink the bound
+                        # but never extend it past an hour
+                        budget = min(float(raw), 3600.0)
+                    except ValueError:
+                        budget = None
+                if budget is None or budget <= 0:
+                    budget = _default_timeout_s()
+                return None if budget <= 0 else time.time() + budget
+
+            def _fail(self, e, headers_sent=False, emit=None):
+                """Map a request failure to a response (pre-headers) or
+                a terminal SSE error event (mid-stream)."""
+                code, retry_after = _status_for(e)
+                try:
+                    if headers_sent:
+                        if emit is not None:
+                            # mid-stream failure: a second status line
+                            # would corrupt the chunked body — emit one
+                            # final error event and end the stream
+                            emit(json.dumps({"error": repr(e)}).encode())
+                            self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        self._respond(code, json.dumps(
+                            {"error": repr(e)}), retry_after=retry_after)
+                except Exception:  # noqa: BLE001  client went away
+                    pass
 
             def _serialize(self, result):
                 if isinstance(result, bytes):
@@ -96,7 +158,8 @@ class HTTPProxy:
                 bodiless = False   # 1xx/204/304: no body, no chunking
                 gen = None
                 try:
-                    gen = handle.options(stream=True).remote(request)
+                    gen = handle.options(stream=True).remote(
+                        request, __serve_deadline_ts=self._deadline())
                     for item in gen:
                         if isinstance(item, dict) and item.get(START_KEY):
                             status = item["status"]
@@ -134,8 +197,10 @@ class HTTPProxy:
                             # body indistinguishable from success
                             self.close_connection = True
                         else:
-                            self._respond(500, json.dumps(
-                                {"error": repr(e)}))
+                            code, retry_after = _status_for(e)
+                            self._respond(code, json.dumps(
+                                {"error": repr(e)}),
+                                retry_after=retry_after)
                     except Exception:  # noqa: BLE001  client went away
                         pass
                 finally:
@@ -162,11 +227,14 @@ class HTTPProxy:
                     self.headers.get("Accept") or "")
                     or (isinstance(body, dict) and bool(
                         body.get("stream"))))
+                deadline_ts = self._deadline()
                 headers_sent = False
                 gen = None
+                emit = None
                 try:
                     if wants_stream:
-                        gen = handle.options(stream=True).remote(body)
+                        gen = handle.options(stream=True).remote(
+                            body, __serve_deadline_ts=deadline_ts)
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "text/event-stream")
@@ -189,23 +257,15 @@ class HTTPProxy:
                             emit(payload)
                         self.wfile.write(b"0\r\n\r\n")
                     else:
-                        result = handle.remote(body).result(timeout_s=60)
+                        result = handle.remote(
+                            body, __serve_deadline_ts=deadline_ts
+                        ).result(timeout_s=(
+                            None if deadline_ts is None
+                            else max(0.1, deadline_ts - time.time())))
                         payload, ctype = self._serialize(result)
                         self._respond(200, payload, ctype)
                 except Exception as e:  # noqa: BLE001
-                    try:
-                        if headers_sent:
-                            # mid-stream failure: a second status line
-                            # would corrupt the chunked body — emit one
-                            # final error event and terminate the stream
-                            emit(json.dumps(
-                                {"error": repr(e)}).encode())
-                            self.wfile.write(b"0\r\n\r\n")
-                        else:
-                            self._respond(500,
-                                          json.dumps({"error": repr(e)}))
-                    except Exception:  # noqa: BLE001  client went away
-                        pass
+                    self._fail(e, headers_sent=headers_sent, emit=emit)
                 finally:
                     if gen is not None:
                         # abandoned stream (client hung up): release
@@ -215,7 +275,14 @@ class HTTPProxy:
 
             do_GET = do_POST = do_PUT = do_DELETE = _handle
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog of 5 RSTs excess
+            # connections under a concurrent burst (observed: 24
+            # simultaneous clients losing 4 to ECONNRESET) — a serve
+            # ingress must absorb bursts, not reset them
+            request_queue_size = 128
+
+        self._server = Server((host, port), Handler)
         self._port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="serve-http").start()

@@ -84,6 +84,7 @@ class LLMServer:
         """Unary or streaming generate. body: {"prompt": [ids] | str,
         "max_tokens": int, "temperature": float, "top_p": float,
         "stop_token_ids": [ids], "stream": bool}."""
+        from ..context import get_request_deadline
         prompt, prefix_id = self._match_prefix(
             self._encode(body["prompt"]))
         max_tokens = body.get("max_tokens")
@@ -92,7 +93,8 @@ class LLMServer:
             prompt, max_tokens, temperature,
             top_p=float(body.get("top_p", 1.0)),
             stop_token_ids=body.get("stop_token_ids"),
-            prefix_id=prefix_id)
+            prefix_id=prefix_id,
+            deadline_ts=get_request_deadline())
         if body.get("stream"):
             def gen():
                 for tok in self.engine.stream(rid):
@@ -112,6 +114,11 @@ class LLMServer:
     def check_health(self):
         if not self.engine._loop_thread.is_alive():
             raise RuntimeError("engine loop died")
+        if self.engine.wedged:
+            from ...exceptions import EngineWedgedError
+            raise EngineWedgedError(
+                "wedged: engine loop made no forward progress past "
+                "its watchdog window; replica must be replaced")
 
 
 def build_llm_deployment(model_factory, *, engine_config=None,

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import queue as queue_mod
 import threading
 import time
@@ -115,6 +116,14 @@ class LLMEngineConfig:
     # proposal lookback window (tokens of trailing history searched per
     # step, vLLM prompt-lookup style) — bounds host work per step
     ngram_lookback: int = 256
+    # Wedged-engine watchdog: if the generation loop makes no forward
+    # progress (no admit, no dispatch, no token drained) for this long
+    # WHILE requests are admitted/waiting, the engine is declared
+    # wedged — in-flight requests abort with EngineWedgedError (so the
+    # serve handle can fail over) and health checks fail with a
+    # `wedged` cause until the replica is replaced. None reads
+    # RAY_TPU_ENGINE_WATCHDOG_S (default 30); <= 0 disables.
+    watchdog_s: Optional[float] = None
 
 
 @dataclass
@@ -151,6 +160,10 @@ class _Request:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     logit_bias: Optional[dict] = None
+    # absolute deadline propagated from the serve plane; a request
+    # whose deadline expires while still QUEUED is shed at admission
+    # (DeadlineExceededError) instead of executed
+    deadline_ts: Optional[float] = None
 
 
 _END = ("__end__", None)
@@ -187,6 +200,28 @@ def _engine_metrics():
         }
     return _metrics_singletons
 
+
+
+def _put_dropping_one(q: "queue_mod.Queue", item) -> None:
+    """Publish a control item (_END / wedged error) to a possibly-full
+    out_queue without ever blocking the engine loop: on Full, drop one
+    buffered token to make room. Single producer (the loop), so the
+    retry cannot race another put; a second Full means the consumer
+    raced a get between our get and put — then the queue has room on
+    the next consumer cycle anyway and the item is dropped."""
+    try:
+        q.put_nowait(item)
+        return
+    except queue_mod.Full:
+        pass
+    try:
+        q.get_nowait()
+    except queue_mod.Empty:
+        pass
+    try:
+        q.put_nowait(item)
+    except queue_mod.Full:
+        pass
 
 
 def _next_pow2(n: int) -> int:
@@ -394,9 +429,35 @@ class LLMEngine:
         # public-API mutation would race a stale buffer. Commands queue
         # here and the loop executes them between steps.
         self._control_q: "queue_mod.Queue" = queue_mod.Queue()
+        # wedged-engine watchdog: _progress_ts advances on every admit /
+        # token emit / idle tick; a separate thread observes staleness
+        # (the loop thread itself may be stuck inside a device call, so
+        # it cannot self-report)
+        if cfg.watchdog_s is not None:
+            self._watchdog_s = float(cfg.watchdog_s)
+        else:
+            try:
+                self._watchdog_s = float(os.environ.get(
+                    "RAY_TPU_ENGINE_WATCHDOG_S", "30"))
+            except ValueError:
+                self._watchdog_s = 30.0
+        self._progress_ts = time.time()
+        self._wedged_since: Optional[float] = None
+        # True while the loop thread is inside the admit/dispatch/drain
+        # work section: a stall there can be a legitimate first-use jit
+        # COMPILE (seconds..minutes for big models), so the watchdog
+        # grants it _DISPATCH_GRACE x the budget. Host-side stalls —
+        # a stuck control command, a lock deadlock, the loop wedged
+        # between iterations — get the tight watchdog_s budget.
+        self._in_dispatch = False
         self._loop_thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
         self._loop_thread.start()
+        if self._watchdog_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="llm-engine-watchdog")
+            self._watchdog_thread.start()
         if cfg.precompile:
             self.precompile()
 
@@ -999,11 +1060,36 @@ class LLMEngine:
                guided_fsm=None,
                presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
-               logit_bias: Optional[dict] = None) -> str:
+               logit_bias: Optional[dict] = None,
+               deadline_ts: Optional[float] = None) -> str:
         """guided_fsm: a serve.llm.guided.TokenFSM constraining this
         request's output (per-step vocab masks; EOS only at accepting
         states). Guided traffic decodes synchronously (pipeline drains
-        each step) so the mask can depend on the previous token."""
+        each step) so the mask can depend on the previous token.
+
+        deadline_ts: absolute deadline (epoch seconds, propagated from
+        the serve plane). A deadline that already cannot be met is
+        rejected HERE — before any queueing — and one that expires
+        while queued is shed at admission, both with
+        DeadlineExceededError."""
+        from ...exceptions import DeadlineExceededError  # noqa: PLC0415
+        if self.wedged:
+            from ...exceptions import EngineWedgedError  # noqa: PLC0415
+            raise EngineWedgedError(
+                "engine is wedged; replica awaiting replacement")
+        if deadline_ts is not None and time.time() >= deadline_ts:
+            # same shed-telemetry contract as the queued-expiry path:
+            # every shed is visible, whichever gate catches it
+            self._event("serve.request.shed", reason="deadline_expired",
+                        stage="submit",
+                        late_s=round(time.time() - deadline_ts, 3))
+            from ...util import events as events_mod  # noqa: PLC0415
+            events_mod.emit_safe(
+                counter="ray_tpu_serve_requests_shed_total",
+                counter_tags={"reason": "deadline_expired"})
+            raise DeadlineExceededError(
+                "deadline already expired at submit; request rejected "
+                "at admission")
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1065,6 +1151,7 @@ class LLMEngine:
                        frequency_penalty=float(frequency_penalty),
                        logit_bias=dict(logit_bias) if logit_bias
                        else None,
+                       deadline_ts=deadline_ts,
                        hist=(list(map(int, prompt))
                              if (self.cfg.ngram_speculation > 0
                                  and temperature == 0.0
@@ -1243,6 +1330,85 @@ class LLMEngine:
     def shutdown(self):
         self._shutdown.set()
 
+    # ---- wedged-engine watchdog -------------------------------------------
+    @property
+    def wedged(self) -> bool:
+        """True once the watchdog declared this engine wedged (sticky:
+        the replica is about to fail health checks and be replaced —
+        un-wedging a half-dead engine under traffic is not a state we
+        try to recover)."""
+        return self._wedged_since is not None
+
+    def _note_progress(self) -> None:
+        self._progress_ts = time.time()
+
+    def _has_work(self) -> bool:
+        return bool(self._active or self._prefilling
+                    or not self._waiting.empty())
+
+    # In-dispatch stall budget multiplier: a first-use jit compile is a
+    # legitimate multi-second (big models: multi-minute — use
+    # precompile=True) stall inside a dispatch, indistinguishable
+    # in-flight from a hung device call. Give dispatches grace x the
+    # budget so compiles pass and true device hangs are still caught.
+    _DISPATCH_GRACE = 10.0
+
+    # A consumer whose out_queue stays full this long without draining
+    # a single token is treated as gone and its request aborted (see
+    # _emit's bounded put) — the bound that keeps per-request
+    # backpressure from parking the shared loop indefinitely.
+    _CONSUMER_STALL_TTL_S = 60.0
+
+    def _watchdog_loop(self) -> None:
+        period = max(0.05, min(1.0, self._watchdog_s / 4.0))
+        while not self._shutdown.is_set():
+            self._shutdown.wait(period)
+            if self._wedged_since is not None:
+                continue
+            if not self._has_work():
+                # idle is not wedged; keep the clock fresh so the first
+                # request after a quiet hour isn't instantly blamed
+                self._note_progress()
+                continue
+            budget = self._watchdog_s * (
+                self._DISPATCH_GRACE if self._in_dispatch else 1.0)
+            stall = time.time() - self._progress_ts
+            if stall <= budget:
+                continue
+            self._declare_wedged(stall)
+
+    def _declare_wedged(self, stall_s: float) -> None:
+        from ...exceptions import EngineWedgedError  # noqa: PLC0415
+        self._wedged_since = time.time()
+        self._event("llm_engine.wedged",
+                    f"no forward progress for {stall_s:.1f}s "
+                    f"(watchdog_s={self._watchdog_s}); aborting "
+                    f"in-flight requests", stall_s=round(stall_s, 2),
+                    active=len(self._active),
+                    waiting=self._waiting.qsize())
+        err = EngineWedgedError(
+            f"engine wedged: no forward progress for {stall_s:.1f}s "
+            f"(> RAY_TPU_ENGINE_WATCHDOG_S={self._watchdog_s}); "
+            "request aborted for failover")
+        # deliberately lock-free: if the loop wedged while HOLDING the
+        # engine lock, taking it here would hang the watchdog too; a
+        # snapshot of the dict values is safe to iterate in CPython
+        reqs = list(self._requests.values())
+        for req in reqs:
+            req.aborted = True
+            # error (not _END) so consumers raise and the serve handle
+            # fails the stream over to a healthy replica; bounded put —
+            # a full queue (slow consumer) must not swallow the error
+            _put_dropping_one(req.out_queue, ("error", err))
+
+    def _chaos_stall(self, seconds: float) -> None:
+        """Deterministic wedge injection (serve/chaos.py, tests): park
+        the engine loop thread via the control queue — the real
+        watchdog path then observes the stall exactly as it would a
+        hung device call. Returns immediately."""
+        from concurrent.futures import Future  # noqa: PLC0415
+        self._control_q.put((lambda: time.sleep(seconds), Future()))
+
     # ---- engine loop ------------------------------------------------------
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -1372,6 +1538,13 @@ class LLMEngine:
                 # the consumer; never take a slot or prefill
                 self._requests.pop(req.request_id, None)
                 continue
+            if (req.deadline_ts is not None
+                    and time.time() >= req.deadline_ts):
+                # deadline expired while queued: shed instead of
+                # spending prefill+decode on an answer nobody waits for
+                self._shed_expired(req)
+                continue
+            self._progress_ts = time.time()   # watchdog: admission
             if self._paged:
                 outcome = self._admit_paged(req)
                 if outcome == "nopages":
@@ -1608,6 +1781,7 @@ class LLMEngine:
         if self._paged:
             self._disp_len[req.slot] = req.prefill_pos
         req.prefill_dispatch_ms += (time.time() - t_dispatch) * 1000
+        self._progress_ts = time.time()   # watchdog: chunk advanced
         if is_last:
             self._prefilling.popleft()
             self.stats["prefills"] += 1
@@ -1633,6 +1807,7 @@ class LLMEngine:
               logp: Optional[float] = None):
         req.generated += 1
         self.stats["tokens_generated"] += 1
+        self._progress_ts = time.time()   # watchdog: forward progress
         m = self._m
         m["tokens"].inc(1.0, tags=self._mtags)
         if req.first_token_ts is None:
@@ -1648,7 +1823,34 @@ class LLMEngine:
             m["ttft"].observe(now - req.submit_ts, tags=self._mtags)
         if req.hist is not None:
             req.hist.append(tok)
-        req.out_queue.put(("token", (tok, logp)))
+        # Bounded-wait put: a FULL out_queue means the CONSUMER is slow
+        # or gone, not that the engine is wedged — refresh the watchdog
+        # clock while parked so per-request backpressure can't get the
+        # whole replica declared wedged and replaced. The park itself
+        # is bounded: a consumer silent past _CONSUMER_STALL_TTL_S
+        # (abandoned generator, crashed client that never cancelled)
+        # gets its request aborted so one dead reader can't stall the
+        # shared loop forever while keeping the watchdog green.
+        parked_since = None
+        while True:
+            try:
+                req.out_queue.put(("token", (tok, logp)), timeout=1.0)
+                break
+            except queue_mod.Full:
+                if req.aborted:
+                    break
+                now = time.time()
+                if parked_since is None:
+                    parked_since = now
+                elif now - parked_since > self._CONSUMER_STALL_TTL_S:
+                    req.aborted = True
+                    req.max_new_tokens = min(req.max_new_tokens,
+                                             req.generated)
+                    self._event("llm_engine.request_abort", req=req,
+                                generated=req.generated,
+                                reason="consumer_stalled")
+                    break
+                self._progress_ts = now
         if ((self.cfg.eos_token_id is not None
              and tok == self.cfg.eos_token_id)
                 or tok in req.stop_ids):
@@ -1700,6 +1902,23 @@ class LLMEngine:
         self._free_pages.extend(pages[n_shared:])
         self._set_page_row(slot, [])
 
+    def _shed_expired(self, req: _Request) -> None:
+        """Queued request whose propagated deadline passed: error the
+        consumer (typed, retriable upstream decision) without ever
+        taking a slot. Load shedding, not failure containment."""
+        from ...exceptions import DeadlineExceededError  # noqa: PLC0415
+        self._requests.pop(req.request_id, None)
+        self._event("serve.request.shed", req=req,
+                    reason="deadline_expired",
+                    late_s=round(time.time() - req.deadline_ts, 3))
+        from ...util import events as events_mod  # noqa: PLC0415
+        events_mod.emit_safe(
+            counter="ray_tpu_serve_requests_shed_total",
+            counter_tags={"reason": "deadline_expired"})
+        req.out_queue.put(("error", DeadlineExceededError(
+            f"deadline expired {time.time() - req.deadline_ts:.3f}s "
+            f"before engine admission of {req.request_id}")))
+
     def _release(self, req: _Request):
         # Slot bookkeeping FIRST, end marker LAST: putting _END wakes the
         # consumer thread, and _set_page_row's jax dispatch below drops
@@ -1727,7 +1946,10 @@ class LLMEngine:
         finally:
             self._event("llm_engine.request_finish", req=req,
                         generated=req.generated, aborted=req.aborted)
-            req.out_queue.put(_END)
+            # bounded end-marker publish: a full queue (stalled/gone
+            # consumer, e.g. the _CONSUMER_STALL_TTL_S abort path)
+            # must not park the loop on a blocking put
+            _put_dropping_one(req.out_queue, _END)
 
     def _decode_window_pages(self) -> int:
         """Power-of-2 page window covering every slot that holds KV
@@ -2050,11 +2272,20 @@ class LLMEngine:
                         fn, done = self._control_q.get_nowait()
                     except queue_mod.Empty:
                         break
+                    # commands are engine work too: a first-use prefix
+                    # prefill can jit-compile for >watchdog_s, so they
+                    # get the same compile grace as dispatches (a truly
+                    # stuck command still wedges after grace x budget —
+                    # the chaos stall exercises exactly that)
+                    self._in_dispatch = True
                     try:
                         fn()
                         done.set_result(None)
                     except BaseException as e:  # noqa: BLE001
                         done.set_exception(e)
+                    finally:
+                        self._in_dispatch = False
+                self._in_dispatch = True   # watchdog: compile grace on
                 self._admit_all(inflight)
                 if self._prefilling:
                     self._dispatch_chunk(inflight)
@@ -2184,6 +2415,7 @@ class LLMEngine:
                         (self._n_pages - len(self._free_pages))
                         / max(1, self._n_pages), tags=self._mtags)
                 if not inflight:
+                    self._in_dispatch = False
                     time.sleep(0.002)
                     continue
                 # stay `pipeline_depth` steps ahead while decoding;
@@ -2194,9 +2426,11 @@ class LLMEngine:
                     #             the previous step's tokens on host
                 while len(inflight) > target:
                     self._drain_one(inflight)
+                self._in_dispatch = False
             except BaseException as e:  # noqa: BLE001  loop must survive
                 import traceback
                 traceback.print_exc()
+                self._in_dispatch = False
                 for req in list(self._active.values()):
                     req.out_queue.put(("error", e))
                     self._release(req)

@@ -254,11 +254,13 @@ class OpenAIServer(LLMServer):
         request ids before re-raising — mirroring the _collect cleanup,
         so failed multi-choice calls never strand siblings on the
         engine."""
+        from ..context import get_request_deadline
         rids: List[str] = []
         try:
             for _ in range(n):
                 rids.append(self.engine.submit(
-                    suffix, prefix_id=prefix_id, **sp))
+                    suffix, prefix_id=prefix_id,
+                    deadline_ts=get_request_deadline(), **sp))
         except BaseException:
             for r in rids:
                 try:

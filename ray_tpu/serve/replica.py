@@ -13,7 +13,10 @@ import inspect
 import itertools
 import queue as queue_mod
 import threading
+import time
 from typing import Any, Dict, Optional
+
+from ..exceptions import DeadlineExceededError, ReplicaDrainingError
 
 _STREAM_END = "__ray_tpu_stream_end__"
 
@@ -44,6 +47,11 @@ class Replica:
         self._ongoing = 0
         self._total_served = 0
         self._lock = threading.Lock()
+        self._draining = False
+        # chaos-injection state (serve/chaos.py): deterministic fault
+        # modes for the fault-tolerance tests; all default off
+        self._chaos_delay_s = 0.0
+        self._chaos_health_mode = ""   # "" | "fail" | "hang" | "wedged"
         self._streams: Dict[str, queue_mod.Queue] = {}
         self._stream_counter = itertools.count()
         # stream ids whose consumer hung up: _drain stops pumping (and
@@ -73,6 +81,13 @@ class Replica:
         return self._replica_id
 
     def health_check(self) -> bool:
+        if self._chaos_health_mode == "hang":
+            time.sleep(3600)           # probe times out controller-side
+        if self._chaos_health_mode == "fail":
+            raise RuntimeError("chaos: health check failing")
+        if self._chaos_health_mode == "wedged":
+            from ..exceptions import EngineWedgedError
+            raise EngineWedgedError("chaos: wedged")
         user_check = getattr(self._callable, "check_health", None)
         if user_check is not None:
             user_check()
@@ -84,9 +99,71 @@ class Replica:
             fn(user_config)
 
     def prepare_for_shutdown(self) -> int:
-        """Graceful drain: report ongoing count so controller can wait."""
+        """Graceful drain: stop admitting new requests (they raise the
+        retriable ReplicaDrainingError and fail over) and report the
+        in-flight count so the controller can wait for it to hit zero.
+        Counts BOTH running handlers (_ongoing) and streams whose
+        consumer is still pulling buffered chunks (_streams keeps the
+        id until the consumer reads the end marker or cancels) —
+        _ongoing alone drops when the PRODUCER finishes, which would
+        let the controller kill us mid-consumer-read. Idempotent; the
+        controller re-calls it as its drain poll."""
         with self._lock:
-            return self._ongoing
+            self._draining = True
+            return self._ongoing + len(self._streams)
+
+    def chaos(self, mode: str, seconds: float = 0.0) -> bool:
+        """Deterministic fault injection (serve/chaos.py; tests only).
+        Modes: "delay" (every request sleeps `seconds` first),
+        "health_fail" / "health_hang" / "health_wedged" (health probe
+        fails / blocks / raises EngineWedgedError), "wedge" (stall the
+        hosted LLM engine's loop for `seconds` — real watchdog path),
+        "die" (hard-exit the replica process), "reset" (clear all)."""
+        if mode == "delay":
+            self._chaos_delay_s = float(seconds)
+        elif mode in ("health_fail", "health_hang", "health_wedged"):
+            self._chaos_health_mode = mode.split("_", 1)[1]
+        elif mode == "wedge":
+            engine = getattr(self._callable, "engine", None)
+            if engine is None:
+                raise ValueError("replica hosts no LLM engine to wedge")
+            engine._chaos_stall(float(seconds))
+        elif mode == "die":
+            import os
+            os._exit(1)
+        elif mode == "reset":
+            self._chaos_delay_s = 0.0
+            self._chaos_health_mode = ""
+        else:
+            raise ValueError(f"unknown chaos mode {mode!r}")
+        return True
+
+    def _admit(self, kwargs) -> Optional[float]:
+        """Shared admission gate for unary + stream paths: reject while
+        draining (retriable — the handle fails over), shed requests
+        whose propagated deadline already expired, and apply the chaos
+        delay. Returns the request's absolute deadline (or None)."""
+        deadline_ts = kwargs.pop("__serve_deadline_ts", None)
+        if self._draining:
+            raise ReplicaDrainingError(
+                f"replica {self._replica_id} is draining")
+        if deadline_ts is not None and time.time() >= deadline_ts:
+            self._shed("deadline_expired")
+            raise DeadlineExceededError(
+                f"deadline expired {time.time() - deadline_ts:.3f}s "
+                f"before admission on {self._replica_id}")
+        if self._chaos_delay_s > 0:
+            time.sleep(self._chaos_delay_s)
+        return deadline_ts
+
+    def _shed(self, reason: str) -> None:
+        from ..util import events as events_mod
+        events_mod.emit_safe("serve.request.shed",
+                             counter="ray_tpu_serve_requests_shed_total",
+                             counter_tags={"reason": reason},
+                             replica_id=self._replica_id,
+                             deployment=self._deployment_name,
+                             reason=reason)
 
     def shutdown_user_callable(self) -> None:
         fn = getattr(self._callable, "__del__", None)
@@ -117,15 +194,18 @@ class Replica:
         """Unary request. Runs user coroutines on the worker loop; sync
         handlers run in the default executor so they don't block the loop
         (and so max_ongoing_requests > 1 gives real concurrency)."""
+        deadline_ts = self._admit(kwargs)
         with self._lock:
             self._ongoing += 1
         try:
             mux_id = kwargs.pop("__serve_multiplexed_model_id", "")
+            from .context import _set_request_deadline
             from .multiplex import _set_multiplexed_model_id
             method = self._resolve_method(method_name)
             if inspect.iscoroutinefunction(method):
                 if mux_id:
                     _set_multiplexed_model_id(mux_id)
+                _set_request_deadline(deadline_ts)
                 result = await method(*args, **kwargs)
             else:
                 def _call_sync():
@@ -133,6 +213,7 @@ class Replica:
                     # run_in_executor does not propagate context.
                     if mux_id:
                         _set_multiplexed_model_id(mux_id)
+                    _set_request_deadline(deadline_ts)
                     return method(*args, **kwargs)
                 loop = asyncio.get_running_loop()
                 result = await loop.run_in_executor(None, _call_sync)
@@ -153,15 +234,18 @@ class Replica:
         """Start a streaming call; returns a stream id to poll with
         stream_next(). The generator is drained on a background task and
         chunks buffered, so slow consumers don't stall the handler."""
+        deadline_ts = self._admit(kwargs)
         stream_id = f"{self._replica_id}-s{next(self._stream_counter)}"
         q: queue_mod.Queue = queue_mod.Queue(maxsize=1024)
         self._streams[stream_id] = q
         with self._lock:
             self._ongoing += 1
         mux_id = kwargs.pop("__serve_multiplexed_model_id", "")
+        from .context import _set_request_deadline
         from .multiplex import _set_multiplexed_model_id
         if mux_id:
             _set_multiplexed_model_id(mux_id)
+        _set_request_deadline(deadline_ts)
         method = self._resolve_method(method_name)
 
         async def _put(item):
@@ -182,6 +266,7 @@ class Replica:
             # var set in the thread actually running its frames.
             if mux_id:
                 _set_multiplexed_model_id(mux_id)
+            _set_request_deadline(deadline_ts)
             return next(it, _STREAM_END)
 
         async def _drain():

@@ -123,6 +123,24 @@ def emit(event_type: str, message: str = "",
     _buffer.emit(event_type, message, severity=severity, **fields)
 
 
+def emit_safe(event_type: Optional[str] = None, message: str = "",
+              counter: Optional[str] = None,
+              counter_tags: Optional[Dict[str, str]] = None,
+              **fields: Any) -> None:
+    """Never-fail telemetry: emit an event and/or bump a cataloged
+    counter, swallowing every exception — instrumentation must not
+    fail the work it observes. One shared helper so the serve plane's
+    event+counter sites don't each re-copy the try/except pattern."""
+    try:
+        if event_type is not None:
+            emit(event_type, message, **fields)
+        if counter is not None:
+            from . import metrics_catalog as mcat  # noqa: PLC0415
+            mcat.get(counter).inc(1.0, tags=counter_tags or {})
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def drain() -> List[Dict[str, Any]]:
     return _buffer.drain()
 

@@ -21,7 +21,9 @@ _SPEC = Tuple[str, str]
 
 SEVERITIES = ("info", "warning", "error")
 
-NAME_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+# <subsystem>.<event> or <subsystem>.<object>.<event> (the serve plane
+# namespaces per object: serve.replica.*, serve.request.*)
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,2}$")
 
 BUILTIN: Dict[str, _SPEC] = {
     # ---- task lifecycle (driver dispatcher) ----
@@ -110,6 +112,30 @@ BUILTIN: Dict[str, _SPEC] = {
         "info", "request released its slot (finished or errored)"),
     "llm_engine.request_abort": (
         "warning", "request aborted by the client"),
+    "llm_engine.wedged": (
+        "error", "generation loop made no forward progress past "
+        "RAY_TPU_ENGINE_WATCHDOG_S with requests admitted; in-flight "
+        "requests aborted with EngineWedgedError and the replica's "
+        "health check fails with a `wedged` cause"),
+    # ---- serve fault-tolerance plane ----
+    "serve.replica.unhealthy": (
+        "error", "replica failed RAY_TPU_SERVE_HEALTH_THRESHOLD "
+        "consecutive controller health probes (message holds the "
+        "cause, e.g. wedged / timeout / ActorDiedError); it is killed "
+        "and replaced"),
+    "serve.replica.replaced": (
+        "warning", "controller started a replacement replica for one "
+        "that died or went unhealthy (attrs link old -> new ids)"),
+    "serve.replica.drain": (
+        "info", "replica finished (or timed out) its graceful drain on "
+        "rolling update / scale-down / shutdown and was stopped"),
+    "serve.request.failover": (
+        "warning", "a request was resubmitted to a different replica "
+        "after its serving replica died, wedged, or started draining"),
+    "serve.request.shed": (
+        "warning", "a request was shed instead of executed (propagated "
+        "deadline expired before admission, or the replica is "
+        "draining); the proxy surfaces 503 + Retry-After"),
     # ---- event plane itself ----
     "events.dropped": (
         "warning", "a process's local event buffer overflowed between "

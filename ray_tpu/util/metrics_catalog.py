@@ -109,6 +109,19 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_llm_engine_tpot_s": (
         "histogram", "mean time per output token after the first",
         ("engine",), "seconds", _FAST),
+    # ---- serve fault-tolerance plane ----
+    "ray_tpu_serve_health_probe_failures_total": (
+        "counter", "controller health probes that failed or timed out "
+        "(one replica replacement per RAY_TPU_SERVE_HEALTH_THRESHOLD "
+        "consecutive failures)", ("deployment",), "probes", None),
+    "ray_tpu_serve_requests_shed_total": (
+        "counter", "requests shed instead of executed (expired "
+        "propagated deadline at admission, or replica draining)",
+        ("reason",), "requests", None),
+    "ray_tpu_serve_failovers_total": (
+        "counter", "requests resubmitted to a different replica after "
+        "a replica death / wedged engine / drain rejection",
+        ("kind",), "requests", None),
     # ---- data executor ----
     "ray_tpu_data_inflight_bytes": (
         "gauge", "bytes of blocks in flight in a streaming stage",

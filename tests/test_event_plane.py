@@ -62,14 +62,25 @@ def test_catalog_requires_recovery_plane_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_serve_fault_tolerance_events():
+    """The serve FT plane's chain (health probe -> replacement ->
+    failover, plus shedding and the wedged watchdog) is asserted by
+    tests/test_serve_fault_tolerance.py and rendered in post-mortem
+    bundles — the catalog must keep carrying it."""
+    for required in ("serve.replica.unhealthy", "serve.replica.replaced",
+                     "serve.replica.drain", "serve.request.failover",
+                     "serve.request.shed", "llm_engine.wedged"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics
     catalog lint)."""
     pkg = os.path.join(REPO, "ray_tpu")
     call = re.compile(
-        r"(?:emit|_emit|_event|_emit_event)\(\s*"
-        r"['\"]([a-z0-9_]+\.[a-z0-9_]+)['\"]")
+        r"(?:emit|emit_safe|_emit|_event|_emit_event|_emit_serve_event)"
+        r"\(\s*['\"]((?:[a-z0-9_]+\.){1,2}[a-z0-9_]+)['\"]")
     offenders = []
     for root, _dirs, files in os.walk(pkg):
         for f in files:

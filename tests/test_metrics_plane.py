@@ -67,6 +67,17 @@ def test_builtin_metric_names_prefixed_snake_unique():
     assert len({id(mcat.get(n)) for n in names}) == len(names)
 
 
+def test_catalog_requires_serve_fault_tolerance_metrics():
+    """The serve FT plane's counters are part of the availability
+    contract (tests/test_serve_fault_tolerance.py and the docs key on
+    them) — the catalog must keep carrying them."""
+    for required in ("ray_tpu_serve_health_probe_failures_total",
+                     "ray_tpu_serve_requests_shed_total",
+                     "ray_tpu_serve_failovers_total"):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == "counter", required
+
+
 def test_no_uncataloged_builtin_metric_literals():
     """Lint: any Counter/Gauge/Histogram constructed with a literal name
     inside the package must use a cataloged ray_tpu_ name (user-facing

@@ -350,13 +350,35 @@ def test_completions_n_validation(openai_app):
 
 def test_guided_json_over_api(openai_app):
     """guided_json forces schema-valid canonical JSON output. (Array
-    schema: DummyTok's decode range covers [ ] , digits but not { }.)"""
+    schema: DummyTok's decode range covers [ ] , digits but not { }.)
+
+    Deflake (ISSUE 7 satellite; recorded load flake per CHANGES.md
+    PR 4): the OpenAI default temperature is 1.0, so the guided output
+    was SAMPLED — and the engine's rng stream splits once per decode
+    dispatch, making it depend on load-dependent step timing. Under
+    full-suite contention a different stream could keep sampling
+    digits past max_tokens mid-array -> truncated, invalid JSON;
+    in isolation the stream (and output) was stable. temperature=0
+    makes the output a pure function of the prompt, load-independent,
+    while still exercising the guided mask end-to-end over the API.
+    One bounded retry guards TRANSPORT-level load failures; the
+    correctness assertions are never retried."""
+    import urllib.error
     port = openai_app
     schema = {"type": "array", "items": {"type": "integer"},
               "minItems": 1, "maxItems": 3}
-    with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 24,
-                      "guided_json": schema}) as r:
-        out = json.loads(r.read())
+    out = None
+    for attempt in (0, 1):
+        try:
+            with _post(port, {"prompt": [1, 2, 3, 4], "max_tokens": 24,
+                              "temperature": 0.0,
+                              "guided_json": schema}) as r:
+                out = json.loads(r.read())
+            break
+        except (urllib.error.URLError, TimeoutError, OSError):
+            if attempt:
+                raise
+            time.sleep(2.0)         # let the load spike pass
     doc = json.loads(out["choices"][0]["text"])
     assert isinstance(doc, list) and 1 <= len(doc) <= 3
     assert all(isinstance(x, int) for x in doc)
