@@ -29,7 +29,7 @@ AUTO_PUT_THRESHOLD = 256 * 1024  # large ndarray args go through the store
 def init(*, address=None, num_cpus=None, num_tpus=None, resources=None,
          object_store_memory=None, namespace="default",
          max_workers=None, ignore_reinit_error=True, log_to_driver=True,
-         listen=None, **_ignored):
+         listen=None, state_dir=None, resume=False, **_ignored):
     """Start the ray_tpu runtime in this (driver) process.
 
     address="ray://host:port" instead connects as a THIN CLIENT to a
@@ -41,6 +41,15 @@ def init(*, address=None, num_cpus=None, num_tpus=None, resources=None,
     listener so remote hosts can join with
     `python -m ray_tpu.core.node tcp://host:port`; the bound address is
     `init(...).tcp_address`.
+
+    state_dir (or RAY_TPU_STATE_DIR) makes the control plane DURABLE:
+    every GCS mutation appends to a write-ahead log with periodic
+    snapshots. resume=True rebuilds the cluster from that state after a
+    driver crash — node agents reattach, actors restart from their
+    `__ray_save__` checkpoints, lost objects reconstruct via lineage —
+    under a bumped driver incarnation (resume="auto" resumes when state
+    exists and starts fresh otherwise). See docs/FAULT_TOLERANCE.md
+    "Driver restart & job resume".
     """
     with _init_lock:
         if runtime_mod.runtime_initialized():
@@ -55,7 +64,9 @@ def init(*, address=None, num_cpus=None, num_tpus=None, resources=None,
             sizing = {"num_cpus": num_cpus, "num_tpus": num_tpus,
                       "resources": resources,
                       "object_store_memory": object_store_memory,
-                      "max_workers": max_workers, "listen": listen}
+                      "max_workers": max_workers, "listen": listen,
+                      "state_dir": state_dir,
+                      "resume": resume or None}
             bad = [k for k, v in sizing.items() if v is not None]
             if bad:
                 raise ValueError(
@@ -71,7 +82,8 @@ def init(*, address=None, num_cpus=None, num_tpus=None, resources=None,
                            resources=resources,
                            object_store_memory=object_store_memory,
                            namespace=namespace, max_workers=max_workers,
-                           log_to_driver=log_to_driver, listen=listen)
+                           log_to_driver=log_to_driver, listen=listen,
+                           state_dir=state_dir, resume=resume)
         runtime_mod.set_runtime(rt)
         return rt
 
@@ -383,7 +395,9 @@ class RuntimeContext:
 
     @property
     def was_current_actor_reconstructed(self):
-        return False
+        # True inside an actor whose current life began with a
+        # __ray_restore__ (worker-death restart OR driver resume)
+        return bool(getattr(self._rt, "actor_restored", False))
 
     def get_resources(self):
         return self._rt.get_resources() if self._rt.is_driver else {}

@@ -58,6 +58,34 @@ def cmd_status(args):
     print(json.dumps(s, indent=2))
 
 
+def cmd_persistence(args):
+    """Control-plane persistence health: driver incarnation, WAL
+    length/bytes, last-snapshot age, replayed records after a resume."""
+    s = _fetch(args.address, "/api/persistence")
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return
+    if not s.get("enabled"):
+        print("persistence: disabled (set RAY_TPU_STATE_DIR or "
+              "init(state_dir=...) to make driver state durable)")
+        print(f"driver incarnation: {s.get('driver_incarnation', 0)}")
+        return
+    print(f"state dir:           {s.get('state_dir')}")
+    print(f"driver incarnation:  {s.get('driver_incarnation')}"
+          + ("  (resumed)" if s.get("resumed") else ""))
+    print(f"WAL records:         {s.get('wal_records')}"
+          f"  ({s.get('wal_bytes')} bytes since last snapshot)")
+    print(f"snapshots taken:     {s.get('snapshots_taken')}"
+          f"  (last {s.get('last_snapshot_age_s')}s ago)")
+    print(f"replayed on resume:  {s.get('replayed_records')}"
+          + ("  [torn WAL tail truncated]"
+             if s.get("torn_tail_recovered") else ""))
+    if s.get("reattach_awaiting_objects"):
+        print(f"awaiting reattach:   "
+              f"{s['reattach_awaiting_objects']} objects parked for "
+              "restored nodes")
+
+
 def cmd_list(args):
     route = {"actors": "/api/actors", "tasks": "/api/tasks",
              "objects": "/api/objects", "nodes": "/api/nodes",
@@ -409,6 +437,13 @@ def main(argv=None):
 
     sub.add_parser("status", help="cluster summary").set_defaults(
         fn=cmd_status)
+
+    pp = sub.add_parser(
+        "persistence",
+        help="control-plane WAL/snapshot health (driver incarnation, "
+             "WAL length, last-snapshot age, resume replay count)")
+    pp.add_argument("--json", action="store_true")
+    pp.set_defaults(fn=cmd_persistence)
 
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("kind", choices=["actors", "tasks", "objects", "nodes",

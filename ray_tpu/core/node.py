@@ -104,6 +104,9 @@ class NodeAgent:
         # incarnations, so a stalled-then-recovered agent can't corrupt
         # the failover that already happened.
         self.incarnation = 0
+        # last acked driver incarnation (bumps when a SIGKILLed driver
+        # resumes and this agent reattaches to it)
+        self.driver_incarnation = 0
         self.conn = connect_address(driver_address)
         self.conn.send(("register_node", self._register_info()))
         # Metrics plane: this agent's registry (node-local store stats,
@@ -296,6 +299,14 @@ class NodeAgent:
         mtype = m[0]
         if mtype == "node_registered":
             self.job_id = m[2]
+            # a restarted driver acks with a bumped incarnation: this
+            # host's capacity (and its surviving object store) is now
+            # reattached to the resumed control plane
+            inc = m[3] if len(m) > 3 else 0
+            if inc and inc != self.driver_incarnation:
+                print(f"ray_tpu node {self.node_id} reattached to "
+                      f"driver incarnation {inc}", flush=True)
+            self.driver_incarnation = inc
         elif mtype == "pull_object":
             _, rid, oid, candidates = m
             threading.Thread(target=self._serve_pull,

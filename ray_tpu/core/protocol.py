@@ -158,6 +158,17 @@ def tcp_listener(host: str = "127.0.0.1", port: int = 0) -> socket.socket:
 def tcp_connect(host: str, port: int,
                 timeout: Optional[float] = 10.0) -> Connection:
     s = socket.create_connection((host, port), timeout=timeout)
+    if s.getsockname() == s.getpeername():
+        # TCP self-connect: connecting to a loopback port in the
+        # ephemeral range while nothing listens can "succeed" against
+        # OURSELVES (the kernel picked source port == dest port). A
+        # node agent retrying a dead driver's address would then talk
+        # to its own echo and believe it rejoined — refuse, so the
+        # caller's retry loop keeps waiting for the real listener
+        # (observed during driver crash-restart reattach tests).
+        s.close()
+        raise ConnectionRefusedError(
+            f"self-connect to {host}:{port} (no listener yet)")
     s.settimeout(None)
     return Connection(s)
 

@@ -117,7 +117,7 @@ class NodeState:
     gcs_node_manager.cc / node_manager.cc)."""
     __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
                  "alive", "free_tpu_ids", "last_heartbeat",
-                 "heartbeat_missed", "incarnation")
+                 "heartbeat_missed", "incarnation", "restored")
 
     def __init__(self, node_id: str, hostname: str,
                  resources: Dict[str, float],
@@ -137,6 +137,9 @@ class NodeState:
         self.heartbeat_missed = False
         # bumped on rejoin; messages from older incarnations are fenced
         self.incarnation = 0
+        # rebuilt from persisted state by a resumed driver and not yet
+        # re-registered: the agent's reattach flips this back off
+        self.restored = False
         # Specific chip indices handed to tasks/actors (get_tpu_ids):
         # concurrent TPU workloads on one host must see disjoint chips.
         self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
@@ -211,11 +214,57 @@ class DriverRuntime:
 
     def __init__(self, *, num_cpus=None, num_tpus=None, resources=None,
                  object_store_memory=None, max_workers=None, namespace="default",
-                 job_id=None, log_to_driver=True, listen=None):
+                 job_id=None, log_to_driver=True, listen=None,
+                 state_dir=None, resume=False):
         self.namespace = namespace
         self.job_id = job_id or f"job-{os.getpid()}"
         self.gcs = GCS()
         self.node_id = new_node_id()
+        # ---- control-plane persistence (core/persistence.py): with a
+        # state dir, every GCS mutation WALs and resume=True rebuilds
+        # the tables after a driver crash under a bumped incarnation
+        from . import persistence as persist_mod  # noqa: PLC0415
+        state_dir = state_dir or persist_mod.default_state_dir()
+        self.state_dir = state_dir
+        self.incarnation = 0
+        self.resumed = False
+        self._resume_rec = None
+        self._persist = None
+        if resume is True and not state_dir:
+            # silently starting fresh here would re-run every
+            # side-effecting task of a job that believes it resumed
+            raise RuntimeError(
+                "init(resume=True) requires a state dir: pass "
+                "state_dir=... or set RAY_TPU_STATE_DIR "
+                "(resume=\"auto\" starts fresh when there is none)")
+        if state_dir and resume:
+            rec = persist_mod.load(state_dir)
+            if rec is None:
+                if resume != "auto":
+                    raise RuntimeError(
+                        f"init(resume=True): no persisted driver state "
+                        f"under {state_dir!r} (missing MANIFEST.json)")
+            else:
+                self._resume_rec = rec
+                self.incarnation = rec.incarnation + 1
+                self.resumed = True
+                if rec.node_id:
+                    # the driver node KEEPS its id across restarts
+                    # (mirroring node agents, which keep theirs across
+                    # rejoins and bump an incarnation): restored
+                    # lineage specs' NodeAffinity pins, persisted
+                    # ObjectLocations, and forensics all keep naming a
+                    # node that still exists
+                    self.node_id = rec.node_id
+                if listen is None and not os.environ.get(
+                        "RAY_TPU_LISTEN"):
+                    # re-bind the crashed driver's control address so
+                    # waiting node agents reattach to it
+                    listen = rec.listen
+        elif state_dir and persist_mod.wipe(state_dir):
+            sys.stderr.write(
+                f"[ray_tpu] fresh init(): cleared stale driver state "
+                f"from {state_dir}\n")
         # Stamp this process's node id so ObjectLocations created by the
         # driver (and env-inheriting local workers) carry it.
         os.environ["RAY_TPU_NODE_ID"] = self.node_id
@@ -400,6 +449,36 @@ class DriverRuntime:
         self.report_handlers["sys.spans"] = self._on_worker_spans
         self.report_handlers["sys.events"] = self._on_worker_events
 
+        # restored remote-held objects parked until their node
+        # reattaches: nid -> [(oid, loc), ...]; past the grace deadline
+        # they go through lineage reconstruction instead
+        self._reattach_pending: Dict[str, List[tuple]] = {}
+        self._reattach_deadline = 0.0
+        if state_dir:
+            bound = None
+            if self.tcp_address:
+                bound = self.tcp_address[len("tcp://"):]
+            self._persist = persist_mod.GCSPersistence(
+                state_dir, incarnation=self.incarnation,
+                job_id=self.job_id, node_id=self.node_id, listen=bound,
+                resuming=self._resume_rec is not None)
+        if self._resume_rec is not None:
+            # single-threaded here (dispatcher not started yet): safe to
+            # mutate every table directly
+            self._restore_from(self._resume_rec)
+            self._resume_rec = None
+            # snapshot the RESTORED tables before anything else runs:
+            # until this lands, the crashed life's manifest stays
+            # authoritative (GCSPersistence deferred its swap), so a
+            # second crash at ANY point resumes from intact state
+            if self._persist is not None and \
+                    not self._persist.snapshot(self._snapshot_tables):
+                sys.stderr.write(
+                    "[ray_tpu] WARNING: post-resume snapshot failed; "
+                    "persistence is running degraded (the previous "
+                    "life's state dir generation remains "
+                    "authoritative)\n")
+
         # Backstop for drivers that exit without calling shutdown() (e.g.
         # a pytest process): workers self-exit on socket close, but the shm
         # arena needs an explicit owner-side unlink or it outlives us in
@@ -421,6 +500,247 @@ class DriverRuntime:
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="rtpu-reaper")
         self._reaper.start()
+
+    # ================= driver restart / resume =================
+    def _restore_from(self, rec) -> None:
+        """Rebuild the control plane from a crashed driver's persisted
+        state (core/persistence.py) and queue reconciliation:
+
+        * remote nodes become reattach candidates (their agents rejoin
+          through the incarnation fencing machinery; until then their
+          objects park in _reattach_pending),
+        * objects whose only payloads died with the old driver go
+          through PR-4 lineage reconstruction,
+        * actors restart from their persisted __ray_save__ checkpoints
+          (named / checkpointed / max_restarts>0 actors only — the
+          serve controller rides this and re-deploys its targets),
+        * everything else (in-flight tasks, streams, placement groups)
+          is the resuming job's to resubmit.
+
+        Runs in __init__ before any thread starts."""
+        self._emit("driver.restart",
+                   f"driver resumed as incarnation {self.incarnation} "
+                   f"from {self.state_dir} "
+                   f"({rec.replayed_records} WAL records replayed"
+                   f"{', torn tail truncated' if rec.torn_tail else ''}"
+                   f"{', clean shutdown' if rec.clean else ''})",
+                   node_id=self.node_id,
+                   incarnation=self.incarnation,
+                   replayed_records=rec.replayed_records,
+                   torn_tail=rec.torn_tail, clean=rec.clean)
+        if self._persist is not None:
+            self._persist.replayed_records = rec.replayed_records
+            self._persist.torn_tail_recovered = rec.torn_tail
+        old_driver_nid = rec.node_id
+        if old_driver_nid and old_driver_nid != self.node_id:
+            # only for state dirs written before node-id adoption: the
+            # dead driver's id survives as a tombstone for forensics
+            self.gcs.nodes.setdefault(old_driver_nid, NodeEntry(
+                node_id=old_driver_nid, hostname="(dead driver)",
+                resources={}, alive=False))
+
+        # ---- nodes: alive-at-crash remote nodes await reattach
+        for nid, info in rec.nodes.items():
+            if nid == old_driver_nid:
+                continue
+            self.gcs.nodes[nid] = NodeEntry(
+                node_id=nid, hostname=info.get("hostname", "?"),
+                resources=dict(info.get("resources") or {}),
+                labels=dict(info.get("labels") or {}),
+                alive=False,
+                incarnation=int(info.get("incarnation", 0)))
+            if not info.get("alive", False):
+                continue    # declared dead pre-crash: nothing to wait on
+            ns = NodeState(nid, info.get("hostname", "?"),
+                           dict(info.get("resources") or {}),
+                           labels=info.get("labels"), conn=None)
+            ns.alive = False
+            ns.restored = True
+            ns.incarnation = int(info.get("incarnation", 0))
+            self.cluster_nodes[nid] = ns
+        grace = float(os.environ.get(
+            "RAY_TPU_RESUME_REATTACH_GRACE_S",
+            os.environ.get("RAY_TPU_NODE_REJOIN_S", "30")))
+        self._reattach_deadline = time.time() + grace
+
+        # ---- lineage + task table (reconstruction needs both)
+        for task_id, spec in rec.lineage.items():
+            self._lineage_specs[task_id] = spec
+            cost = self._lineage_cost(spec)
+            self._lineage_sizes[task_id] = cost
+            self._lineage_bytes += cost
+            self.gcs.tasks[task_id] = TaskEntry(
+                task_id=task_id, name=spec.name, state="FINISHED",
+                actor_id=spec.actor_id)
+
+        # ---- objects: classify every persisted payload location
+        lost: List[str] = []
+        for oid, e in rec.objects.items():
+            if e.state != "ready":
+                continue
+            servable, awaiting = [], []
+            for loc in [e.loc, *e.copies]:
+                if loc is None:
+                    continue
+                kind = getattr(loc, "kind", None)
+                if kind == "inline":
+                    servable.append(loc)
+                    continue
+                if kind == "device":
+                    continue            # holder died with the driver
+                nid = getattr(loc, "node_id", None) or old_driver_nid
+                ns = self.cluster_nodes.get(nid)
+                if ns is not None and getattr(ns, "restored", False):
+                    awaiting.append(loc)
+                    continue
+                # driver-local (or dead-node) payload: the store died
+                # with its process, but a spill copy on disk survives a
+                # SIGKILL — re-home it onto the new driver node
+                spath = getattr(loc, "spill_path", None) or (
+                    loc.name if kind == "spill" else None)
+                if spath and os.path.exists(spath):
+                    loc.node_id = self.node_id
+                    servable.append(loc)
+            self.gcs.objects[oid] = e
+            if servable:
+                e.loc, e.copies = servable[0], servable[1:] + awaiting
+            elif awaiting:
+                # park until the holder reattaches; the reattach path
+                # re-seals (fresh seal_seq), the grace expiry
+                # reconstructs instead
+                e.state, e.loc, e.copies = "pending", None, []
+                nid = awaiting[0].node_id
+                self._reattach_pending.setdefault(nid, []).append(
+                    (oid, awaiting[0]))
+            else:
+                e.state, e.loc, e.copies = "pending", None, []
+                lost.append(oid)
+
+        # ---- actors: resume-eligible ones restart from checkpoints
+        self.gcs.named_actors.update(rec.named_actors)
+        self._actor_checkpoints.update(rec.checkpoints)
+        for aid, ae in rec.actors.items():
+            self.gcs.actors[aid] = ae
+            if ae.state == "DEAD":
+                continue    # a dead actor's name is not resurrected
+            acspec = ae.create_spec
+            pg_id = getattr(acspec, "placement_group_id", None) \
+                if acspec is not None else None
+            resumable = acspec is not None and pg_id is None and (
+                bool(ae.name) or aid in rec.checkpoints
+                or ae.max_restarts > 0)
+            if not resumable:
+                ae.state = "DEAD"
+                ae.worker_id = None
+                ae.death_cause = (
+                    "placement groups are not persisted across a "
+                    "driver restart" if pg_id is not None else
+                    "driver restarted; actor is not resumable (no "
+                    "name, no __ray_save__ checkpoint, max_restarts=0)")
+                self._emit("actor.death", ae.death_cause, actor_id=aid,
+                           class_name=ae.class_name)
+                self._persist_actor_state(ae)
+                continue
+            ae.state = "RESTARTING"
+            ae.worker_id = None
+            self.actor_max_conc[aid] = acspec.max_concurrency
+            self.actor_group_conc[aid] = dict(
+                getattr(acspec, "concurrency_groups", None) or {})
+            self.pending_restarts.append(aid)
+            self._emit("actor.restart",
+                       f"driver restart (incarnation "
+                       f"{self.incarnation}); restarting"
+                       + (" from persisted checkpoint"
+                          if aid in rec.checkpoints else ""),
+                       actor_id=aid, class_name=ae.class_name)
+            self._persist_actor_state(ae)
+
+        # ---- internal KV (job-level resume handles live here)
+        self.gcs.kv.update(rec.kv)
+
+        # lost objects reconstruct once the dispatcher starts (their
+        # producer chains re-queue through _handle_lost_object)
+        if lost:
+            self.inbox.put(("resume_reconcile", lost))
+        sys.stderr.write(
+            f"[ray_tpu] driver resumed as incarnation "
+            f"{self.incarnation}: {len(rec.objects)} objects "
+            f"({len(lost)} lost with the old driver, "
+            f"{sum(len(v) for v in self._reattach_pending.values())} "
+            f"awaiting node reattach), {len(rec.actors)} actors "
+            f"({len(self.pending_restarts)} restarting), "
+            f"{len(rec.lineage)} lineage specs, "
+            f"{rec.replayed_records} WAL records replayed\n")
+
+    def _resume_reconcile(self, lost: List[str]) -> None:
+        """Dispatcher-side half of resume: push every payload that died
+        with the old driver through the PR-4 loss machinery — lineage
+        re-execution when the producer's spec survived, a clean
+        ObjectLostError otherwise."""
+        for oid in lost:
+            e = self.gcs.objects.get(oid)
+            if e is None or e.state != "pending":
+                continue
+            self._handle_lost_object(
+                oid, e,
+                cause="payload lived in the crashed driver's store")
+
+    def _check_reattach_grace(self) -> None:
+        """Give up on restored nodes that never reattached: their parked
+        objects go through lineage reconstruction instead."""
+        if not self._reattach_pending \
+                or time.time() < self._reattach_deadline:
+            return
+        pend, self._reattach_pending = self._reattach_pending, {}
+        for nid, items in pend.items():
+            for oid, loc in items:
+                e = self.gcs.objects.get(oid)
+                if e is None or e.state != "pending":
+                    continue
+                self._handle_lost_object(
+                    oid, e,
+                    cause=f"holder node {nid} did not reattach within "
+                          f"the resume grace window", node_id=nid)
+
+    def _snapshot_tables(self) -> dict:
+        """Build the snapshot payload (dispatcher thread: tables are
+        consistent without locks; only kv is shared with API threads)."""
+        nodes = {}
+        for nid, ns in self.cluster_nodes.items():
+            if nid == self.node_id:
+                continue
+            nodes[nid] = {"node_id": nid, "hostname": ns.hostname,
+                          "resources": dict(ns.total),
+                          "labels": dict(ns.labels),
+                          "incarnation": ns.incarnation,
+                          "alive": ns.alive}
+        with self._kv_lock:
+            kv = dict(self.gcs.kv)
+        return {
+            "objects": {oid: e for oid, e in self.gcs.objects.items()
+                        if e.state == "ready"},
+            "actors": dict(self.gcs.actors),
+            "checkpoints": dict(self._actor_checkpoints),
+            "named_actors": dict(self.gcs.named_actors),
+            "nodes": nodes,
+            "lineage": dict(self._lineage_specs),
+            "kv": kv,
+        }
+
+    def _persist_actor_state(self, ae) -> None:
+        if self._persist is not None:
+            self._persist.actor_state(ae)
+
+    def persistence_stats(self) -> Optional[dict]:
+        """Persistence-health snapshot for the state API / CLI; None
+        when no state dir is configured."""
+        if self._persist is None:
+            return None
+        stats = self._persist.stats()
+        stats["resumed"] = self.resumed
+        stats["reattach_awaiting_objects"] = sum(
+            len(v) for v in list(self._reattach_pending.values()))
+        return stats
 
     # ================= threads =================
     def _accept_loop(self, listener):
@@ -497,7 +817,37 @@ class DriverRuntime:
         if kind == "tick":
             self._update_builtin_gauges()
             self._check_node_heartbeats()
+            self._check_reattach_grace()
+            if self._persist is not None and \
+                    self._persist.maybe_snapshot(self._snapshot_tables):
+                self._emit("gcs.snapshot",
+                           node_id=self.node_id,
+                           incarnation=self.incarnation,
+                           **{k: v for k, v in
+                              self._persist.stats().items()
+                              if k in ("snapshots_taken",
+                                       "wal_records")})
+                try:
+                    _mcat().get("ray_tpu_gcs_snapshots_total").inc()
+                except Exception:
+                    pass
             self.drain_local_events()
+            return
+        if kind == "resume_reconcile":
+            self._resume_reconcile(item[1])
+            return
+        if kind == "wal":
+            # API-thread mutations (internal KV) persist through here so
+            # appends serialize with snapshot rotation
+            if self._persist is not None:
+                self._persist.append(item[1])
+            return
+        if kind == "final_snapshot":
+            # shutdown(): the LAST snapshot must run on this thread —
+            # the tables are only consistent here
+            if self._persist is not None:
+                self._persist.snapshot(self._snapshot_tables)
+            item[1].set()
             return
         if kind == "register":
             _, wid, conn, pid = item
@@ -716,6 +1066,8 @@ class DriverRuntime:
         # future connection can still report once
         self._fenced_seen = {k for k in self._fenced_seen
                              if k[0] != nid}
+        was_restored = prev is not None and getattr(prev, "restored",
+                                                    False)
         ns = NodeState(nid, info.get("hostname", "?"), info["resources"],
                        labels=info.get("labels"), conn=conn)
         ns.incarnation = inc
@@ -725,7 +1077,29 @@ class DriverRuntime:
             labels=dict(ns.labels), incarnation=inc)
         if info.get("transfer_address"):
             self.transfer_addrs[nid] = info["transfer_address"]
-        if prev is not None:
+        if self._persist is not None:
+            self._persist.node_register(
+                {"node_id": nid, "hostname": ns.hostname,
+                 "resources": dict(ns.total),
+                 "labels": dict(ns.labels), "incarnation": inc})
+        if was_restored:
+            # reattach after a driver restart: the agent (and its store)
+            # never died — every parked object it holds becomes ready
+            # again under a fresh seal generation
+            parked = self._reattach_pending.pop(nid, [])
+            resealed = 0
+            for oid, loc in parked:
+                e = self.gcs.objects.get(oid)
+                if e is not None and e.state == "pending":
+                    self._seal(oid, loc)
+                    resealed += 1
+            self._emit("node.reattach",
+                       f"node {nid} ({ns.hostname}) reattached to the "
+                       f"restarted driver (incarnation {inc}); "
+                       f"{resealed} restored objects ready again",
+                       node_id=nid, objects_resealed=resealed,
+                       driver_incarnation=self.incarnation)
+        elif prev is not None:
             # elastic rejoin (preempted/stalled host back): queued work
             # may flow to it again; everything it held was failed over
             # at death determination and is NOT resurrected
@@ -739,7 +1113,8 @@ class DriverRuntime:
                        hostname=ns.hostname, resources=dict(ns.total))
         # the driver's own transfer address travels per-candidate in
         # pull_object/locations payloads, so the ack stays minimal
-        conn.send(("node_registered", self.node_id, self.job_id))
+        conn.send(("node_registered", self.node_id, self.job_id,
+                   self.incarnation))
 
     def _handle_node_msg(self, nid: str, m, conn=None) -> None:
         from .protocol import RECV_ERROR  # noqa: PLC0415
@@ -867,6 +1242,8 @@ class DriverRuntime:
         entry = self.gcs.nodes.get(nid)
         if entry is not None:
             entry.alive = False
+        if self._persist is not None:
+            self._persist.node_death(nid)
         self._emit("node.death",
                    f"node {nid} ({ns.hostname}) declared dead; failing "
                    "over its workers, objects, and placement bundles",
@@ -993,6 +1370,8 @@ class DriverRuntime:
         self._lineage_specs[task_id] = spec
         self._lineage_bytes += cost - self._lineage_sizes.get(task_id, 0)
         self._lineage_sizes[task_id] = cost
+        if self._persist is not None:
+            self._persist.lineage_retain(task_id, spec)
         # the spec is (back) in the table: un-pin outputs a concurrent
         # eviction may have flagged while this re-run was in flight
         for oid in spec.return_ids:
@@ -1005,6 +1384,8 @@ class DriverRuntime:
             old_id = next(iter(self._lineage_specs))
             old = self._lineage_specs.pop(old_id)
             self._lineage_bytes -= self._lineage_sizes.pop(old_id, 0)
+            if self._persist is not None:
+                self._persist.lineage_evict(old_id)
             for ooid in old.return_ids:
                 oe = self.gcs.objects.get(ooid)
                 if oe is not None:
@@ -1367,6 +1748,8 @@ class DriverRuntime:
     def _seal(self, oid: str, loc) -> None:
         e = self.gcs.seal_object(oid, loc)
         self._materializing.discard(oid)
+        if self._persist is not None:
+            self._persist.object_seal(e)
         self._emit("object.seal", object_id=oid, task_id=e.owner_task,
                    node_id=getattr(loc, "node_id", None) or self.node_id,
                    kind=getattr(loc, "kind", None),
@@ -1727,7 +2110,11 @@ class DriverRuntime:
                 self._emit("actor.death", ae.death_cause,
                            actor_id=acspec.actor_id,
                            class_name=acspec.class_name)
+                if self._persist is not None:
+                    self._persist.actor_create(ae)
                 return
+        if self._persist is not None:
+            self._persist.actor_create(ae)
         self.actor_max_conc[acspec.actor_id] = acspec.max_concurrency
         self.actor_group_conc[acspec.actor_id] = dict(
             getattr(acspec, "concurrency_groups", None) or {})
@@ -1915,6 +2302,7 @@ class DriverRuntime:
             if dr is None:
                 ae = self.gcs.actors[acspec.actor_id]
                 ae.state, ae.death_cause = "DEAD", "constructor arg errored"
+                self._persist_actor_state(ae)
                 continue
             if dr is False:
                 still.append(acspec)
@@ -1936,6 +2324,7 @@ class DriverRuntime:
                     ae.state = "DEAD"
                     ae.death_cause = (f"NodeAffinity target node {hard!r} "
                                       "is dead or unknown")
+                    self._persist_actor_state(ae)
                     continue
             tries, spread = sched_mod.strategy_plan(strat, allowed)
             node = None
@@ -1991,6 +2380,7 @@ class DriverRuntime:
                     ae.state = "DEAD"
                     ae.death_cause = (f"NodeAffinity target node {hard!r} "
                                       "died; cannot restart pinned actor")
+                    self._persist_actor_state(ae)
                     # queued method calls fail via the DEAD branch of the
                     # actor-task scheduling section below
                     continue
@@ -2575,10 +2965,12 @@ class DriverRuntime:
             return
         if ok:
             ae.state, ae.worker_id = "ALIVE", wid
+            self._persist_actor_state(ae)
             self._emit("actor.alive", actor_id=actor_id, worker_id=wid,
                        class_name=ae.class_name)
         else:
             ae.state, ae.death_cause = "DEAD", repr(err)
+            self._persist_actor_state(ae)
             self._actor_checkpoints.pop(actor_id, None)
             self._emit("actor.death",
                        f"constructor failed: {repr(err)[:400]}",
@@ -2692,6 +3084,7 @@ class DriverRuntime:
             return
         ae.state = "DEAD"
         ae.death_cause = "actor_exit() called"
+        self._persist_actor_state(ae)
         self._actor_checkpoints.pop(aid, None)
         self._emit("actor.death", ae.death_cause, actor_id=aid,
                    class_name=ae.class_name)
@@ -2705,6 +3098,8 @@ class DriverRuntime:
         if ae is None or ae.state == "DEAD" or blob is None:
             return
         self._actor_checkpoints[aid] = blob
+        if self._persist is not None:
+            self._persist.actor_ckpt(aid, blob)
         self._emit("actor.checkpoint", actor_id=aid, worker_id=wid,
                    size=len(blob))
 
@@ -2716,6 +3111,7 @@ class DriverRuntime:
         if ae.num_restarts < ae.max_restarts:
             ae.num_restarts += 1
             ae.state = "RESTARTING"
+            self._persist_actor_state(ae)
             self._emit("actor.restart",
                        f"worker {wid} died; restart "
                        f"{ae.num_restarts}/{ae.max_restarts}",
@@ -2730,6 +3126,7 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or f"worker {wid} died"
+            self._persist_actor_state(ae)
             self._actor_checkpoints.pop(aid, None)
             self._emit("actor.death", ae.death_cause, actor_id=aid,
                        worker_id=wid, class_name=ae.class_name)
@@ -2950,6 +3347,7 @@ class DriverRuntime:
         else:
             ae.state = "DEAD"
             ae.death_cause = ae.death_cause or "killed before start"
+            self._persist_actor_state(ae)
             self._emit("actor.death", ae.death_cause,
                        actor_id=actor_id, class_name=ae.class_name)
             for spec in self.actor_queues.pop(actor_id, []):
@@ -2979,6 +3377,8 @@ class DriverRuntime:
             e = self.gcs.objects.pop(oid, None)
             if e is None or e.loc is None:
                 continue
+            if self._persist is not None:
+                self._persist.object_free(oid)
             self._emit("object.free", object_id=oid,
                        task_id=e.owner_task)
             for loc in [e.loc, *e.copies]:
@@ -3190,6 +3590,11 @@ class DriverRuntime:
                 existed = key in kv
                 if overwrite or not existed:
                     kv[key] = value
+                    if self._persist is not None:
+                        # WAL via the dispatcher: an API-thread append
+                        # racing a snapshot rotation could land in the
+                        # WAL generation being deleted and vanish
+                        self.inbox.put(("wal", ("kvput", key, value)))
                 return existed
             if op == "get":
                 return kv.get(args[0])
@@ -3197,6 +3602,8 @@ class DriverRuntime:
                 return args[0] in kv
             if op == "del":
                 key, by_prefix = args
+                if self._persist is not None:
+                    self.inbox.put(("wal", ("kvdel", key, by_prefix)))
                 if by_prefix:
                     doomed = [k for k in kv if k.startswith(key)]
                     for k in doomed:
@@ -3309,6 +3716,13 @@ class DriverRuntime:
             if callable(nobj):
                 _mcat().get("ray_tpu_object_store_objects").set(
                     float(nobj()))
+            if self._persist is not None:
+                _mcat().get("ray_tpu_driver_incarnation").set(
+                    float(self.incarnation))
+                _mcat().get("ray_tpu_wal_records").set(
+                    float(self._persist.records_appended))
+                _mcat().get("ray_tpu_wal_bytes").set(
+                    float(self._persist.wal_bytes))
         except Exception:
             pass
 
@@ -3373,6 +3787,23 @@ class DriverRuntime:
         if self._shutdown.is_set():
             return
         self._shutdown.set()
+        if self._persist is not None:
+            # final snapshot BEFORE teardown: it must capture the live
+            # cluster (ALIVE actors, sealed objects), not the storm of
+            # worker/actor deaths the shutdown itself is about to
+            # cause — and it must run ON the dispatcher thread, where
+            # the tables are consistent. close() then stops further
+            # WAL appends, so those teardown deaths never reach the
+            # persisted state and a planned restart resumes the job as
+            # it last ran.
+            done = threading.Event()
+            self.inbox.put(("final_snapshot", done))
+            snapped = done.wait(timeout=5.0)
+            # dispatcher wedged/dead: degrade to a caller-side snapshot
+            # attempt (snapshot() tolerates a racing mutation by
+            # failing closed) rather than skipping the final state
+            self._persist.close(
+                None if snapped else self._snapshot_tables)
         for n in list(self.cluster_nodes.values()):
             if n.conn is not None:
                 try:

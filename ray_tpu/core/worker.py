@@ -56,6 +56,9 @@ class WorkerRuntime:
         self.current_task_id: Optional[str] = None
         self.current_actor_id: Optional[str] = None
         self.current_tpu_ids: list = []
+        # this worker's actor began life via __ray_restore__ (surfaced
+        # as RuntimeContext.was_current_actor_reconstructed)
+        self.actor_restored = False
         self.job_id = os.environ.get("RAY_TPU_JOB_ID", "job-default")
 
     # ---- request/reply over the driver connection -------------------------
@@ -546,6 +549,7 @@ class WorkerLoop:
                 # last __ray_save__ snapshot instead of resetting
                 self._actor_instance.__ray_restore__(
                     serialization.unpack(ckpt))
+                self.rt.actor_restored = True
                 events_mod.emit(
                     "actor.restore",
                     f"restored __ray_save__ checkpoint ({len(ckpt)} B)",

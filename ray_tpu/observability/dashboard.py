@@ -5,6 +5,7 @@ Reference counterpart: python/ray/dashboard (head modules serving
 panel the reference renders is available as JSON:
 
   GET /api/cluster     — cluster summary
+  GET /api/persistence — control-plane WAL/snapshot health
   GET /api/nodes       — node table
   GET /api/actors      — actor table
   GET /api/tasks       — task table
@@ -90,6 +91,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route == "/api/cluster":
                 self._json(state_mod.cluster_summary())
+            elif route == "/api/persistence":
+                self._json(state_mod.persistence_summary())
             elif route == "/api/nodes":
                 self._json(state_mod.list_nodes(limit=limit))
             elif route == "/api/actors":

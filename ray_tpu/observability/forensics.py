@@ -188,7 +188,7 @@ def build_post_mortem(subject_id: str) -> Dict[str, Any]:
                 + "\n# ...truncated...\n"
     except Exception as e:  # noqa: BLE001
         metrics_text = f"# metrics snapshot failed: {e!r}\n"
-    return {
+    bundle = {
         "subject_id": subject_id,
         "generated_at": time.time(),
         "subject": subject,
@@ -199,6 +199,19 @@ def build_post_mortem(subject_id: str) -> Dict[str, Any]:
         "metrics": metrics_text,
         "event_summary": rt.cluster_events.summarize(),
     }
+    if getattr(rt, "incarnation", 0) or getattr(rt, "resumed", False):
+        # a post-mortem read on a RESUMED driver leads with the restart
+        # context: the driver.restart / node.reattach / gcs.snapshot
+        # chain explains why the subject's history starts mid-life
+        rows, _tot = rt.cluster_events.query(
+            types=["driver.restart", "node.reattach", "gcs.snapshot"],
+            limit=50)
+        bundle["driver_recovery"] = {
+            "incarnation": rt.incarnation,
+            "persistence": rt.persistence_stats(),
+            "events": rows,
+        }
+    return bundle
 
 
 def write_post_mortem(subject_id: str,

@@ -26,8 +26,12 @@ def _get_or_start_controller(http_options: Optional[HTTPOptions] = None):
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001  not started yet
         opts = http_options or HTTPOptions()
+        # checkpoint_interval_s throttles the controller's __ray_save__
+        # (deployment-target persistence for driver restart): without
+        # it every routing-table RPC would ship a checkpoint blob
         ctrl = ray_tpu.remote(ServeController).options(
-            name=CONTROLLER_NAME, max_concurrency=16).remote(
+            name=CONTROLLER_NAME, max_concurrency=16,
+            checkpoint_interval_s=0.5).remote(
             {"host": opts.host, "port": opts.port,
              "root_path": opts.root_path})
         ray_tpu.get(ctrl.ping.remote())

@@ -250,6 +250,44 @@ class ServeController:
     def ping(self) -> bool:
         return True
 
+    # ---- driver-restart persistence ---------------------------------------
+    # The controller is a NAMED actor, so a resumed driver
+    # (init(resume=True), core/persistence.py) restarts it and hands
+    # back the last checkpoint: __ray_save__ captures the deployment
+    # TARGETS (code, config, version, routes — not live replica
+    # handles), __ray_restore__ re-deploys them and the reconcile loop
+    # starts fresh replicas, so traffic resumes after a driver crash.
+    def __ray_save__(self) -> dict:
+        with self._lock:
+            apps = {}
+            for app, keys in self._apps.items():
+                rows = []
+                for key in keys:
+                    st = self._deployments.get(key)
+                    if st is None:
+                        continue
+                    rows.append({
+                        "name": st.name,
+                        "callable_bytes": st.callable_bytes,
+                        "init_args": st.init_args,
+                        "init_kwargs": st.init_kwargs,
+                        "config": st.config.to_dict(),
+                        "version": st.version,
+                        "route_prefix": st.route_prefix,
+                        "is_ingress": st.is_ingress,
+                        "is_asgi": st.is_asgi,
+                    })
+                apps[app] = rows
+            return {"apps": apps,
+                    "http_options": dict(self._http_options)}
+
+    def __ray_restore__(self, saved: dict) -> None:
+        self._http_options = saved.get("http_options") \
+            or self._http_options
+        for app, deployments in (saved.get("apps") or {}).items():
+            if deployments:
+                self.deploy_application(app, deployments)
+
     # ---- reconcile loop ---------------------------------------------------
     def _control_loop(self) -> None:
         import ray_tpu

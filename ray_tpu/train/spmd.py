@@ -179,8 +179,18 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         state_sh = jax.tree_util.tree_map_with_path(
             lambda path, leaf: _state_leaf_sharding(path, leaf, mesh, rules),
             abstract)
-        with jax.transfer_guard("allow"):
-            state = jax.jit(_init, out_shardings=state_sh)(rng)
+        # partitionable threefry makes the sharded init draw the SAME
+        # bits as an unsharded one: with the legacy (non-partitionable)
+        # impl, jit(out_shardings=...) lets the SPMD partitioner shard
+        # the RNG computation and every mesh produces different initial
+        # params — sharded-vs-single-device parity then fails at step 0
+        old_tf = jax.config.jax_threefry_partitionable
+        jax.config.update("jax_threefry_partitionable", True)
+        try:
+            with jax.transfer_guard("allow"):
+                state = jax.jit(_init, out_shardings=state_sh)(rng)
+        finally:
+            jax.config.update("jax_threefry_partitionable", old_tf)
 
         bshard = jax.tree_util.tree_map(
             lambda x: batch_sharding(mesh), example_batch)

@@ -74,9 +74,21 @@ BUILTIN: Dict[str, _SPEC] = {
         "warning", "lost object's producing task re-queued from the "
         "driver's lineage table (the Ray-paper availability trick: a "
         "lost object is a re-execution, not an error)"),
+    # ---- driver / control-plane persistence ----
+    "driver.restart": (
+        "warning", "a driver resumed from persisted state "
+        "(RAY_TPU_STATE_DIR) under a bumped incarnation; attrs carry "
+        "replayed WAL record count and torn-tail/clean flags"),
+    "gcs.snapshot": (
+        "info", "control-plane snapshot written and WAL rotated "
+        "(bounds replay time after a driver crash)"),
     # ---- node lifecycle ----
     "node.register": (
         "info", "node agent joined the cluster"),
+    "node.reattach": (
+        "info", "a node agent (with its surviving object store) "
+        "re-registered with a RESTARTED driver; its restored objects "
+        "were re-sealed and are ready again"),
     "node.heartbeat_miss": (
         "warning", "node stopped heartbeating (stale or connection "
         "lost); death determination may follow"),

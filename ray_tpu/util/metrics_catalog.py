@@ -55,6 +55,20 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_actor_checkpoints_total": (
         "counter", "actor __ray_save__ checkpoints shipped to the "
         "driver", (), "checkpoints", None),
+    # ---- control-plane persistence (core/persistence.py) ----
+    "ray_tpu_driver_incarnation": (
+        "gauge", "driver restart generation (0 = first life; bumps on "
+        "every init(resume=...) from persisted state)", (),
+        "incarnations", None),
+    "ray_tpu_wal_records": (
+        "gauge", "control-plane WAL records appended this driver life",
+        (), "records", None),
+    "ray_tpu_wal_bytes": (
+        "gauge", "bytes in the active control-plane WAL since the last "
+        "snapshot rotation", (), "bytes", None),
+    "ray_tpu_gcs_snapshots_total": (
+        "counter", "control-plane snapshots written (each rotates the "
+        "WAL)", (), "snapshots", None),
     "ray_tpu_node_memory_pressure": (
         "gauge", "host memory pressure (1 - available/total); the RSS "
         "watchdog kills a worker as it approaches 1.0", (), "ratio",

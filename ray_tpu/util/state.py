@@ -225,11 +225,31 @@ def summarize_objects() -> Dict[str, Any]:
                                             None)}
 
 
+def persistence_summary() -> Dict[str, Any]:
+    """Control-plane persistence health (core/persistence.py): driver
+    incarnation, WAL length/bytes, last-snapshot age, and — after a
+    resume — replayed-record count. `enabled` False when no
+    RAY_TPU_STATE_DIR / init(state_dir=...) is configured."""
+    rt = get_runtime()
+    stats = None
+    fn = getattr(rt, "persistence_stats", None)
+    if callable(fn):
+        stats = fn()
+    if stats is None:
+        return {"enabled": False,
+                "driver_incarnation": getattr(rt, "incarnation", 0),
+                "resumed": bool(getattr(rt, "resumed", False))}
+    stats["enabled"] = True
+    return stats
+
+
 def cluster_summary() -> Dict[str, Any]:
     rt = get_runtime()
     return {
         "job_id": rt.job_id,
         "namespace": rt.namespace,
+        "driver_incarnation": getattr(rt, "incarnation", 0),
+        "persistence": persistence_summary(),
         "nodes": len(rt.gcs.nodes),
         "workers": {s: sum(1 for w in list(rt.workers.values()) if w.state == s)
                     for s in ("starting", "idle", "busy", "actor", "dead")},

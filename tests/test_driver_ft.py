@@ -1,0 +1,562 @@
+"""Driver fault tolerance: persistent GCS state, crash-restart cluster
+reattach, and job resume (core/persistence.py + DriverRuntime resume).
+
+Covers: WAL framing + torn-tail crash consistency, atomic snapshots,
+stale state-dir cleanup, named-actor lifecycle across restart, clean-
+shutdown resume (lineage reconstruction of driver-local payloads), a
+SIGKILL-mid-job resume with zero lost tasks, and the full chaos test —
+driver SIGKILL with tasks in flight, a checkpointed actor, a node agent
+holding object payloads, and a serve deployment; the resumed driver
+finishes the job, the agent reattaches with its objects, the actor
+restores from its checkpoint, and the named serve endpoint answers.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import persistence
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.util import state as state_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fresh():
+    ray_tpu.shutdown()
+    yield
+    ray_tpu.shutdown()
+
+
+def _sub_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, *env.get("PYTHONPATH", "").split(os.pathsep)])
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+# ---------- WAL framing & crash consistency ----------
+
+def test_wal_roundtrip(tmp_path):
+    sd = str(tmp_path)
+    p = persistence.GCSPersistence(sd, incarnation=0, job_id="j",
+                                  node_id="n", listen=None)
+    p.kv_put("a", b"1")
+    p.kv_put("b", b"2")
+    p.kv_del("a", False)
+    st = persistence.load(sd)
+    assert st is not None
+    assert st.replayed_records == 3 and not st.torn_tail
+    assert st.kv == {"b": b"2"}
+    assert st.incarnation == 0 and not st.clean
+
+
+def test_wal_torn_tail_stops_cleanly(tmp_path):
+    """A record half-written at the SIGKILL must not poison replay:
+    everything before the tear is recovered, the tear is flagged."""
+    sd = str(tmp_path)
+    p = persistence.GCSPersistence(sd)
+    for i in range(5):
+        p.kv_put(f"k{i}", str(i).encode())
+    wal = os.path.join(sd, p._wal_name)
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 7)          # mid-record tear
+    records, torn, valid = persistence.replay_wal(wal)
+    assert torn and len(records) == 4
+    st = persistence.load(sd)
+    assert st.torn_tail and st.replayed_records == 4
+    assert st.kv == {f"k{i}": str(i).encode() for i in range(4)}
+
+
+def test_wal_crc_corruption_stops_cleanly(tmp_path):
+    sd = str(tmp_path)
+    p = persistence.GCSPersistence(sd)
+    for i in range(3):
+        p.kv_put(f"k{i}", b"x")
+    wal = os.path.join(sd, p._wal_name)
+    with open(wal, "r+b") as f:
+        f.seek(os.path.getsize(wal) - 3)
+        f.write(b"\xff\xff\xff")      # flip payload bytes of record 3
+    records, torn, _ = persistence.replay_wal(wal)
+    assert torn and len(records) == 2
+
+
+def test_snapshot_rotates_wal_and_is_atomic(tmp_path):
+    sd = str(tmp_path)
+    p = persistence.GCSPersistence(sd)
+    first_wal = p._wal_name
+    p.kv_put("early", b"1")
+    assert p.snapshot(lambda: {"kv": {"early": b"1"}})
+    p.kv_put("late", b"2")
+    # rotation: only the current (snapshot, wal) pair survives; a
+    # leftover .tmp from a crashed snapshot attempt is ignored by load
+    names = sorted(os.listdir(sd))
+    assert p._wal_name != first_wal and first_wal not in names
+    assert {n for n in names if persistence._GEN_RE.match(n)} == \
+        {p._snap_name, p._wal_name}
+    with open(os.path.join(sd, "snapshot-999999.bin.tmp"), "wb") as f:
+        f.write(b"garbage half-written snapshot")
+    st = persistence.load(sd)
+    assert st.kv == {"early": b"1", "late": b"2"}
+    assert st.replayed_records == 1   # only the post-snapshot record
+
+
+def test_resume_is_crash_safe_before_first_snapshot(tmp_path):
+    """Double-crash safety: a resuming life defers the manifest swap
+    until the restored tables are snapshotted, and never appends into
+    the crashed life's files — so crashing at ANY point during/after
+    resume still recovers the first life's state."""
+    sd = str(tmp_path)
+    p1 = persistence.GCSPersistence(sd, incarnation=0)
+    p1.kv_put("a", b"1")
+    p1.kv_put("b", b"2")
+    gen1_wal = p1._wal_name
+    # crash; resume: writer opens a FRESH generation, old manifest
+    # stays authoritative, old files untouched
+    p2 = persistence.GCSPersistence(sd, incarnation=1, resuming=True)
+    assert p2._wal_name != gen1_wal
+    p2.kv_put("post", b"3")
+    # second crash BEFORE the post-restore snapshot: replay still
+    # yields the FIRST life's state, not an empty generation
+    st = persistence.load(sd)
+    assert st.incarnation == 0 and st.kv == {"a": b"1", "b": b"2"}
+    # with the post-restore snapshot taken, the new generation becomes
+    # authoritative and stale files are swept
+    assert p2.snapshot(lambda: {"kv": {"a": b"1", "b": b"2"},
+                                "objects": {}, "actors": {},
+                                "checkpoints": {}, "named_actors": {},
+                                "nodes": {}, "lineage": {}})
+    st = persistence.load(sd)
+    assert st.incarnation == 1 and st.kv == {"a": b"1", "b": b"2"}
+    names = os.listdir(sd)
+    assert gen1_wal not in names
+    assert {n for n in names if persistence._GEN_RE.match(n)} == \
+        {p2._snap_name, p2._wal_name}
+
+
+def test_fresh_init_wipes_stale_state_dir(tmp_path, fresh):
+    """A fresh (non-resume) init over a dir holding a previous life's
+    state starts clean instead of mixing generations; files that are
+    not ours are untouched."""
+    sd = str(tmp_path)
+    p = persistence.GCSPersistence(sd)
+    p.kv_put("stale", b"1")
+    p.close()
+    other = os.path.join(sd, "notes.txt")
+    with open(other, "w") as f:
+        f.write("keep me")
+    rt = ray_tpu.init(num_cpus=1, state_dir=sd)
+    assert rt.incarnation == 0 and not rt.resumed
+    st = persistence.load(sd)
+    assert st is not None and "stale" not in st.kv
+    assert os.path.exists(other)
+    ray_tpu.shutdown()
+
+
+def test_resume_without_state_raises(tmp_path, fresh):
+    with pytest.raises(RuntimeError, match="no persisted driver state"):
+        ray_tpu.init(num_cpus=1, state_dir=str(tmp_path / "empty"),
+                     resume=True)
+    # resume="auto" starts fresh instead
+    rt = ray_tpu.init(num_cpus=1, state_dir=str(tmp_path / "empty"),
+                      resume="auto")
+    assert not rt.resumed and rt.incarnation == 0
+    ray_tpu.shutdown()
+
+
+# ---------- clean-shutdown resume (in-process) ----------
+
+@ray_tpu.remote
+def _big(seed):
+    return np.full((50_000,), seed, dtype=np.float64)   # > INLINE_MAX
+
+
+@ray_tpu.remote(max_restarts=0, checkpoint_interval_s=0)
+class _Keeper:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def was_restored(self):
+        return ray_tpu.get_runtime_context() \
+            .was_current_actor_reconstructed
+
+    def __ray_save__(self):
+        return {"n": self.n}
+
+    def __ray_restore__(self, state):
+        self.n = state["n"]
+
+
+def test_clean_shutdown_resume_and_named_actor_lifecycle(tmp_path,
+                                                         fresh):
+    """Planned restart: shutdown() snapshots the live cluster; a
+    resume rebuilds it — the named checkpointed actor restores (and is
+    findable BY NAME), a dead actor's name is NOT resurrected, big
+    driver-local task outputs reconstruct via lineage, and put()
+    objects fail with a clean ObjectLostError."""
+    sd = str(tmp_path / "state")
+    ray_tpu.init(num_cpus=2, state_dir=sd)
+    keeper = _Keeper.options(name="keeper").remote()
+    for _ in range(5):
+        ray_tpu.get(keeper.bump.remote(), timeout=60)
+    doomed = _Keeper.options(name="doomed").remote()
+    ray_tpu.get(doomed.value.remote(), timeout=60)
+    ray_tpu.kill(doomed)
+    big_ref = _big.remote(3)
+    (val,) = ray_tpu.get([big_ref], timeout=60)
+    assert float(val[0]) == 3.0
+    put_ref = ray_tpu.put(np.ones(30_000))
+    ray_tpu.wait([put_ref], timeout=60)
+    time.sleep(0.5)                    # checkpoint + WAL settle
+    ray_tpu.shutdown()
+
+    rt = ray_tpu.init(num_cpus=2, state_dir=sd, resume=True)
+    assert rt.resumed and rt.incarnation == 1
+    # named actor restored from its checkpoint, findable by name
+    k2 = ray_tpu.get_actor("keeper", timeout=30)
+    assert ray_tpu.get(k2.value.remote(), timeout=60) == 5
+    assert ray_tpu.get(k2.was_restored.remote(), timeout=60) is True
+    # the dead actor's name is gone for lookup...
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("doomed", timeout=1.0)
+    # ...and stays DEAD in the table
+    aid = rt.gcs.named_actors.get(("default", "doomed"))
+    assert aid is not None and rt.gcs.actors[aid].state == "DEAD"
+    # ...so a NEW actor may take the name
+    fresh_doomed = _Keeper.options(name="doomed").remote()
+    assert ray_tpu.get(fresh_doomed.value.remote(), timeout=60) == 0
+    # driver-local payload died with the old store: lineage re-executes
+    val2 = ray_tpu.get(big_ref, timeout=90)
+    assert val2.shape == (50_000,) and float(val2[7]) == 3.0
+    evs = state_mod.list_events(
+        ids=[big_ref.id], types=["object.reconstruct"])
+    assert len(evs) >= 1
+    # put() objects have no lineage: clean error, not a hang
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(put_ref, timeout=30)
+    summary = state_mod.persistence_summary()
+    assert summary["enabled"] and summary["resumed"]
+    assert summary["driver_incarnation"] == 1
+    ray_tpu.shutdown()
+
+
+def test_live_snapshot_rotation_and_health_surface(tmp_path, fresh,
+                                                   monkeypatch):
+    """A running driver snapshots on the tick (gcs.snapshot event, WAL
+    rotation) and the state API surfaces persistence health."""
+    monkeypatch.setenv("RAY_TPU_GCS_SNAPSHOT_INTERVAL_S", "0.4")
+    sd = str(tmp_path / "state")
+    rt = ray_tpu.init(num_cpus=2, state_dir=sd)
+
+    @ray_tpu.remote
+    def one(i):
+        return i
+
+    assert ray_tpu.get([one.remote(i) for i in range(8)],
+                       timeout=60) == list(range(8))
+    deadline = time.time() + 20
+    while time.time() < deadline \
+            and rt._persist.snapshots_taken < 1:
+        time.sleep(0.1)
+    assert rt._persist.snapshots_taken >= 1
+    assert state_mod.list_events(types=["gcs.snapshot"])
+    summary = state_mod.persistence_summary()
+    assert summary["enabled"] and not summary["resumed"]
+    assert summary["snapshots_taken"] >= 1
+    assert state_mod.cluster_summary()["persistence"]["enabled"]
+    # the rotated generation replays: snapshot + post-snapshot WAL
+    st = persistence.load(sd)
+    assert st is not None and len(st.lineage) == 8
+    ray_tpu.shutdown()
+
+
+# ---------- SIGKILL resume: zero lost tasks ----------
+
+def test_sigkill_mid_job_resume_zero_lost(tmp_path, fresh):
+    """SIGKILL the driver mid-job; a second process resumes from the
+    WAL, the progress actor restores from its checkpoint, and ONLY the
+    missing indices re-run — every index completes exactly once."""
+    sd = str(tmp_path / "state")
+    progress = str(tmp_path / "progress.txt")
+    script = os.path.join(REPO, "tools", "driver_ft_job.py")
+    total = 24
+    env = _sub_env()
+    p1 = subprocess.Popen(
+        [sys.executable, script, sd, progress, str(total)],
+        env=env, cwd=REPO)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                with open(progress) as f:
+                    if len(f.read().split()) >= total // 3:
+                        break
+            except OSError:
+                pass
+            assert p1.poll() is None, "phase-1 driver exited early"
+            time.sleep(0.02)
+        else:
+            raise AssertionError("phase-1 made no progress")
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+    p2 = subprocess.run(
+        [sys.executable, script, sd, progress, str(total), "--resume"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert p2.returncode == 0, (p2.stdout + p2.stderr)[-1500:]
+    assert f"JOB-COMPLETE total={total} resumed=True incarnation=1" \
+        in p2.stdout, p2.stdout[-500:]
+
+
+# ---------- THE chaos test ----------
+
+_CHAOS_PHASE1 = """
+import os, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.experimental import internal_kv
+from ray_tpu.util.scheduling_strategies import \
+    NodeAffinitySchedulingStrategy
+
+rt = ray_tpu.init(num_cpus=6, state_dir={sd!r},
+                  listen="127.0.0.1:{port}")
+open({drvmark!r}, "w").write("listening")
+deadline = time.time() + 90
+while time.time() < deadline and len(rt.cluster_nodes) < 2:
+    time.sleep(0.05)
+assert len(rt.cluster_nodes) >= 2, "node agent never joined"
+remote_nid = next(n for n in rt.cluster_nodes if n != rt.node_id)
+
+@ray_tpu.remote
+def big(seed):
+    import numpy as np
+    return np.full((50_000,), seed, dtype=np.float64)
+
+remote_ref = None
+for _ in range(10):
+    cand = big.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            remote_nid, soft=True)).remote(7)
+    ray_tpu.wait([cand], timeout=60)
+    if getattr(rt.gcs.objects[cand.id].loc, "node_id", None) \
+            == remote_nid:
+        remote_ref = cand
+        break
+assert remote_ref is not None, "blob never landed on the agent node"
+# this payload must live in the DRIVER's store (it dies with the
+# driver and must come back via lineage reconstruction) — hard-pin
+# it, or the agent's warm worker would win the placement
+local_ref = big.options(
+    scheduling_strategy=NodeAffinitySchedulingStrategy(
+        rt.node_id, soft=False)).remote(3)
+ray_tpu.wait([local_ref], timeout=60)
+assert getattr(rt.gcs.objects[local_ref.id].loc, "node_id", None) \
+    in (None, rt.node_id), "blob never landed on the driver node"
+
+@ray_tpu.remote(name="chaos-acc", checkpoint_interval_s=0)
+class Acc:
+    def __init__(self):
+        self.seen = dict()
+    def add(self, i):
+        self.seen[i] = True
+        return len(self.seen)
+    def snapshot(self):
+        return sorted(self.seen)
+    def __ray_save__(self):
+        return dict(seen=self.seen)
+    def __ray_restore__(self, st):
+        self.seen = st["seen"]
+
+@ray_tpu.remote
+def work(i):
+    return i
+
+acc = Acc.remote()
+for i in range(12):
+    ray_tpu.get(acc.add.remote(
+        ray_tpu.get(work.remote(i), timeout=60)), timeout=60)
+
+@serve.deployment(name="echo")
+def echo(body):
+    return dict(echo=body)
+
+serve.run(echo.bind(), name="chaos", route_prefix="/chaos")
+h = serve.get_app_handle("chaos")
+assert h.remote(dict(x=1)).result(timeout_s=30) == dict(echo=dict(x=1))
+
+internal_kv._internal_kv_put(b"chaos:remote_ref",
+                             remote_ref.id.encode())
+internal_kv._internal_kv_put(b"chaos:local_ref", local_ref.id.encode())
+serve.status()    # a controller call past the checkpoint throttle,
+                  # so the deployed targets are in the persisted blob
+time.sleep(0.7)   # let checkpoints + WAL land
+open({mark!r}, "w").write("ready")
+j = 100
+while True:       # tasks stay IN FLIGHT until the SIGKILL
+    refs = [work.remote(j + k) for k in range(4)]
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+    j += 4
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_event(types, ids=None, timeout=60):
+    from ray_tpu.util import state as state_mod
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        evs = state_mod.list_events(ids=ids, types=types)
+        if evs:
+            return evs
+        time.sleep(0.1)
+    raise AssertionError(f"no {types} event within {timeout}s")
+
+
+def test_chaos_driver_sigkill_restart(tmp_path, fresh):
+    """The acceptance chaos test: SIGKILL the driver mid-job (tasks in
+    flight, a checkpointed named actor alive, a serve deployment
+    running, a node agent holding payloads), resume, and assert the
+    job completes with zero lost tasks, the actor resumed from its
+    checkpoint, the agent reattached with its objects intact, the
+    named serve endpoint answers again, and the event store + post-
+    mortem bundle show the driver.restart -> node.reattach ->
+    object.reconstruct / actor.restore chain."""
+    sd = str(tmp_path / "state")
+    mark = str(tmp_path / "ready")
+    drvmark = str(tmp_path / "listening")
+    port = _free_port()
+    script = str(tmp_path / "phase1.py")
+    with open(script, "w") as f:
+        f.write(_CHAOS_PHASE1.format(repo=REPO, sd=sd, port=port,
+                                     mark=mark, drvmark=drvmark))
+    env = _sub_env()
+    env["RAY_TPU_NODE_REJOIN_S"] = "120"
+    driver = subprocess.Popen([sys.executable, script], env=env,
+                              cwd=REPO)
+    agent = None
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline and not os.path.exists(drvmark):
+            assert driver.poll() is None, "phase-1 driver died early"
+            time.sleep(0.05)
+        assert os.path.exists(drvmark), "driver never listened"
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node",
+             f"tcp://127.0.0.1:{port}", "--num-cpus", "2"],
+            env=env, cwd=REPO)
+        deadline = time.time() + 120
+        while time.time() < deadline and not os.path.exists(mark):
+            assert driver.poll() is None, "phase-1 driver died early"
+            assert agent.poll() is None, "node agent died early"
+            time.sleep(0.05)
+        assert os.path.exists(mark), "phase 1 never reached ready"
+        # ---- the crash: SIGKILL with tasks in flight
+        driver.send_signal(signal.SIGKILL)
+        driver.wait(timeout=30)
+
+        # ---- phase 2: THIS process resumes the cluster
+        rt = ray_tpu.init(num_cpus=6, state_dir=sd, resume=True,
+                          listen=f"127.0.0.1:{port}")
+        assert rt.resumed and rt.incarnation == 1
+        _wait_event(["driver.restart"], timeout=30)
+        # the agent (which never died) reattaches with its store
+        _wait_event(["node.reattach"], timeout=90)
+
+        from ray_tpu.experimental import internal_kv
+        from ray_tpu.core.object_ref import ObjectRef
+        remote_oid = internal_kv._internal_kv_get(
+            b"chaos:remote_ref").decode()
+        local_oid = internal_kv._internal_kv_get(
+            b"chaos:local_ref").decode()
+        # the agent-held payload became READY AGAIN (no reconstruction)
+        rv = ray_tpu.get(ObjectRef(remote_oid), timeout=90)
+        assert float(rv[0]) == 7.0 and rv.shape == (50_000,)
+        from ray_tpu.util import state as state_mod
+        assert not state_mod.list_events(
+            ids=[remote_oid], types=["object.reconstruct"]), \
+            "agent-held object should reattach, not reconstruct"
+        # the driver-local payload reconstructs via lineage
+        lv = ray_tpu.get(ObjectRef(local_oid), timeout=120)
+        assert float(lv[0]) == 3.0
+        _wait_event(["object.reconstruct"], ids=[local_oid],
+                    timeout=30)
+        # the checkpointed actor resumed: pre-kill progress intact,
+        # and the job finishes with zero lost indices
+        acc = ray_tpu.get_actor("chaos-acc", timeout=60)
+        seen = ray_tpu.get(acc.snapshot.remote(), timeout=90)
+        assert set(range(12)) <= set(seen), seen
+        aid = rt.gcs.lookup_named_actor("default", "chaos-acc")
+        _wait_event(["actor.restore"], ids=[aid], timeout=60)
+
+        @ray_tpu.remote
+        def work(i):
+            return i
+
+        for i in range(12, 30):
+            if i not in seen:
+                ray_tpu.get(acc.add.remote(
+                    ray_tpu.get(work.remote(i), timeout=60)),
+                    timeout=60)
+        final = ray_tpu.get(acc.snapshot.remote(), timeout=60)
+        assert set(range(30)) <= set(final), final
+
+        # the named serve endpoint answers again (controller restored
+        # its deployment targets and started fresh replicas)
+        from ray_tpu import serve
+        deadline = time.time() + 120
+        answer = None
+        while time.time() < deadline:
+            try:
+                h = serve.get_app_handle("chaos")
+                answer = h.remote({"x": 2}).result(timeout_s=10)
+                break
+            except Exception:
+                time.sleep(0.25)
+        assert answer == {"echo": {"x": 2}}, answer
+
+        # post-mortem bundle: the recovery chain in one artifact
+        from ray_tpu.observability.forensics import build_post_mortem
+        owner = rt.gcs.objects[local_oid].owner_task
+        bundle = build_post_mortem(owner)
+        rec_types = {ev.get("type")
+                     for ev in bundle["driver_recovery"]["events"]}
+        assert "driver.restart" in rec_types
+        assert "node.reattach" in rec_types
+        chain_types = {ev.get("type") for ev in bundle["events"]}
+        assert "object.reconstruct" in chain_types
+        assert bundle["driver_recovery"]["incarnation"] == 1
+        stats = bundle["driver_recovery"]["persistence"]
+        assert stats["replayed_records"] > 0
+        serve.shutdown()
+    finally:
+        for proc in (driver, agent):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        ray_tpu.shutdown()

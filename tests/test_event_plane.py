@@ -62,6 +62,15 @@ def test_catalog_requires_recovery_plane_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_driver_fault_tolerance_events():
+    """The driver-restart chain (persisted-GCS resume -> node reattach
+    -> snapshot rotation) is asserted by tests/test_driver_ft.py and
+    rendered in post-mortem bundles under `driver_recovery` — the
+    catalog must keep carrying it."""
+    for required in ("driver.restart", "node.reattach", "gcs.snapshot"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_catalog_requires_serve_fault_tolerance_events():
     """The serve FT plane's chain (health probe -> replacement ->
     failover, plus shedding and the wedged watchdog) is asserted by

@@ -78,6 +78,18 @@ def test_catalog_requires_serve_fault_tolerance_metrics():
         assert mcat.BUILTIN[required][0] == "counter", required
 
 
+def test_catalog_requires_driver_persistence_metrics():
+    """The control-plane persistence gauges/counters back the state
+    API's persistence_summary and the driver_ft bench — the catalog
+    must keep carrying them."""
+    for required, kind in (("ray_tpu_driver_incarnation", "gauge"),
+                           ("ray_tpu_wal_records", "gauge"),
+                           ("ray_tpu_wal_bytes", "gauge"),
+                           ("ray_tpu_gcs_snapshots_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_no_uncataloged_builtin_metric_literals():
     """Lint: any Counter/Gauge/Histogram constructed with a literal name
     inside the package must use a cataloged ray_tpu_ name (user-facing

@@ -470,7 +470,11 @@ def test_llm_engine_top_p_and_stop_ids(tiny_llm):
         stopped = eng.generate_sync(prompt, max_new_tokens=6,
                                     temperature=0.0,
                                     stop_token_ids=[stop])
-        assert stopped == greedy[:3]
+        # the stream ends the moment the stop id is PRODUCED — at its
+        # first occurrence, which need not be index 2 (the debug-size
+        # model can emit the same token repeatedly; jax-version logit
+        # drift made that the actual greedy output here)
+        assert stopped == greedy[:greedy.index(stop) + 1]
         # invalid top_p rejected at submit
         with pytest.raises(ValueError):
             eng.submit(prompt, top_p=0.0)

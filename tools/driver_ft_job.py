@@ -1,0 +1,68 @@
+"""A resumable driver job for the driver-fault-tolerance bench/tests.
+
+Phase 1 (no --resume): init with a state dir, create a checkpointed
+named progress actor, run `total` tasks feeding it, then (when killed
+mid-loop by the parent) leave everything to the WAL. Phase 2
+(--resume): init(resume=True), recover the progress actor from its
+__ray_save__ checkpoint, run ONLY the missing indices, and assert every
+index completed exactly once — the "zero lost work" contract.
+
+Usage: driver_ft_job.py <state_dir> <progress_file> <total> [--resume]
+"""
+import sys
+
+STATE_DIR, PROGRESS, TOTAL = sys.argv[1], sys.argv[2], int(sys.argv[3])
+RESUME = "--resume" in sys.argv[4:]
+
+import ray_tpu  # noqa: E402
+
+
+@ray_tpu.remote
+def work(i):
+    return i
+
+
+@ray_tpu.remote(name="dft-progress", checkpoint_interval_s=0)
+class Progress:
+    def __init__(self):
+        self.done = {}
+
+    def record(self, i):
+        self.done[i] = self.done.get(i, 0) + 1
+        return len(self.done)
+
+    def snapshot(self):
+        return dict(self.done)
+
+    def __ray_save__(self):
+        return {"done": self.done}
+
+    def __ray_restore__(self, state):
+        self.done = state["done"]
+
+
+def main():
+    rt = ray_tpu.init(num_cpus=2, state_dir=STATE_DIR,
+                      resume=RESUME)
+    if RESUME:
+        acc = ray_tpu.get_actor("dft-progress", timeout=60)
+        done = ray_tpu.get(acc.snapshot.remote(), timeout=60)
+    else:
+        acc = Progress.remote()
+        done = {}
+    todo = [i for i in range(TOTAL) if i not in done]
+    for i in todo:
+        v = ray_tpu.get(work.remote(i), timeout=60)
+        ray_tpu.get(acc.record.remote(v), timeout=60)
+        with open(PROGRESS, "a") as f:
+            f.write(f"{i} ")
+    final = ray_tpu.get(acc.snapshot.remote(), timeout=60)
+    missing = [i for i in range(TOTAL) if i not in final]
+    assert not missing, f"lost tasks: {missing}"
+    print(f"JOB-COMPLETE total={len(final)} resumed={rt.resumed} "
+          f"incarnation={rt.incarnation}", flush=True)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
