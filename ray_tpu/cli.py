@@ -424,6 +424,17 @@ def cmd_serve(args):
         # in-process runtime would report an empty fresh cluster)
         print(json.dumps(_fetch(args.address, "/api/serve"), indent=2))
         return
+    if args.serve_cmd == "router":
+        # scale-out router table: ring membership, registered prefixes
+        # + owners, recent sticky session bindings
+        print(json.dumps(_fetch(args.address, "/api/serve/router"),
+                         indent=2))
+        return
+    if args.serve_cmd == "autoscaler":
+        # autoscaler targets + the recent scale_up/scale_down decisions
+        print(json.dumps(_fetch(args.address, "/api/serve/autoscaler"),
+                         indent=2))
+        return
 
 
 def main(argv=None):
@@ -518,6 +529,14 @@ def main(argv=None):
                        "dashboard); stop a served app with Ctrl-C on "
                        "its `serve run` process")
     svst.set_defaults(fn=cmd_serve)
+    svrt = svsub.add_parser(
+        "router", help="scale-out router table: replica ring, "
+                       "registered prefixes + owners, sticky bindings")
+    svrt.set_defaults(fn=cmd_serve)
+    svas = svsub.add_parser(
+        "autoscaler", help="serve autoscaler targets + recent "
+                           "scale_up/scale_down decisions")
+    svas.set_defaults(fn=cmd_serve)
 
     jp = sub.add_parser("job", help="run a driver script as a job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

@@ -29,6 +29,16 @@ class AutoscalerConfig:
     idle_timeout_s: float = 300.0
 
 
+def upscale_step(have: int, want: int, upscaling_speed: float) -> int:
+    """Launches allowed this round: at most upscaling_speed * existing
+    nodes (floor 1, so a cold pool can still start). Shared by the
+    node-scaling plan() below and the serve replica autoscaler, which
+    models replicas as nodes of a per-deployment NodeType."""
+    if want <= 0:
+        return 0
+    return min(want, max(1, int(upscaling_speed * max(1, have))))
+
+
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) >= v for k, v in demand.items())
 
@@ -109,8 +119,8 @@ class Autoscaler:
             want = wanted.get(nt.name, 0)
             have = counts.get(nt.name, 0)
             room = max(0, nt.max_workers - have)
-            speed_cap = max(1, int(cfg.upscaling_speed * max(1, have)))
-            launches[nt.name] = min(want, room, speed_cap)
+            launches[nt.name] = min(
+                upscale_step(have, want, cfg.upscaling_speed), room)
             # honor min_workers even with zero demand
             if have + launches[nt.name] < nt.min_workers:
                 launches[nt.name] = min(nt.min_workers - have, room)

@@ -448,6 +448,11 @@ class DriverRuntime:
         self.report_handlers["sys.metrics"] = self._on_worker_metrics
         self.report_handlers["sys.spans"] = self._on_worker_spans
         self.report_handlers["sys.events"] = self._on_worker_events
+        # control-plane actors (the serve controller's autoscaler) need
+        # the node table and placement-group ops; both live only in the
+        # driver, so workers reach them over report_sync channels
+        self.report_handlers["sys.cluster_view"] = self._sys_cluster_view
+        self.report_handlers["sys.pg"] = self._sys_pg
 
         # restored remote-held objects parked until their node
         # reattaches: nid -> [(oid, loc), ...]; past the grace deadline
@@ -3737,6 +3742,38 @@ class DriverRuntime:
         ae = self.gcs.actors[aid]
         return (aid, ae.class_name,
                 getattr(ae.create_spec, "method_opts", {}) or {})
+
+    def _sys_cluster_view(self, _wid, _payload) -> List[Dict]:
+        """report_sync channel: live node capacity views for worker-side
+        schedulers (the serve autoscaler's bin-pack feasibility)."""
+        views = []
+        for ns in list(self.cluster_nodes.values()):
+            if not ns.alive:
+                continue
+            views.append({"id": ns.node_id, "total": dict(ns.total),
+                          "avail": dict(ns.avail),
+                          "labels": dict(getattr(ns, "labels", {}) or {}),
+                          "is_driver": ns.node_id == self.node_id})
+        return views
+
+    def _sys_pg(self, _wid, payload):
+        """report_sync channel: placement-group create/remove/table from
+        worker processes (actors only get `.pg_id` back — bundle node
+        resolution happens at scheduling time like every other pg)."""
+        op = payload[0]
+        if op == "create":
+            _, bundles, strategy, name = payload
+            pg = self.placement_group(bundles, strategy, name)
+            return {"pg_id": pg.pg_id}
+        if op == "remove":
+            self.remove_placement_group(payload[1])
+            return True
+        if op == "table":
+            return {pg.pg_id: {"name": pg.name, "strategy": pg.strategy,
+                               "state": pg.state,
+                               "bundles": list(pg.bundles)}
+                    for pg in list(self.placement_groups.values())}
+        raise ValueError(f"unknown sys.pg op {op!r}")
 
     def placement_group(self, bundles, strategy="PACK", name="") -> "PlacementGroupState":
         from .ids import new_placement_group_id  # noqa: PLC0415

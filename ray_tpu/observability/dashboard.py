@@ -139,6 +139,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(timeline_mod.timeline_events())
             elif route == "/api/serve":
                 self._json(_serve_status())
+            elif route == "/api/serve/router":
+                self._json(state_mod.serve_router_table())
+            elif route == "/api/serve/autoscaler":
+                self._json(state_mod.serve_autoscaler_status())
             elif route == "/api/jobs":
                 self._json(_jobs().list_jobs())
             elif route.startswith("/api/jobs/"):
@@ -169,6 +173,8 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/objects", "/api/workers",
                                        "/api/placement_groups",
                                        "/api/serve",
+                                       "/api/serve/router",
+                                       "/api/serve/autoscaler",
                                        "/api/summary/tasks",
                                        "/api/summary/actors",
                                        "/api/summary/objects",

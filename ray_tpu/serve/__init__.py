@@ -14,7 +14,7 @@ from ..exceptions import (DeadlineExceededError, EngineWedgedError,
                           NoCapacityError, ReplicaDrainingError,
                           StreamInterruptedError)
 from .api import (run, start, status, delete, shutdown, get_app_handle,
-                  get_deployment_handle)
+                  get_deployment_handle, register_prefix)
 from .asgi import ingress
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
@@ -28,7 +28,7 @@ deployment = deployment_decorator
 
 
 def __getattr__(name):
-    if name in ("llm", "chaos"):
+    if name in ("llm", "chaos", "router", "autoscaler"):
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod
         return mod
@@ -45,4 +45,5 @@ __all__ = [
     "ReplicaDrainingError", "StreamInterruptedError",
     "get_request_deadline", "remaining_budget",
     "get_multiplexed_model_id", "multiplexed", "llm", "chaos",
+    "register_prefix", "router", "autoscaler",
 ]

@@ -168,6 +168,30 @@ def get_deployment_handle(deployment_name: str,
     return DeploymentHandle(deployment_name, app_name)
 
 
+def register_prefix(prefix, *, key: Optional[str] = None,
+                    app_name: str = _DEFAULT_APP,
+                    deployment_name: Optional[str] = None) -> str:
+    """Register a shared prompt prefix (e.g. a system prompt) against a
+    deployment for warm-KV affinity routing.
+
+    The controller pre-fills it on the replica that owns the returned
+    affinity key on the routing hash ring, and on every replica started
+    later (replacements / scale-ups). Requests whose prompt starts with
+    the prefix are then sticky-routed to the warm replica by every
+    handle and proxy (serve/router.py). The deployment's callable must
+    expose a `register_prefix` method (LLMServer does). Returns the
+    affinity key."""
+    import ray_tpu
+    ctrl = _get_or_start_controller()
+    if deployment_name is None:
+        ingress = ray_tpu.get(ctrl.get_ingress_targets.remote())
+        deployment_name = ingress.get(app_name)
+        if deployment_name is None:
+            raise KeyError(f"no application named {app_name!r}")
+    return ray_tpu.get(ctrl.register_prefix.remote(
+        app_name, deployment_name, prefix, key))
+
+
 def shutdown():
     """Tear down all serve apps and the controller."""
     import ray_tpu

@@ -24,6 +24,15 @@ class AutoscalingConfig:
     downscaling_factor: float = 1.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # step clamp fed into core/autoscaler.py's policy: at most
+    # max(1, int(upscaling_speed * current)) new replicas per decision
+    upscaling_speed: float = 1.0
+    # SLO terms (serve/autoscaler.py): each may only RAISE the desired
+    # count computed from the load formula above. None disables a term.
+    target_queue_depth: Optional[float] = None   # engine queue / replica
+    ttft_slo_ms: Optional[float] = None
+    tpot_slo_ms: Optional[float] = None
+    kv_util_target: Optional[float] = 0.9        # KV pages in use / pool
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -68,6 +77,10 @@ class DeploymentConfig:
     health_check_timeout_s: float = 5.0
     health_check_failure_threshold: int = 3
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    # when set (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD), autoscale-ups
+    # reserve a placement group with one bundle per new replica before
+    # starting them (multi-host capable placement)
+    placement_group_strategy: Optional[str] = None
 
     def __post_init__(self):
         if isinstance(self.autoscaling_config, dict):
@@ -75,6 +88,12 @@ class DeploymentConfig:
                 **self.autoscaling_config)
         if self.num_replicas is not None and self.num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        if self.placement_group_strategy is not None:
+            from ..util.placement_group import VALID_STRATEGIES
+            if self.placement_group_strategy not in VALID_STRATEGIES:
+                raise ValueError(
+                    f"placement_group_strategy must be one of "
+                    f"{VALID_STRATEGIES}")
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -117,3 +136,9 @@ class ReplicaInfo:
     # graceful drain (rolling update / scale-down / shutdown)
     draining_since: float = 0.0  # 0 = not draining
     drain_ref: Any = None        # outstanding ongoing-count ObjectRef
+    # live autoscale metrics (controller reconcile loop; non-blocking)
+    metrics_ref: Any = None      # outstanding get_autoscale_metrics ref
+    metrics_dispatch_ts: float = 0.0
+    last_metrics: Optional[Dict[str, Any]] = None
+    # placement-group reservation this replica was started into
+    pg_id: Optional[str] = None

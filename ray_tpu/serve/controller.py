@@ -8,6 +8,7 @@ so control-plane progress never depends on incoming calls.
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -17,6 +18,13 @@ from .config import DeploymentConfig, ReplicaInfo
 
 CONTROLLER_NAME = "_SERVE_CONTROLLER"
 _LOOP_PERIOD_S = 0.25
+# how often each RUNNING replica is polled for autoscale metrics when
+# the deployment sets no autoscaling_config (victim selection for
+# least-busy scale-down still wants a load sample)
+_METRICS_PERIOD_S = 0.5
+# sticky session/prefix bindings remembered per deployment for the
+# state API / dashboard router table
+_BINDINGS_CAP = 1024
 
 
 def _env_float(name: str, default: float) -> float:
@@ -60,6 +68,18 @@ class _DeploymentState:
         self._last_metrics: Dict[str, float] = {}
         self._ongoing_history: List[tuple] = []  # (ts, total_ongoing)
         self._last_scale_ts = 0.0
+        # shared prompt prefixes registered against this deployment
+        # (serve.register_prefix): rows {"key", "prefix"}. Pushed to
+        # the affinity ring owner at registration and to every replica
+        # that starts afterwards, so warmth survives replacement.
+        self.registered_prefixes: List[dict] = []
+        # placement-group bundles reserved by a scale-up, consumed one
+        # per _start_replica: [(pg_id, bundle_index), ...]
+        self._pending_pg_bundles: List[tuple] = []
+        # sticky-routing bindings reported by handles (router table)
+        self.bindings: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self.binding_counts: Dict[str, int] = {}
         self._start_failures = 0  # consecutive replica-init failures
         # replica ids killed for unhealthiness/death whose replacement
         # hasn't started yet: _start_replica pops one per start and
@@ -84,11 +104,19 @@ class ServeController:
     """Actor. Owns all deployment state; creates/destroys replica actors."""
 
     def __init__(self, http_options: Optional[dict] = None):
+        from .autoscaler import ServeAutoscaler
         self._deployments: Dict[str, _DeploymentState] = {}  # key: app/name
         self._apps: Dict[str, List[str]] = {}  # app -> deployment keys
         # deployment states removed from _deployments that still have
         # STOPPING replicas draining; the control loop finishes them
         self._stopping_states: List[_DeploymentState] = []
+        self._autoscaler = ServeAutoscaler()
+        # placement-group refcounts: pg removed when its last replica is
+        # gone (pg_id -> live replica count); removals queue here and
+        # the control loop drains them OUTSIDE the lock (the removal is
+        # a driver round trip)
+        self._pg_refs: Dict[str, int] = {}
+        self._pgs_to_remove: List[str] = []
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._http_options = http_options or {}
@@ -169,7 +197,133 @@ class ServeController:
                     "max_ongoing_requests":
                         st.config.max_ongoing_requests,
                     "max_queued_requests":
-                        st.config.max_queued_requests}
+                        st.config.max_queued_requests,
+                    "registered_prefixes":
+                        [dict(row) for row in st.registered_prefixes]}
+
+    # ---- scale-out router surface -----------------------------------------
+    def register_prefix(self, app_name: str, deployment_name: str,
+                        prefix, key: Optional[str] = None) -> str:
+        """Register a shared prompt prefix against a deployment.
+
+        The prefix is pushed (via the deployment callable's
+        `register_prefix` method, e.g. LLMServer's) to the replica that
+        owns `key` on the affinity hash ring — the SAME deterministic
+        ring every handle routes prefix-keyed requests with, so traffic
+        lands on the warm replica without coordination — and to every
+        replica that starts later (replacements, scale-ups), so warmth
+        survives replica death. Returns the affinity key."""
+        from .router import prefix_key, ring_order
+        if key is None:
+            key = prefix_key(prefix)
+        row = {"key": key, "prefix": prefix}
+        with self._lock:
+            st = self._deployments.get(f"{app_name}/{deployment_name}")
+            if st is None:
+                raise KeyError(
+                    f"no deployment {app_name}/{deployment_name}")
+            if any(r["key"] == key for r in st.registered_prefixes):
+                return key               # idempotent
+            st.registered_prefixes.append(row)
+            running = [(r.replica_id, r.actor_handle)
+                       for r in st.replicas if r.state == "RUNNING"]
+        order = ring_order(key, [rid for rid, _h in running])
+        if order:
+            target = dict(running)[order[0]]
+            try:
+                # fire-and-forget: a failed push only costs the first
+                # request a cold prefill (the replica registers lazily
+                # through its own register_prefix handler)
+                target.handle_request.remote(
+                    "register_prefix", (dict(row),), {})
+            except Exception:  # noqa: BLE001
+                pass
+        return key
+
+    def note_session_binding(self, app_name: str, deployment_name: str,
+                             key: str, replica_id: str,
+                             outcome: str) -> None:
+        """Handles report sticky-binding transitions here (best-effort)
+        so the router table is centrally introspectable — and so a
+        registered prefix FOLLOWS its key: when a key re-binds (its
+        warm replica died or was diverted), the prefix is pushed to the
+        new home, which re-warms it for every request after the first.
+        Replacement replicas get prefixes eagerly in _check_started;
+        this covers keys remapped onto pre-existing replicas."""
+        push = None
+        with self._lock:
+            st = self._deployments.get(f"{app_name}/{deployment_name}")
+            if st is None:
+                return
+            st.bindings[key] = {"replica_id": replica_id,
+                                "outcome": outcome, "ts": time.time()}
+            st.bindings.move_to_end(key)
+            while len(st.bindings) > _BINDINGS_CAP:
+                st.bindings.popitem(last=False)
+            st.binding_counts[outcome] = \
+                st.binding_counts.get(outcome, 0) + 1
+            row = next((p for p in st.registered_prefixes
+                        if p["key"] == key), None)
+            if row is not None:
+                handle = next((r.actor_handle for r in st.replicas
+                               if r.replica_id == replica_id
+                               and r.state == "RUNNING"), None)
+                if handle is not None:
+                    push = (handle, dict(row))
+        if push is not None:
+            try:
+                # idempotent replica-side (keyed); a lost push costs
+                # cold prefills until the next binding transition
+                push[0].handle_request.remote(
+                    "register_prefix", (push[1],), {})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_router_table(self) -> Dict[str, Any]:
+        """Per-deployment routing view: RUNNING replica ids (the hash
+        ring membership), registered prefixes, and the recent sticky
+        bindings handles reported."""
+        from .router import ring_order
+        with self._lock:
+            out = {}
+            for dep_key, st in self._deployments.items():
+                running = [r.replica_id for r in st.replicas
+                           if r.state == "RUNNING"]
+                out[dep_key] = {
+                    "replicas": running,
+                    "registered_prefixes": [
+                        {"key": row["key"],
+                         "owner": (ring_order(row["key"], running) or
+                                   [None])[0]}
+                        for row in st.registered_prefixes],
+                    "bindings": {k: dict(v)
+                                 for k, v in st.bindings.items()},
+                    "binding_transitions": dict(st.binding_counts),
+                }
+            return out
+
+    def get_autoscaler_status(self) -> Dict[str, Any]:
+        """Autoscaler targets + the recent decision log (scale_up /
+        scale_down rows with reasons and placement annotations)."""
+        with self._lock:
+            per = {}
+            for dep_key, st in self._deployments.items():
+                ac = st.config.autoscaling_config
+                per[dep_key] = {
+                    "target_num_replicas": st.target_num,
+                    "num_running": sum(1 for r in st.replicas
+                                       if r.state == "RUNNING"),
+                    "autoscaling": None if ac is None else {
+                        "min_replicas": ac.min_replicas,
+                        "max_replicas": ac.max_replicas,
+                        "target_ongoing_requests":
+                            ac.target_ongoing_requests,
+                        "ttft_slo_ms": ac.ttft_slo_ms,
+                        "tpot_slo_ms": ac.tpot_slo_ms,
+                        "target_queue_depth": ac.target_queue_depth},
+                }
+            return {"deployments": per,
+                    "decisions": self._autoscaler.snapshot()}
 
     def get_app_status(self, app_name: str) -> dict:
         with self._lock:
@@ -276,6 +430,8 @@ class ServeController:
                         "route_prefix": st.route_prefix,
                         "is_ingress": st.is_ingress,
                         "is_asgi": st.is_asgi,
+                        "registered_prefixes":
+                            [dict(p) for p in st.registered_prefixes],
                     })
                 apps[app] = rows
             return {"apps": apps,
@@ -287,6 +443,15 @@ class ServeController:
         for app, deployments in (saved.get("apps") or {}).items():
             if deployments:
                 self.deploy_application(app, deployments)
+                # restore registered prefixes: replicas started by the
+                # redeploy get them pushed on the _check_started path
+                with self._lock:
+                    for d in deployments:
+                        st = self._deployments.get(f"{app}/{d['name']}")
+                        if st is not None:
+                            st.registered_prefixes = [
+                                dict(p) for p in
+                                (d.get("registered_prefixes") or [])]
 
     # ---- reconcile loop ---------------------------------------------------
     def _control_loop(self) -> None:
@@ -298,7 +463,11 @@ class ServeController:
                 for key in keys:
                     # metric collection blocks on replicas -> outside lock
                     self._collect_autoscale_metrics(ray_tpu, key)
+                    # autoscale decisions do driver round trips
+                    # (feasibility, pg reserve) -> phased locking inside
+                    self._autoscale_step(key)
                     self._reconcile(ray_tpu, key)
+                self._drain_pg_removals()
                 # deployments deleted mid-drain: their STOPPING replicas
                 # still need the drain poll until done/timeout
                 with self._lock:
@@ -325,7 +494,6 @@ class ServeController:
             self._check_started(ray_tpu, st)
             self._probe_health(ray_tpu, st)
             self._check_draining(ray_tpu, st)
-            self._apply_autoscale_decision(st)
             running = [r for r in st.replicas if r.state == "RUNNING"]
             starting = [r for r in st.replicas if r.state == "STARTING"]
             # version rollout: replace at most one stale replica per tick,
@@ -347,9 +515,13 @@ class ServeController:
                         self._start_replica(ray_tpu, st)
                 # else: stay DEPLOY_FAILED until a redeploy resets backoff
             elif len(live) > st.target_num:
-                # prefer stopping stale, then newest
+                # prefer stopping stale versions, then the replica with
+                # the FEWEST in-flight requests (live autoscale sample)
+                # — draining a busy replica while an idle peer survives
+                # wastes the drain window and fails more streams over
                 extras = sorted(
                     live, key=lambda r: (r.version == st.version,
+                                         self._replica_load(r),
                                          r.replica_id))
                 for r in extras[:len(live) - st.target_num]:
                     self._stop_replica(ray_tpu, st, r)
@@ -360,10 +532,17 @@ class ServeController:
             st.replicas = [r for r in st.replicas if r.state != "DEAD"]
 
     def _start_replica(self, ray_tpu, st: _DeploymentState) -> None:
+        from .autoscaler import PlacementGroupRef
         from .replica import Replica
         rid = st.next_replica_id()
         opts = dict(st.config.ray_actor_options)
         opts.setdefault("max_concurrency", st.config.max_ongoing_requests + 8)
+        pg_id = None
+        if st._pending_pg_bundles:
+            # consume one reserved bundle from the latest scale-up batch
+            pg_id, bundle_index = st._pending_pg_bundles.pop(0)
+            opts["placement_group"] = PlacementGroupRef(pg_id)
+            opts["bundle_index"] = bundle_index
         handle = ray_tpu.remote(Replica).options(**opts).remote(
             st.name, rid, st.callable_bytes, st.init_args, st.init_kwargs,
             user_config=st.config.user_config,
@@ -371,7 +550,9 @@ class ServeController:
         info = ReplicaInfo(replica_id=rid, deployment_name=st.name,
                            app_name=st.app_name, version=st.version,
                            actor_handle=handle, state="STARTING",
-                           start_ref=handle.ready.remote())
+                           start_ref=handle.ready.remote(), pg_id=pg_id)
+        if pg_id:
+            self._pg_refs[pg_id] = self._pg_refs.get(pg_id, 0) + 1
         st.replicas.append(info)
         if st._pending_replacements:
             old = st._pending_replacements.pop(0)
@@ -392,11 +573,24 @@ class ServeController:
                     ray_tpu.get(r.start_ref)
                     r.state = "RUNNING"
                     st._start_failures = 0
+                    # propagate registered prefixes: every replica that
+                    # starts after a register_prefix() call pre-warms
+                    # them, so affinity survives replacement/scale-up
+                    for row in st.registered_prefixes:
+                        try:
+                            r.actor_handle.handle_request.remote(
+                                "register_prefix", (dict(row),), {})
+                        except Exception:  # noqa: BLE001  lazy re-warm
+                            pass
                 except Exception as e:  # noqa: BLE001  init failed
                     r.state = "DEAD"
                     st._start_failures += 1
                     st.status = "DEPLOY_FAILED"
                     st.message = repr(e)
+                    # a failed init never reaches _kill_replica, so its
+                    # pg reservation must be released here or it leaks
+                    self._release_pg(r.pg_id)
+                    r.pg_id = None
 
     def _stop_replica(self, ray_tpu, st: _DeploymentState,
                       r: ReplicaInfo, graceful: bool = True) -> None:
@@ -422,6 +616,8 @@ class ServeController:
             ray_tpu.kill(r.actor_handle)
         except Exception:  # noqa: BLE001
             pass
+        self._release_pg(r.pg_id)
+        r.pg_id = None
 
     def _check_draining(self, ray_tpu, st: _DeploymentState) -> None:
         """Drive STOPPING replicas to DEAD: poll the ongoing-request
@@ -539,6 +735,16 @@ class ServeController:
         st = self._deployments.pop(key, None)
         if st is None:
             return
+        # pg bundles reserved by a scale-up whose replicas never
+        # started: nothing will consume them now — queue the empty pgs
+        # for removal or their reserved capacity leaks forever
+        if st._pending_pg_bundles:
+            stale = {pg for pg, _i in st._pending_pg_bundles}
+            st._pending_pg_bundles.clear()
+            for pg in stale:
+                if self._pg_refs.get(pg, 0) <= 0:
+                    self._pg_refs.pop(pg, None)
+                    self._pgs_to_remove.append(pg)
         for r in st.replicas:
             self._stop_replica(ray_tpu, st, r,
                                graceful=r.state == "RUNNING")
@@ -546,51 +752,208 @@ class ServeController:
             self._stopping_states.append(st)
 
     def _collect_autoscale_metrics(self, ray_tpu, key: str) -> None:
-        """Poll replica queue lengths WITHOUT holding the controller lock
-        (the 0.2s wait would otherwise stall routing-table RPCs)."""
-        with self._lock:
-            st = self._deployments.get(key)
-            if st is None or st.config.autoscaling_config is None:
-                return
-            running = [r for r in st.replicas if r.state == "RUNNING"]
-            refs = [r.actor_handle.get_queue_len.remote() for r in running]
-        if not refs:
-            return
-        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.2)
-        total = 0.0
-        for ref in ready:
-            try:
-                total += ray_tpu.get(ref)
-            except Exception:  # noqa: BLE001
-                pass
+        """Harvest + re-dispatch per-replica autoscale metric probes,
+        never blocking: outstanding refs are collected with
+        wait(timeout=0) and a new probe is dispatched once the previous
+        answered and the sampling period elapsed. Runs for EVERY
+        deployment (least-busy scale-down victim selection wants a load
+        sample) — only autoscaling ones keep the windowed history."""
         with self._lock:
             st = self._deployments.get(key)
             if st is None:
                 return
-            now = time.time()
             ac = st.config.autoscaling_config
-            st._ongoing_history.append((now, total))
+            now = time.time()
+            period = (ac.metrics_interval_s if ac is not None
+                      else _METRICS_PERIOD_S)
+            total_ongoing = 0.0
+            engine_agg: Dict[str, list] = {}
+            have_sample = False
+            for r in st.replicas:
+                if r.state != "RUNNING":
+                    continue
+                if r.metrics_ref is not None:
+                    ready, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
+                    if ready:
+                        ref, r.metrics_ref = r.metrics_ref, None
+                        try:
+                            r.last_metrics = ray_tpu.get(ref)
+                        except Exception:  # noqa: BLE001  dying replica
+                            pass
+                if (r.metrics_ref is None
+                        and now - r.metrics_dispatch_ts >= period):
+                    r.metrics_dispatch_ts = now
+                    try:
+                        r.metrics_ref = \
+                            r.actor_handle.get_autoscale_metrics.remote()
+                    except Exception:  # noqa: BLE001  dying replica
+                        pass
+                m = r.last_metrics
+                if m is None:
+                    continue
+                have_sample = True
+                load = float(m.get("ongoing", 0)) + float(
+                    m.get("streams", 0))
+                eng = m.get("engine") or {}
+                load += float(eng.get("queue_depth", 0) or 0)
+                total_ongoing += load
+                for k in ("queue_depth", "kv_util", "ttft_p50_ms",
+                          "tpot_ms"):
+                    v = eng.get(k)
+                    if v is not None:
+                        engine_agg.setdefault(k, []).append(float(v))
+            if ac is None or not have_sample:
+                return
+            st._ongoing_history.append((now, total_ongoing))
             cutoff = now - ac.look_back_period_s
             st._ongoing_history = [(t, v) for t, v in st._ongoing_history
                                    if t >= cutoff]
+            # engine SLO signals: queue depth sums across replicas, the
+            # latency/utilization signals take the worst replica
+            st._last_metrics = {
+                "queue_depth": sum(engine_agg.get("queue_depth", [])),
+            }
+            for k in ("kv_util", "ttft_p50_ms", "tpot_ms"):
+                if engine_agg.get(k):
+                    st._last_metrics[k] = max(engine_agg[k])
 
-    def _apply_autoscale_decision(self, st: _DeploymentState) -> None:
-        """Pure state update from already-collected metrics; lock held."""
-        ac = st.config.autoscaling_config
-        if ac is None or not st._ongoing_history:
-            return
-        running = [r for r in st.replicas if r.state == "RUNNING"]
-        if not running:
-            return
-        now = time.time()
-        avg = (sum(v for _, v in st._ongoing_history)
-               / max(len(st._ongoing_history), 1))
-        desired = ac.desired_replicas(avg, len(running))
-        if desired > st.target_num:
-            if now - st._last_scale_ts >= ac.upscale_delay_s:
-                st.target_num = desired
+    @staticmethod
+    def _replica_load(r: ReplicaInfo) -> float:
+        m = r.last_metrics or {}
+        return (float(m.get("ongoing", 0)) + float(m.get("streams", 0)))
+
+    def _autoscale_step(self, key: str) -> None:
+        """Feed the metric window into the deployment's autoscaler
+        policy (serve/autoscaler.py -> core/autoscaler.py) and apply
+        the returned target: scale-up reserves placement-group bundles
+        when configured, scale-down lets _reconcile drain the
+        least-busy replicas.
+
+        Three phases so the controller lock is NEVER held across a
+        driver round trip (feasibility view, pg create — each a
+        report_sync with a seconds-scale timeout; pinning the lock
+        would stall every handle's routing-table RPC during the exact
+        load spike that triggered the scale-up): decide under the
+        lock, do driver I/O unlocked, re-validate and apply under the
+        lock."""
+        # ---- phase 1 (lock): decide ----
+        with self._lock:
+            st = self._deployments.get(key)
+            if st is None:
+                return
+            ac = st.config.autoscaling_config
+            if ac is None or not st._ongoing_history:
+                return
+            running = [r for r in st.replicas if r.state == "RUNNING"]
+            if not running:
+                return
+            now = time.time()
+            avg = (sum(v for _, v in st._ongoing_history)
+                   / max(len(st._ongoing_history), 1))
+            policy = self._autoscaler.policy_for(key, ac)
+            busy = {r.replica_id: self._replica_load(r) for r in running}
+            target, reason = policy.decide(
+                now, st.target_num, avg, engine=st._last_metrics,
+                per_replica_busy=busy)
+            try:
+                from ..util import metrics_catalog as mcat
+                mcat.get("ray_tpu_serve_autoscaler_target_replicas").set(
+                    float(target), tags={"deployment": st.name})
+            except Exception:  # noqa: BLE001
+                pass
+            if target == st.target_num:
+                return
+            old_target = st.target_num
+            direction = ("scale_up" if target > old_target
+                         else "scale_down")
+            if direction == "scale_down" and st._pending_pg_bundles:
+                # bundles reserved by a scale-up that never started its
+                # replicas: drop them so a LATER unrelated start isn't
+                # pinned to a stale reservation; empty pgs queue for
+                # removal (drained outside the lock)
+                stale = {pg for pg, _i in st._pending_pg_bundles}
+                st._pending_pg_bundles.clear()
+                for pg in stale:
+                    if self._pg_refs.get(pg, 0) <= 0:
+                        self._pg_refs.pop(pg, None)
+                        self._pgs_to_remove.append(pg)
+            resources = dict(
+                st.config.ray_actor_options.get("resources") or {})
+            resources.setdefault(
+                "CPU",
+                st.config.ray_actor_options.get("num_cpus", 1) or 1)
+            pg_strategy = st.config.placement_group_strategy
+            dep_name, app_name = st.name, st.app_name
+
+        # ---- phase 2 (no lock): driver round trips ----
+        feasible = None
+        pg = None
+        if direction == "scale_up":
+            from .autoscaler import create_placement_group
+            deficit = target - old_target
+            feasible = self._autoscaler.feasible_now(resources, deficit)
+            if pg_strategy:
+                pg = create_placement_group(
+                    [dict(resources) for _ in range(deficit)],
+                    strategy=pg_strategy,
+                    name=f"serve-{app_name}-{dep_name}-{int(time.time())}")
+
+        # ---- phase 3 (lock): re-validate and apply ----
+        aborted = False
+        with self._lock:
+            st = self._deployments.get(key)
+            if st is None or st.target_num != old_target:
+                # deleted or retargeted (redeploy) while unlocked:
+                # drop this decision; an unconsumed reservation frees
+                aborted = True
+                if pg is not None:
+                    self._pgs_to_remove.append(pg.pg_id)
+            else:
+                if pg is not None:
+                    self._pg_refs.setdefault(pg.pg_id, 0)
+                    st._pending_pg_bundles.extend(
+                        (pg.pg_id, i) for i in range(deficit))
+                self._autoscaler.record(
+                    key=key, deployment=dep_name, app=app_name,
+                    direction=direction, from_num=old_target,
+                    to_num=target, reason=reason, feasible=feasible,
+                    pg_id=pg.pg_id if pg is not None else None)
+                st.target_num = target
                 st._last_scale_ts = now
-        elif desired < st.target_num:
-            if now - st._last_scale_ts >= ac.downscale_delay_s:
-                st.target_num = desired
-                st._last_scale_ts = now
+        if not aborted:
+            _emit_serve_event(
+                f"serve.autoscaler.{direction}",
+                f"{key}: {old_target} -> {target} ({reason})",
+                counter="ray_tpu_serve_autoscaler_scale_events_total",
+                counter_tags={"deployment": dep_name,
+                              "direction": direction},
+                deployment=dep_name, app=app_name,
+                from_replicas=old_target, to_replicas=target,
+                reason=reason[:200], feasible_now=feasible,
+                placement_group=pg.pg_id if pg is not None else None)
+
+    def _release_pg(self, pg_id: Optional[str]) -> None:
+        """Drop one replica's claim; the last claim queues the pg for
+        removal. Lock-safe: the actual driver RPC happens when the
+        control loop drains _pgs_to_remove outside the lock."""
+        if not pg_id:
+            return
+        n = self._pg_refs.get(pg_id)
+        if n is None:
+            return
+        n -= 1
+        if n <= 0:
+            self._pg_refs.pop(pg_id, None)
+            self._pgs_to_remove.append(pg_id)
+        else:
+            self._pg_refs[pg_id] = n
+
+    def _drain_pg_removals(self) -> None:
+        """Remove released placement groups; control loop, no lock."""
+        from .autoscaler import remove_placement_group
+        while True:
+            with self._lock:
+                if not self._pgs_to_remove:
+                    return
+                pg_id = self._pgs_to_remove.pop(0)
+            remove_placement_group(pg_id)

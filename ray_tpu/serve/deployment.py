@@ -71,7 +71,9 @@ class Deployment:
                 health_check_timeout_s: Optional[float] = None,
                 health_check_failure_threshold: Optional[int] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
-                ray_actor_options: Optional[dict] = None) -> "Deployment":
+                ray_actor_options: Optional[dict] = None,
+                placement_group_strategy: Optional[str] = "__unset__",
+                ) -> "Deployment":
         cfg = DeploymentConfig(**self._config.to_dict())
         if num_replicas == "auto":
             if autoscaling_config is None:
@@ -103,6 +105,8 @@ class Deployment:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if ray_actor_options is not None:
             cfg.ray_actor_options = ray_actor_options
+        if placement_group_strategy != "__unset__":
+            cfg.placement_group_strategy = placement_group_strategy
         return Deployment(
             self._target, name or self._name, cfg,
             version if version is not None else self._version,
@@ -138,7 +142,8 @@ def deployment_decorator(target=None, *, name: Optional[str] = None,
                          health_check_timeout_s=None,
                          health_check_failure_threshold=None,
                          graceful_shutdown_timeout_s=None,
-                         ray_actor_options=None, **_compat):
+                         ray_actor_options=None,
+                         placement_group_strategy="__unset__", **_compat):
     """@serve.deployment — wraps a class or function into a Deployment."""
 
     def wrap(t):
@@ -153,7 +158,8 @@ def deployment_decorator(target=None, *, name: Optional[str] = None,
             health_check_timeout_s=health_check_timeout_s,
             health_check_failure_threshold=health_check_failure_threshold,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
-            ray_actor_options=ray_actor_options)
+            ray_actor_options=ray_actor_options,
+            placement_group_strategy=placement_group_strategy)
 
     if target is not None:  # bare @serve.deployment
         return wrap(target)

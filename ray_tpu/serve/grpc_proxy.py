@@ -99,6 +99,12 @@ class GrpcProxy:
                 budget = 1e-4
             return _time.time() + budget
 
+        def _affinity_kw(context):
+            """Session affinity from `session-id` request metadata —
+            the gRPC twin of the HTTP X-Serve-Session-Id header."""
+            sid = dict(context.invocation_metadata()).get("session-id")
+            return {"__serve_affinity_key": sid} if sid else {}
+
         def predict(request: bytes, context) -> bytes:
             import time as _time
             handle = _resolve(context)
@@ -111,7 +117,8 @@ class GrpcProxy:
             deadline_ts = _deadline(context)
             try:
                 return _encode(handle.remote(
-                    body, __serve_deadline_ts=deadline_ts).result(
+                    body, __serve_deadline_ts=deadline_ts,
+                    **_affinity_kw(context)).result(
                     timeout_s=(None if deadline_ts is None
                                else max(0.1,
                                         deadline_ts - _time.time()))))
@@ -125,7 +132,8 @@ class GrpcProxy:
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
             gen = handle.options(stream=True).remote(
-                body, __serve_deadline_ts=_deadline(context))
+                body, __serve_deadline_ts=_deadline(context),
+                **_affinity_kw(context))
             try:
                 for chunk in gen:
                     yield _encode(chunk)

@@ -16,6 +16,8 @@ from ..exceptions import (ActorDiedError, ActorUnavailableError,
                           EngineWedgedError, NoCapacityError, RayTpuError,
                           ReplicaDrainingError, StreamInterruptedError,
                           TaskError, error_cause_is)
+from .router import (AffinityRouter, extract_affinity_key,
+                     pick_least_loaded)
 
 _REPLICA_REFRESH_S = 1.0
 # a replica that just failed a request is skipped by routing for this
@@ -264,7 +266,7 @@ class _RouterState:
     the generator finishes.
     """
 
-    def __init__(self):
+    def __init__(self, deployment: str = "", app: str = "default"):
         self.replicas: List[tuple] = []  # (replica_id, actor_handle)
         self.pending: Dict[str, list] = {}   # replica_id -> [ObjectRef]
         self.manual: Dict[str, int] = {}     # replica_id -> stream count
@@ -274,6 +276,12 @@ class _RouterState:
         self.max_ongoing = 5
         self.max_queued = -1
         self.queued = 0
+        # scale-out router state (serve/router.py): sticky
+        # session/prefix bindings + the deployment's registered
+        # prefixes (refreshed from the controller with the replica set)
+        self.affinity = AffinityRouter(deployment, app)
+        self.registered_prefixes: List[dict] = []
+        self.last_prune = 0.0
 
     def mark_suspect(self, replica_id: str) -> None:
         """A request just failed on this replica (death/wedge/drain):
@@ -283,6 +291,9 @@ class _RouterState:
             self.suspects[replica_id] = time.time()
             self.pending.pop(replica_id, None)
             self.manual.pop(replica_id, None)
+            # affinity keys bound to the dead replica re-bind (and
+            # re-warm) on their next request instead of chasing a ghost
+            self.affinity.forget(replica_id)
 
     def live_candidates(self) -> List[tuple]:
         """Routing candidates minus recently-failed replicas. Caller
@@ -292,16 +303,37 @@ class _RouterState:
         straight back to the replica that just failed (the old
         _resubmit bug) only burns the retry budget. Suspicion expires
         after _SUSPECT_TTL_S in case the controller disagrees."""
+        if not self.suspects:          # hot path: nothing ever failed
+            return self.replicas
         now = time.time()
         for rid in [rid for rid, ts in self.suspects.items()
                     if now - ts > _SUSPECT_TTL_S]:
             del self.suspects[rid]
         return [c for c in self.replicas if c[0] not in self.suspects]
 
-    def prune(self):
-        """Drop refs whose tasks completed. Caller must NOT hold lock."""
+    _PRUNE_INTERVAL_S = 0.02
+
+    def prune(self, force: bool = False):
+        """Drop refs whose tasks completed. Caller must NOT hold lock.
+
+        Throttled: the wait(timeout=0) completion scan is a runtime
+        round trip, and paying it on EVERY request put ~20% on the
+        router's happy path. Between scans the in-flight counts can
+        only over-estimate (finished-but-unpruned refs), which at worst
+        biases p2c — correctness never depends on them. Saturation
+        paths pass force=True so a full replica never looks full a
+        moment longer than real."""
         import ray_tpu
+        now = time.time()
+        # unlocked pre-check: a stale read just delays one scan by an
+        # interval; the locked re-check below keeps the scan single
+        if not force and now - self.last_prune < self._PRUNE_INTERVAL_S:
+            return
         with self.lock:
+            if not force and now - self.last_prune < \
+                    self._PRUNE_INTERVAL_S:
+                return
+            self.last_prune = now
             all_refs = [ref for refs in self.pending.values()
                         for ref in refs]
         if not all_refs:
@@ -332,7 +364,7 @@ class DeploymentHandle:
         self._stream = stream
         self._multiplexed_model_id = multiplexed_model_id
         self._deadline_s = deadline_s
-        self._router = _RouterState()
+        self._router = _RouterState(deployment_name, app_name)
 
     def __reduce__(self):
         return (DeploymentHandle,
@@ -382,32 +414,46 @@ class DeploymentHandle:
             if info:
                 r.max_ongoing = info["max_ongoing_requests"]
                 r.max_queued = info["max_queued_requests"]
+                r.registered_prefixes = list(
+                    info.get("registered_prefixes") or [])
 
-    def _pick_replica(self, deadline_ts: Optional[float] = None):
-        """Power-of-two-choices on pending-request counts over live
-        (non-suspect) replicas; waits with exponential backoff + jitter
-        (not a hot loop) when every replica is at max_ongoing_requests.
-        The wait is bounded by the request's propagated deadline when
-        one is set, else 30s; exhaustion raises the typed
-        NoCapacityError the proxy maps to 503."""
+    def _pick_replica(self, deadline_ts: Optional[float] = None,
+                      affinity_key: Optional[str] = None):
+        """Least-loaded power-of-two-choices over live (non-suspect)
+        replicas that still have request slots (serve/router.py);
+        requests carrying an affinity key are sticky-routed first
+        (consistent hash with bounded load) and only fall back to p2c
+        when every preferred replica is over the load bound. Waits with
+        exponential backoff + jitter (not a hot loop) when every
+        replica is at max_ongoing_requests. The wait is bounded by the
+        request's propagated deadline when one is set, else 30s;
+        exhaustion raises the typed NoCapacityError the proxy maps to
+        503."""
         r = self._router
         start = time.time()
         budget = (30.0 if deadline_ts is None
                   else max(0.0, deadline_ts - start))
         sleep_s = 0.002
+        first_pass = True
         while True:
             self._refresh_replicas(force=not r.replicas)
-            r.prune()
+            # retries after a full pass must see completions instantly
+            # (a saturated replica may have just freed a slot)
+            r.prune(force=not first_pass)
+            first_pass = False
             with r.lock:
                 candidates = r.live_candidates()
                 total = len(r.replicas)
                 if candidates:
-                    if len(candidates) == 1:
-                        chosen = candidates[0]
-                    else:
-                        a, b = random.sample(candidates, 2)
-                        chosen = a if r.load(a[0]) <= r.load(b[0]) else b
-                    if r.load(chosen[0]) < r.max_ongoing:
+                    if affinity_key is not None:
+                        chosen = r.affinity.pick(
+                            affinity_key, candidates, r.load,
+                            r.max_ongoing)
+                        if chosen is not None:
+                            return chosen
+                    chosen = pick_least_loaded(candidates, r.load,
+                                               r.max_ongoing)
+                    if chosen is not None:
                         return chosen
             if time.time() - start > budget:
                 # name the REAL cause: "saturated" vs "all replicas just
@@ -429,6 +475,25 @@ class DeploymentHandle:
             time.sleep(sleep_s * (0.5 + random.random()))
             sleep_s = min(sleep_s * 2, 0.05)
 
+    def _flush_binding_notes(self) -> None:
+        """Deliver queued binding transitions to the controller's
+        router table (state API / dashboard surface). Fire-and-forget,
+        best-effort, and ALWAYS outside the router lock — resolving the
+        controller is a driver round trip from proxy processes."""
+        r = self._router
+        with r.lock:
+            notes = r.affinity.take_notes()
+        if not notes:
+            return
+        try:
+            ctrl = self._controller()
+            for key, replica_id, outcome in notes:
+                ctrl.note_session_binding.remote(
+                    self._app, self._deployment, key, replica_id,
+                    outcome)
+        except Exception:  # noqa: BLE001
+            pass
+
     def remote(self, *args, **kwargs):
         r = self._router
         # absolute deadline: explicit kwarg (proxy-stamped; retries keep
@@ -437,17 +502,42 @@ class DeploymentHandle:
         if deadline_ts is None and self._deadline_s is not None:
             deadline_ts = time.time() + self._deadline_s
             kwargs["__serve_deadline_ts"] = deadline_ts
-        with r.lock:
-            if r.max_queued >= 0 and r.queued >= r.max_queued:
-                raise BackPressureError(
-                    f"{self._deployment}: max_queued_requests "
-                    f"({r.max_queued}) exceeded")
-            r.queued += 1
-        try:
-            replica_id, handle = self._pick_replica(deadline_ts)
-        finally:
+        # affinity key: explicit kwarg (proxy session header / caller),
+        # else a session id or registered-prefix match in a dict body.
+        # Popped here — replicas never see the routing hint.
+        affinity_key = kwargs.pop("__serve_affinity_key", None)
+        if affinity_key is None:
+            if not r.replicas:
+                # cold handle: fetch the routing table (and with it the
+                # registered-prefix list) BEFORE key extraction, so the
+                # very first prefix-keyed request routes warm
+                try:
+                    self._refresh_replicas(force=True)
+                except Exception:  # noqa: BLE001  pick loop will retry
+                    pass
+            affinity_key = extract_affinity_key(
+                args, r.registered_prefixes)
+        if affinity_key is not None:
+            affinity_key = str(affinity_key)
+        # the queued counter only backs max_queued_requests enforcement;
+        # with the unbounded default (-1) skip both lock rounds
+        track_queue = r.max_queued >= 0
+        if track_queue:
             with r.lock:
-                r.queued -= 1
+                if r.queued >= r.max_queued:
+                    raise BackPressureError(
+                        f"{self._deployment}: max_queued_requests "
+                        f"({r.max_queued}) exceeded")
+                r.queued += 1
+        try:
+            replica_id, handle = self._pick_replica(deadline_ts,
+                                                    affinity_key)
+        finally:
+            if track_queue:
+                with r.lock:
+                    r.queued -= 1
+        if affinity_key is not None:
+            self._flush_binding_notes()
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
                      else a for a in args)
         if self._multiplexed_model_id:
@@ -462,6 +552,11 @@ class DeploymentHandle:
             r.mark_suspect(failed)
             r.last_refresh = 0.0
             _note_failover(kind, self._deployment, failed, exc)
+            if affinity_key is not None:
+                # keep the session key on the retry: the failed replica
+                # is suspect, so the key re-binds to a live one instead
+                # of degrading to keyless routing
+                kw = {**kw, "__serve_affinity_key": affinity_key}
             if (deadline_override is not None
                     and "__serve_deadline_ts" not in kw):
                 # a deadline-less request retried from result(timeout_s=)
@@ -489,8 +584,21 @@ class DeploymentHandle:
         ref = handle.handle_request.remote(self._method, args, kwargs)
         with r.lock:
             r.pending.setdefault(replica_id, []).append(ref)
+
+        def unary_done(ref=ref, rid=replica_id):
+            # consuming the response releases its in-flight count right
+            # away — the prune() completion scan (a runtime round trip)
+            # is then only a backstop for responses nobody reads
+            with r.lock:
+                refs = r.pending.get(rid)
+                if refs is not None:
+                    try:
+                        refs.remove(ref)
+                    except ValueError:
+                        pass    # prune() already dropped it
         return DeploymentResponse(
-            ref, resubmit=lambda exc, deadline_override=None: resubmit(
+            ref, on_done=unary_done,
+            resubmit=lambda exc, deadline_override=None: resubmit(
                 exc, "unary", deadline_override=deadline_override))
 
 

@@ -89,6 +89,15 @@ class HTTPProxy:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _affinity_kw(self):
+                """Session affinity from the X-Serve-Session-Id header:
+                requests carrying it are sticky-routed to the session's
+                bound replica (warm KV prefix) by the handle's router.
+                Dict bodies may carry session_id/a registered prefix
+                instead — the handle extracts those itself."""
+                sid = self.headers.get("X-Serve-Session-Id")
+                return {"__serve_affinity_key": sid} if sid else {}
+
             def _deadline(self):
                 """Absolute deadline for this request: client-supplied
                 X-Serve-Timeout-S budget, else the proxy default. It
@@ -159,7 +168,8 @@ class HTTPProxy:
                 gen = None
                 try:
                     gen = handle.options(stream=True).remote(
-                        request, __serve_deadline_ts=self._deadline())
+                        request, __serve_deadline_ts=self._deadline(),
+                        **self._affinity_kw())
                     for item in gen:
                         if isinstance(item, dict) and item.get(START_KEY):
                             status = item["status"]
@@ -234,7 +244,8 @@ class HTTPProxy:
                 try:
                     if wants_stream:
                         gen = handle.options(stream=True).remote(
-                            body, __serve_deadline_ts=deadline_ts)
+                            body, __serve_deadline_ts=deadline_ts,
+                            **self._affinity_kw())
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "text/event-stream")
@@ -258,7 +269,8 @@ class HTTPProxy:
                         self.wfile.write(b"0\r\n\r\n")
                     else:
                         result = handle.remote(
-                            body, __serve_deadline_ts=deadline_ts
+                            body, __serve_deadline_ts=deadline_ts,
+                            **self._affinity_kw()
                         ).result(timeout_s=(
                             None if deadline_ts is None
                             else max(0.1, deadline_ts - time.time())))

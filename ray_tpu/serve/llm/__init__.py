@@ -48,6 +48,10 @@ class LLMServer:
         cfg = LLMEngineConfig(**engine_config)
         self.engine = LLMEngine(model, params, cfg)
         self.tokenizer = tokenizer
+        import threading
+        self._prefix_lock = threading.Lock()
+        self._prefix_keys = {}          # affinity key -> engine pid
+        self._prefix_inflight = set()   # keys mid-registration
         self._cached_prefixes = []      # (tokens, pid), longest first
         for p in cached_prefixes or []:
             ids = np.asarray(self._encode(p), np.int32).reshape(-1)
@@ -60,11 +64,50 @@ class LLMServer:
         prefix the prompt starts with; the engine re-attaches its
         tokens but adopts its KV by copy."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        for ids, pid in self._cached_prefixes:
+        with self._prefix_lock:
+            prefixes = list(self._cached_prefixes)
+        for ids, pid in prefixes:
             if prompt.size > ids.size and np.array_equal(
                     prompt[:ids.size], ids):
                 return prompt[ids.size:], pid
         return prompt, None
+
+    def register_prefix(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Dynamic shared-prefix registration (scale-out router path):
+        the serve controller pushes `serve.register_prefix(...)`
+        payloads here — to the affinity ring owner at registration time
+        and to every replica started afterwards. body: {"prefix":
+        str | [token ids], "key": affinity key}. Idempotent per key;
+        requires engine_config max_prefixes > 0 (the engine's KV slots
+        for warm prefixes)."""
+        key = body.get("key") or ""
+        prefix = body["prefix"]
+        ids = np.asarray(self._encode(prefix), np.int32).reshape(-1)
+        with self._prefix_lock:
+            pid = self._prefix_keys.get(key) if key else None
+            if pid is not None:
+                return {"key": key, "prefix_id": int(pid),
+                        "prefix_tokens": int(ids.size)}
+            if key in self._prefix_inflight:
+                # a concurrent push (controller re-warm racing the
+                # _check_started push) is already prefilling this key —
+                # don't burn a second engine prefix slot on it
+                return {"key": key, "prefix_id": -1, "pending": True}
+            self._prefix_inflight.add(key)
+        try:
+            # the prefill can take seconds cold — never under the lock
+            # (the request path's _match_prefix reads under it)
+            pid = self.engine.register_prefix(ids)
+        finally:
+            with self._prefix_lock:
+                self._prefix_inflight.discard(key)
+        with self._prefix_lock:
+            if key:
+                self._prefix_keys[key] = pid
+            self._cached_prefixes.append((ids, pid))
+            self._cached_prefixes.sort(key=lambda t: -t[0].size)
+        return {"key": key, "prefix_id": int(pid),
+                "prefix_tokens": int(ids.size)}
 
     def _encode(self, prompt):
         if isinstance(prompt, str):
@@ -110,6 +153,28 @@ class LLMServer:
 
     def stats(self, _body=None) -> Dict[str, Any]:
         return self.engine.get_stats()
+
+    def autoscale_metrics(self) -> Dict[str, Any]:
+        """Replica.get_autoscale_metrics hook: the live engine signals
+        the serve autoscaler's SLO terms key on (queue depth, TTFT/TPOT,
+        KV-page utilization) plus prefix-cache savings for the router's
+        affinity accounting."""
+        s = self.engine.get_stats()
+        out: Dict[str, Any] = {
+            "queue_depth": float(s.get("waiting", 0) or 0),
+            "active_slots": float(s.get("active", 0) or 0),
+            "prefix_tokens_saved": float(
+                s.get("prefix_tokens_saved", 0) or 0),
+        }
+        kv = s.get("kv_pages") or {}
+        if kv.get("total"):
+            out["kv_util"] = kv["in_use"] / max(kv["total"], 1)
+        ttft = s.get("ttft_breakdown_p50_ms") or {}
+        if ttft.get("total_ms") is not None:
+            out["ttft_p50_ms"] = float(ttft["total_ms"])
+        if s.get("tpot_p50_ms") is not None:
+            out["tpot_ms"] = float(s["tpot_p50_ms"])
+        return out
 
     def check_health(self):
         if not self.engine._loop_thread.is_alive():

@@ -340,6 +340,11 @@ class LLMEngine:
         # dispatch (compile on a bucket's first use) vs emit lag.
         self._ttft_samples: collections.deque = collections.deque(
             maxlen=512)
+        # recent per-request mean time-per-output-token (seconds) —
+        # feeds get_stats()["tpot_p50_ms"] and through it the serve
+        # autoscaler's tpot_slo_ms term
+        self._tpot_samples: collections.deque = collections.deque(
+            maxlen=512)
         self._prefill_compile_ms: Dict[int, float] = {}  # bucket -> ms
         # surfaced on the shared metrics registry (/metrics, dashboard);
         # one labeled series per engine instance. The dict is cached
@@ -1317,6 +1322,10 @@ class LLMEngine:
                     "peak_in_use": self._page_hwm,
                 }
             samples = list(self._ttft_samples)
+            tpots = sorted(self._tpot_samples)
+        if tpots:
+            out["tpot_p50_ms"] = round(
+                tpots[len(tpots) // 2] * 1000, 2)
         if samples:
             def p50(key):
                 vals = sorted(s[key] for s in samples)
@@ -1937,10 +1946,11 @@ class LLMEngine:
                 self._pen_coef_dirty = True
                 req.slot = -1
             if req.first_token_ts is not None and req.generated > 1:
+                tpot = ((time.time() - req.first_token_ts)
+                        / (req.generated - 1))
+                self._tpot_samples.append(tpot)
                 try:
-                    self._m["tpot"].observe(
-                        (time.time() - req.first_token_ts)
-                        / (req.generated - 1), tags=self._mtags)
+                    self._m["tpot"].observe(tpot, tags=self._mtags)
                 except Exception:
                     pass
         finally:

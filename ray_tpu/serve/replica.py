@@ -181,6 +181,28 @@ class Replica:
         with self._lock:
             return self._ongoing
 
+    def get_autoscale_metrics(self) -> Dict[str, Any]:
+        """Live load sample for the controller's autoscaler/scale-down
+        victim selection: in-flight handlers + undrained streams, plus
+        whatever the hosted callable exposes via an `autoscale_metrics`
+        hook (LLMServer reports engine queue depth, TTFT/TPOT, and
+        KV-page utilization through it)."""
+        with self._lock:
+            out: Dict[str, Any] = {"replica_id": self._replica_id,
+                                   "ongoing": self._ongoing,
+                                   "streams": len(self._streams),
+                                   "total": self._total_served,
+                                   "ts": time.time()}
+        hook = getattr(self._callable, "autoscale_metrics", None)
+        if callable(hook):
+            try:
+                engine = hook()
+                if isinstance(engine, dict):
+                    out["engine"] = engine
+            except Exception:  # noqa: BLE001  telemetry must not fail
+                pass
+        return out
+
     # ---- request path -----------------------------------------------------
     def _resolve_method(self, method_name: str):
         if self._is_function:

@@ -148,6 +148,24 @@ BUILTIN: Dict[str, _SPEC] = {
         "warning", "a request was shed instead of executed (propagated "
         "deadline expired before admission, or the replica is "
         "draining); the proxy surfaces 503 + Retry-After"),
+    # ---- serve scale-out plane (router + autoscaler) ----
+    "serve.router.affinity_hit": (
+        "info", "a session/prefix-keyed request reached its warm bound "
+        "replica; emitted at binding creation (per-request hits are "
+        "counted by ray_tpu_serve_router_requests_total)"),
+    "serve.router.affinity_miss": (
+        "warning", "a session/prefix-keyed request could not reach its "
+        "warm replica (suspect / draining / over the bounded-load cap "
+        "/ gone) and was re-bound to another replica (cold prefill, "
+        "never an error)"),
+    "serve.autoscaler.scale_up": (
+        "info", "the serve autoscaler raised a deployment's replica "
+        "target from live engine metrics (attrs: from/to, reason, "
+        "bin-packed feasible_now, placement group when reserved)"),
+    "serve.autoscaler.scale_down": (
+        "info", "the serve autoscaler lowered a deployment's replica "
+        "target; the controller gracefully drains the least-busy "
+        "replicas first"),
     # ---- event plane itself ----
     "events.dropped": (
         "warning", "a process's local event buffer overflowed between "

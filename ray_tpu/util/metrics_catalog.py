@@ -136,6 +136,21 @@ BUILTIN: Dict[str, _SPEC] = {
         "counter", "requests resubmitted to a different replica after "
         "a replica death / wedged engine / drain rejection",
         ("kind",), "requests", None),
+    # ---- serve scale-out plane (router + autoscaler) ----
+    "ray_tpu_serve_router_requests_total": (
+        "counter", "affinity-keyed requests routed, by outcome "
+        "(affinity_hit = reached the bound warm replica, affinity_miss "
+        "= diverted/re-bound)", ("deployment", "outcome"), "requests",
+        None),
+    "ray_tpu_serve_router_sessions": (
+        "gauge", "session/prefix keys currently bound to a replica in "
+        "this process's router", ("deployment",), "sessions", None),
+    "ray_tpu_serve_autoscaler_target_replicas": (
+        "gauge", "replica target the serve autoscaler reconciles the "
+        "deployment toward", ("deployment",), "replicas", None),
+    "ray_tpu_serve_autoscaler_scale_events_total": (
+        "counter", "serve autoscaler target changes",
+        ("deployment", "direction"), "decisions", None),
     # ---- data executor ----
     "ray_tpu_data_inflight_bytes": (
         "gauge", "bytes of blocks in flight in a streaming stage",

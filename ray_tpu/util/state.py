@@ -243,6 +243,40 @@ def persistence_summary() -> Dict[str, Any]:
     return stats
 
 
+def _serve_controller(timeout: float = 0.2):
+    import ray_tpu
+    from ..serve.controller import CONTROLLER_NAME
+    return ray_tpu.get_actor(CONTROLLER_NAME, timeout=timeout)
+
+
+def serve_router_table() -> Dict[str, Any]:
+    """Scale-out router view per deployment: RUNNING replica ids (the
+    affinity hash-ring membership), registered prefixes with their
+    current ring owner, and the recent sticky session bindings handles
+    reported. {"running": False} when no serve controller exists."""
+    import ray_tpu
+    try:
+        ctrl = _serve_controller()
+    except Exception:  # noqa: BLE001  controller not running
+        return {"running": False, "deployments": {}}
+    return {"running": True,
+            "deployments": ray_tpu.get(ctrl.get_router_table.remote(),
+                                       timeout=5.0)}
+
+
+def serve_autoscaler_status() -> Dict[str, Any]:
+    """Serve autoscaler targets + recent decision log (scale_up /
+    scale_down rows with reasons and placement annotations)."""
+    import ray_tpu
+    try:
+        ctrl = _serve_controller()
+    except Exception:  # noqa: BLE001
+        return {"running": False, "deployments": {}, "decisions": []}
+    out = ray_tpu.get(ctrl.get_autoscaler_status.remote(), timeout=5.0)
+    out["running"] = True
+    return out
+
+
 def cluster_summary() -> Dict[str, Any]:
     rt = get_runtime()
     return {

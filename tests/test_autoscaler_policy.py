@@ -1,0 +1,120 @@
+"""Direct unit tests for core/autoscaler.py's pure policy.
+
+Until now the policy was only exercised end-to-end through
+test_autoscaler_live.py (real node subprocesses); these pin the three
+behaviors the serve replica-autoscaler now also builds on: first-fit-
+decreasing bin-pack, the upscaling_speed step clamp, and idle-timeout
+downscale with a min_workers floor.
+"""
+from ray_tpu.core.autoscaler import (Autoscaler, AutoscalerConfig,
+                                     NodeType, upscale_step)
+
+CPU4 = NodeType("cpu4", {"CPU": 4.0}, min_workers=0, max_workers=10)
+CPU8 = NodeType("cpu8", {"CPU": 8.0, "TPU": 4.0}, min_workers=0,
+                max_workers=10)
+
+
+def _scaler(types=(CPU4, CPU8), **kw):
+    return Autoscaler(AutoscalerConfig(node_types=list(types), **kw))
+
+
+# ---------- bin_pack: first-fit-decreasing ----------
+
+def test_bin_pack_packs_onto_existing_capacity_first():
+    a = _scaler()
+    unmet, new = a.bin_pack(
+        [{"CPU": 2.0}, {"CPU": 1.0}, {"CPU": 1.0}],
+        [("n1", {"CPU": 4.0})])
+    assert unmet == [] and new == {}
+
+
+def test_bin_pack_decreasing_order_avoids_fragmentation():
+    # FFD places the big demand first; ascending placement would strand
+    # it (2x {CPU:1} on the 4-cpu node leaves 2 < 3)
+    a = _scaler()
+    unmet, new = a.bin_pack(
+        [{"CPU": 1.0}, {"CPU": 3.0}, {"CPU": 1.0}],
+        [("n1", {"CPU": 4.0}), ("n2", {"CPU": 1.0})])
+    assert unmet == [] and new == {}
+
+
+def test_bin_pack_overflow_launches_smallest_fitting_type():
+    a = _scaler()
+    unmet, new = a.bin_pack([{"CPU": 2.0}], [])
+    assert unmet == [] and new == {"cpu4": 1}
+    # a TPU demand only fits the TPU-bearing type
+    unmet, new = a.bin_pack([{"TPU": 2.0}], [])
+    assert unmet == [] and new == {"cpu8": 1}
+
+
+def test_bin_pack_virtual_nodes_shared_by_multiple_demands():
+    a = _scaler()
+    unmet, new = a.bin_pack(
+        [{"CPU": 2.0}, {"CPU": 2.0}], [])
+    assert unmet == [] and new == {"cpu4": 1}  # both fit ONE fresh node
+
+
+def test_bin_pack_infeasible_demand_reported_not_launched():
+    a = _scaler()
+    unmet, new = a.bin_pack([{"GPU": 1.0}], [("n1", {"CPU": 4.0})])
+    assert unmet == [{"GPU": 1.0}] and new == {}
+
+
+# ---------- upscaling_speed clamp ----------
+
+def test_upscale_step_floor_of_one_from_cold_pool():
+    assert upscale_step(0, 5, 0.5) == 1
+    assert upscale_step(1, 5, 0.0) == 1   # speed 0 still makes progress
+    assert upscale_step(0, 0, 1.0) == 0   # nothing wanted
+
+
+def test_upscale_step_proportional_to_existing():
+    assert upscale_step(4, 100, 1.0) == 4
+    assert upscale_step(4, 100, 2.0) == 8
+    assert upscale_step(4, 3, 2.0) == 3   # never over the want
+
+
+def test_plan_clamps_launches_by_speed_and_max_workers():
+    a = _scaler(types=[NodeType("cpu4", {"CPU": 4.0}, min_workers=0,
+                                max_workers=3)], upscaling_speed=1.0)
+    nodes = [{"id": "n1", "type": "cpu4", "avail": {"CPU": 0.0},
+              "used": {"CPU": 4.0}}]
+    plan = a.plan(demands=[{"CPU": 4.0}] * 8, nodes=nodes, now=100.0)
+    # speed 1.0 x 1 existing = 1 launch this round, despite 8 unmet
+    assert plan["launch"] == {"cpu4": 1}
+    nodes3 = nodes + [
+        {"id": f"n{i}", "type": "cpu4", "avail": {"CPU": 0.0},
+         "used": {"CPU": 4.0}} for i in (2, 3)]
+    plan = a.plan(demands=[{"CPU": 4.0}] * 8, nodes=nodes3, now=100.0)
+    assert plan["launch"] == {}           # max_workers=3 already reached
+
+
+# ---------- idle-timeout downscale ----------
+
+def test_idle_timeout_downscale_after_window_only():
+    a = _scaler(types=[NodeType("cpu4", {"CPU": 4.0}, min_workers=1,
+                                max_workers=5)], idle_timeout_s=10.0)
+    idle = [{"id": f"n{i}", "type": "cpu4", "avail": {"CPU": 4.0},
+             "used": {}} for i in range(3)]
+    # first observation starts the idle clock: nothing terminates
+    plan = a.plan(demands=[], nodes=idle, now=1000.0)
+    assert plan["terminate"] == []
+    # inside the window: still nothing
+    plan = a.plan(demands=[], nodes=idle, now=1005.0)
+    assert plan["terminate"] == []
+    # past the window: terminate down to the min_workers floor
+    plan = a.plan(demands=[], nodes=idle, now=1011.0)
+    assert len(plan["terminate"]) == 2    # 3 idle - floor of 1
+
+
+def test_busy_node_resets_idle_clock():
+    a = _scaler(types=[NodeType("cpu4", {"CPU": 4.0}, min_workers=0,
+                                max_workers=5)], idle_timeout_s=10.0)
+    n = {"id": "n1", "type": "cpu4", "avail": {"CPU": 4.0}, "used": {}}
+    assert a.plan(demands=[], nodes=[n], now=0.0)["terminate"] == []
+    busy = dict(n, used={"CPU": 1.0}, avail={"CPU": 3.0})
+    assert a.plan(demands=[], nodes=[busy], now=9.0)["terminate"] == []
+    # idle again at t=12: the clock restarted at 12, so t=15 is safe
+    assert a.plan(demands=[], nodes=[n], now=12.0)["terminate"] == []
+    assert a.plan(demands=[], nodes=[n], now=15.0)["terminate"] == []
+    assert a.plan(demands=[], nodes=[n], now=23.0)["terminate"] == ["n1"]

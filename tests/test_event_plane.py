@@ -82,6 +82,18 @@ def test_catalog_requires_serve_fault_tolerance_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_serve_scaleout_events():
+    """The scale-out serving plane's chain (affinity bind/rebind +
+    autoscaler target changes) is asserted by
+    tests/test_serve_scaleout.py and surfaced by the state API /
+    `/api/serve/*` — the catalog must keep carrying it."""
+    for required in ("serve.router.affinity_hit",
+                     "serve.router.affinity_miss",
+                     "serve.autoscaler.scale_up",
+                     "serve.autoscaler.scale_down"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

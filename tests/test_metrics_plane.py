@@ -78,6 +78,19 @@ def test_catalog_requires_serve_fault_tolerance_metrics():
         assert mcat.BUILTIN[required][0] == "counter", required
 
 
+def test_catalog_requires_serve_scaleout_metrics():
+    """The scale-out router/autoscaler telemetry backs the affinity
+    hit-rate acceptance assertions (tests/test_serve_scaleout.py) and
+    the `/api/serve/*` surface — the catalog must keep carrying it."""
+    for required, kind in (
+            ("ray_tpu_serve_router_requests_total", "counter"),
+            ("ray_tpu_serve_router_sessions", "gauge"),
+            ("ray_tpu_serve_autoscaler_target_replicas", "gauge"),
+            ("ray_tpu_serve_autoscaler_scale_events_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_catalog_requires_driver_persistence_metrics():
     """The control-plane persistence gauges/counters back the state
     API's persistence_summary and the driver_ft bench — the catalog

@@ -1,0 +1,481 @@
+"""Scale-out serving plane (ISSUE 9): affinity router, SLO autoscaler,
+least-busy scale-down, and the end-to-end multi-replica LLM acceptance
+chain (prefix-cache affinity well above the 1/N no-affinity baseline,
+autoscale 1->3->1 event chain, zero failed unaries across the death of
+an affinity-pinned replica)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import NoCapacityError
+from ray_tpu.serve import chaos
+from ray_tpu.serve.router import (AffinityRouter, extract_affinity_key,
+                                  prefix_key, ring_order, ring_owner)
+from ray_tpu.util import state as state_mod
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _serve_instance():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps():
+    yield
+    try:
+        for app in list(serve.status()["applications"]):
+            if app != "llm3-app":   # module-scoped fixture owns it
+                serve.delete(app)
+    except Exception:
+        pass
+
+
+def _poll(fn, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+def _events(types, timeout=20.0, pred=None):
+    def fetch():
+        rows = list(state_mod.list_events(types=types, limit=1000))
+        if pred is not None:
+            rows = [e for e in rows if pred(e)]
+        return rows
+    return _poll(fetch, timeout=timeout)
+
+
+# ---------- router units ----------
+
+def test_ring_is_deterministic_and_remaps_minimally():
+    reps = ["app#d#1", "app#d#2", "app#d#3"]
+    keys = [f"key-{i}" for i in range(200)]
+    owners = {k: ring_owner(k, reps) for k in keys}
+    # deterministic across calls and input order
+    assert owners == {k: ring_owner(k, list(reversed(reps)))
+                      for k in keys}
+    assert set(owners.values()) == set(reps)  # spread, not one bucket
+    # removing one replica remaps ONLY its keys (consistent hashing)
+    survivors = reps[:2]
+    for k, own in owners.items():
+        if own != reps[2]:
+            assert ring_owner(k, survivors) == own
+
+
+def test_ring_order_walks_all_replicas():
+    reps = ["a#b#1", "a#b#2", "a#b#3", "a#b#4"]
+    order = ring_order("some-key", reps)
+    assert sorted(order) == sorted(reps)
+    assert order[0] == ring_owner("some-key", reps)
+
+
+def test_affinity_router_sticky_bounded_load_and_forget():
+    ar = AffinityRouter("dep")
+    cands = [("r1", None), ("r2", None), ("r3", None)]
+    loads = {"r1": 0, "r2": 0, "r3": 0}
+    first = ar.pick("s", cands, lambda r: loads[r], max_ongoing=5)
+    assert first is not None and ar.hits == 1
+    assert ar.pick("s", cands, lambda r: loads[r], 5) == first
+    # over the bounded-load cap: the key diverts and re-binds
+    loads[first[0]] = 50
+    diverted = ar.pick("s", cands, lambda r: loads[r], 5)
+    assert diverted is not None and diverted[0] != first[0]
+    assert ar.misses == 1
+    # and sticks to the NEW binding afterwards
+    loads[first[0]] = 0
+    assert ar.pick("s", cands, lambda r: loads[r], 5) == diverted
+    # forget(dead replica) releases the binding
+    ar.forget(diverted[0])
+    rebound = ar.pick("s", [c for c in cands if c != diverted],
+                      lambda r: loads[r], 5)
+    assert rebound is not None and rebound[0] != diverted[0]
+    # every preferred replica saturated -> None (caller falls to p2c)
+    loads = {"r1": 9, "r2": 9, "r3": 9}
+    assert ar.pick("s", cands, lambda r: loads[r], 5) is None
+
+
+def test_extract_affinity_key_session_and_prefix_forms():
+    assert extract_affinity_key(({"session_id": "s1"},), []) == "s1"
+    assert extract_affinity_key(({"user": "u9"},), []) == "u9"
+    assert extract_affinity_key((), []) is None
+    assert extract_affinity_key(("not-a-dict",), []) is None
+    rows = [{"key": "pA", "prefix": [1, 2, 3]},
+            {"key": "pB", "prefix": [1, 2, 3, 4]},
+            {"key": "pS", "prefix": "sys: "}]
+    # token prompts match token prefixes, longest wins
+    assert extract_affinity_key(({"prompt": [1, 2, 3, 9]},), rows) == "pA"
+    assert extract_affinity_key(({"prompt": [1, 2, 3, 4, 9]},),
+                                rows) == "pB"
+    assert extract_affinity_key(({"prompt": [7, 8]},), rows) is None
+    # string prompts match string prefixes only
+    assert extract_affinity_key(({"prompt": "sys: hello"},), rows) == "pS"
+    assert extract_affinity_key(({"prompt": "other"},), rows) is None
+    # stable key derivation for registration
+    assert prefix_key([1, 2, 3]) == prefix_key((1, 2, 3))
+    assert prefix_key("abc") != prefix_key("abd")
+
+
+# ---------- satellite: least-loaded p2c in _pick_replica ----------
+
+def test_pick_replica_p2c_skips_saturated_replicas():
+    """The old pick sampled 2 of ALL candidates and re-looped when the
+    winner was at max_ongoing — a saturated pair burned a backoff round
+    while a free replica idled. Now sampling is restricted to replicas
+    with open slots."""
+    h = serve.get_deployment_handle("fake-dep", "fake-app")
+    r = h._router
+    r.replicas = [("r1", "h1"), ("r2", "h2"), ("r3", "h3")]
+    r.last_refresh = time.time() + 3600   # never refresh (no controller)
+    r.max_ongoing = 5
+    r.manual = {"r1": 5, "r2": 5, "r3": 2}  # stream-count load source
+    t0 = time.time()
+    for _ in range(50):
+        rid, _handle = h._pick_replica()
+        assert rid == "r3"                # only replica with a slot
+    assert time.time() - t0 < 1.0         # no backoff rounds burned
+
+
+def test_pick_replica_p2c_prefers_less_loaded():
+    h = serve.get_deployment_handle("fake-dep2", "fake-app")
+    r = h._router
+    r.replicas = [("r1", "h1"), ("r2", "h2"), ("r3", "h3")]
+    r.last_refresh = time.time() + 3600
+    r.max_ongoing = 5
+    r.manual = {"r1": 0, "r2": 1, "r3": 4}
+    picks = [h._pick_replica()[0] for _ in range(60)]
+    # r3 loses every pairwise comparison; r1 beats r2
+    assert "r3" not in picks
+    assert picks.count("r1") > picks.count("r2")
+
+
+def test_pick_replica_saturated_raises_typed_no_capacity():
+    h = serve.get_deployment_handle("fake-dep3", "fake-app")
+    r = h._router
+    r.replicas = [("r1", "h1")]
+    r.last_refresh = time.time() + 3600
+    r.max_ongoing = 2
+    r.manual = {"r1": 2}
+    t0 = time.time()
+    with pytest.raises(NoCapacityError):
+        h._pick_replica(deadline_ts=time.time() + 0.3)
+    assert time.time() - t0 < 3.0
+
+
+# ---------- session affinity end to end ----------
+
+def test_session_affinity_sticky_and_table_surfaced():
+    @serve.deployment(num_replicas=3, max_ongoing_requests=8)
+    def who(body):
+        import os
+        return {"pid": os.getpid()}
+
+    h = serve.run(who.bind(), name="sess-app", route_prefix="/sess")
+    pids = {h.remote({"session_id": "alpha"}).result(timeout_s=30)["pid"]
+            for _ in range(8)}
+    assert len(pids) == 1, f"session bounced across replicas: {pids}"
+    r = h._router
+    assert r.affinity.hits >= 7 and r.affinity.misses <= 1
+    # distinct sessions spread over replicas (not all on one)
+    spread = {h.remote({"session_id": f"s{i}"}).result(
+        timeout_s=30)["pid"] for i in range(12)}
+    assert len(spread) > 1
+    # controller router table surfaces the bindings + ring membership
+
+    def table_has_binding():
+        t = state_mod.serve_router_table()
+        dep = t["deployments"].get("sess-app/who") or {}
+        return "alpha" in (dep.get("bindings") or {}) and \
+            len(dep.get("replicas", [])) == 3
+    assert _poll(table_has_binding, timeout=10), \
+        state_mod.serve_router_table()
+    # binding-transition events were cataloged + recorded
+    assert _events(["serve.router.affinity_hit"], timeout=10)
+
+
+# ---------- satellite: scale-down drains the least-busy replica ----------
+
+def test_scale_down_prefers_idle_replica():
+    @serve.deployment(name="lb", num_replicas=2, max_ongoing_requests=4,
+                      graceful_shutdown_timeout_s=20.0)
+    def lb(body):
+        time.sleep((body or {}).get("sleep", 0))
+        return "ok"
+
+    serve.run(lb.bind(), name="lb-app", route_prefix="/lb")
+    reps = chaos.running_replicas("lb-app", "lb")
+    assert len(reps) == 2
+    busy_rid, busy_handle = reps[0]
+    done = {}
+
+    def long_call():
+        done["v"] = ray_tpu.get(busy_handle.handle_request.remote(
+            "__call__", ({"sleep": 5.0},), {}))
+    t = threading.Thread(target=long_call, daemon=True)
+    t.start()
+    time.sleep(1.5)     # metrics sampling picks up the busy replica
+    serve.run(lb.options(num_replicas=1).bind(), name="lb-app",
+              route_prefix="/lb")
+
+    def one_left():
+        ids = [rid for rid, _h in chaos.running_replicas("lb-app", "lb")]
+        return ids if len(ids) == 1 else None
+    survivors = _poll(one_left, timeout=20)
+    assert survivors == [busy_rid], (
+        f"scale-down stopped the BUSY replica {busy_rid}; "
+        f"survivors={survivors}")
+    t.join(timeout=30)
+    assert done.get("v") == "ok"    # the in-flight call was never cut
+
+
+# ---------- autoscaler end to end: 1 -> 3 -> 1 with event chain ----------
+
+def test_autoscaler_scales_up_and_down_with_event_chain():
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "look_back_period_s": 1.0,
+                            "metrics_interval_s": 0.2,
+                            "upscale_delay_s": 0.3,
+                            "downscale_delay_s": 1.0},
+        max_ongoing_requests=4)
+    def elastic(body):
+        time.sleep(0.3)
+        return "ok"
+
+    h = serve.run(elastic.bind(), name="el-app", route_prefix="/el")
+    assert len(chaos.running_replicas("el-app", "elastic")) == 1
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                h.remote(None).result(timeout_s=10)
+            except Exception:  # noqa: BLE001  scale churn
+                pass
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    try:
+        assert _poll(lambda: len(chaos.running_replicas(
+            "el-app", "elastic")) >= 3, timeout=30), \
+            "autoscaler never reached 3 replicas under load"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    # idle -> back down to min_replicas
+    assert _poll(lambda: len(chaos.running_replicas(
+        "el-app", "elastic")) <= 1, timeout=40), \
+        "autoscaler never scaled back to min when idle"
+    # event chain: scale_up -> replica drain (graceful scale-down)
+    # -> scale_down, all attributed to this deployment
+    pred = lambda e: e.get("attrs", {}).get("deployment") == "elastic"  # noqa: E731
+    up = _events(["serve.autoscaler.scale_up"], timeout=15, pred=pred)
+    assert up and up[0]["attrs"]["to_replicas"] > up[0]["attrs"][
+        "from_replicas"]
+    assert _events(["serve.replica.drain"], timeout=15, pred=pred)
+    down = _events(["serve.autoscaler.scale_down"], timeout=15,
+                   pred=pred)
+    assert down and down[-1]["attrs"]["to_replicas"] < down[-1][
+        "attrs"]["from_replicas"]
+    # decision log surfaced through the state API
+    status = state_mod.serve_autoscaler_status()
+    assert status["running"]
+    dirs = {d["direction"] for d in status["decisions"]
+            if d["deployment"] == "elastic"}
+    assert {"scale_up", "scale_down"} <= dirs
+
+
+def test_autoscale_up_reserves_placement_groups_and_cleans_up():
+    """placement_group_strategy: each autoscale-up reserves a pg (one
+    bundle per new replica) the replicas start into; pgs are removed
+    when their last replica is gone."""
+    from ray_tpu.util.placement_group import placement_group_table
+
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "look_back_period_s": 1.0,
+                            "metrics_interval_s": 0.2,
+                            "upscale_delay_s": 0.3,
+                            "downscale_delay_s": 1.0},
+        max_ongoing_requests=4, placement_group_strategy="PACK")
+    def pgel(body):
+        time.sleep(0.3)
+        return "ok"
+
+    h = serve.run(pgel.bind(), name="pg-app", route_prefix="/pg")
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                h.remote(None).result(timeout_s=10)
+            except Exception:  # noqa: BLE001
+                pass
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    try:
+        assert _poll(lambda: len(chaos.running_replicas(
+            "pg-app", "pgel")) >= 3, timeout=30)
+        pgs = [v for v in placement_group_table().values()
+               if v["name"].startswith("serve-pg-app")]
+        # scale-ups 1->2->3 reserved one single-bundle pg each
+        assert pgs and all(len(v["bundles"]) >= 1 for v in pgs)
+        up = _events(["serve.autoscaler.scale_up"], timeout=10,
+                     pred=lambda e: e.get("attrs", {}).get(
+                         "deployment") == "pgel")
+        assert any(e["attrs"].get("placement_group") for e in up), up
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    serve.delete("pg-app")
+
+    def cleaned():
+        return not [v for v in placement_group_table().values()
+                    if v["name"].startswith("serve-pg-app")
+                    and v["state"] != "REMOVED"]
+    assert _poll(cleaned, timeout=30), placement_group_table()
+
+
+# ---------- acceptance: multi-replica LLM prefix affinity ----------
+
+@pytest.fixture(scope="module")
+def llm_3rep():
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    def factory():
+        import jax
+        from ray_tpu.models import Llama, LlamaConfig
+        cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=64,
+                          max_seq_len=128, remat=False)
+        model = Llama(cfg)
+        return model, model.init_params(jax.random.PRNGKey(0))
+
+    app = build_llm_deployment(
+        factory, name="LLM3", num_replicas=3,
+        engine_config={"max_slots": 2, "max_seq_len": 128,
+                       "prefill_buckets": (16, 32), "max_prefixes": 4},
+        route_prefix="/llm3")
+    app = serve.Application(
+        app.deployment.options(health_check_period_s=0.3,
+                               health_check_failure_threshold=1),
+        app._args, app._kwargs)
+    h = serve.run(app, name="llm3-app", wait_for_ready_timeout_s=300)
+    yield h
+    serve.delete("llm3-app")
+
+
+def _prefix_saved_by_replica(app, dep):
+    out = {}
+    for rid, handle in chaos.running_replicas(app, dep):
+        try:
+            s = ray_tpu.get(handle.handle_request.remote(
+                "stats", (), {}), timeout=30)
+            out[rid] = s.get("prefix_tokens_saved", 0)
+        except Exception:  # noqa: BLE001  replica mid-death
+            pass
+    return out
+
+
+def test_llm_prefix_affinity_beats_no_affinity_baseline(llm_3rep):
+    """Acceptance: a shared-prefix session workload on 3 replicas keeps
+    ALL prefix-cache savings on the affinity home replica — without
+    affinity, uniform routing would land ~1/3 of requests on the one
+    warm replica. Asserted from engine prefix_tokens_saved and the
+    router's own hit counters."""
+    h = llm_3rep
+    prefix = list(range(1, 13))          # 12 shared tokens
+    serve.register_prefix(prefix, app_name="llm3-app")
+    n_req = 9
+    for i in range(n_req):
+        out = h.remote({"prompt": prefix + [20 + i, 40 + i],
+                        "max_tokens": 2}).result(timeout_s=120)
+        assert len(out["tokens"]) == 2
+    saved = _prefix_saved_by_replica("llm3-app", "LLM3")
+    total = sum(saved.values())
+    assert total >= len(prefix) * (n_req - 1), saved  # cache really hit
+    # all savings concentrated on ONE replica = affinity hit rate ~1.0
+    # vs the ~1/3 a no-affinity router would manage
+    assert max(saved.values()) == total, saved
+    r = h._router
+    assert r.affinity.hits / max(r.affinity.hits + r.affinity.misses,
+                                 1) > 0.8
+    # the routed prefix owner matches the controller's ring computation
+    table = state_mod.serve_router_table()["deployments"][
+        "llm3-app/LLM3"]
+    warm_rid = max(saved, key=saved.get)
+    assert any(row["owner"] == warm_rid
+               for row in table["registered_prefixes"])
+
+
+def test_llm_kill_pinned_replica_zero_failed_unaries(llm_3rep):
+    """Acceptance: killing the affinity-pinned replica mid-traffic
+    loses ZERO unary requests (PR-5 failover preserved) and the
+    registered prefix re-warms on the session's new home."""
+    h = llm_3rep
+    prefix = list(range(1, 13))
+    serve.register_prefix(prefix, app_name="llm3-app")
+    for i in range(3):                   # establish the warm binding
+        h.remote({"prompt": prefix + [60 + i], "max_tokens": 2}).result(
+            timeout_s=120)
+    saved = _prefix_saved_by_replica("llm3-app", "LLM3")
+    pinned = max(saved, key=saved.get)
+
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def one(i):
+        try:
+            out = h.remote({"prompt": prefix + [70 + i],
+                            "max_tokens": 2}).result(timeout_s=120)
+            with lock:
+                results.append(len(out["tokens"]))
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    chaos.kill_replica("llm3-app", "LLM3", replica_id=pinned)
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, f"unaries failed across pinned-replica kill: " \
+                       f"{errors}"
+    assert results == [2] * 10
+    # the divert was recorded as an affinity miss / re-bind
+    assert _events(
+        ["serve.router.affinity_miss"], timeout=15,
+        pred=lambda e: e.get("attrs", {}).get("deployment") == "LLM3")
+    chaos.wait_for_replacement("llm3-app", "LLM3", pinned, timeout_s=120)
+
+    # prefix follows the key: savings grow again on the new home
+    def rewarmed():
+        before = sum(_prefix_saved_by_replica("llm3-app",
+                                              "LLM3").values())
+        h.remote({"prompt": prefix + [99], "max_tokens": 2}).result(
+            timeout_s=120)
+        after = sum(_prefix_saved_by_replica("llm3-app",
+                                             "LLM3").values())
+        return after > before
+    assert _poll(rewarmed, timeout=90, interval=0.5), \
+        "registered prefix never re-warmed after replacement"
