@@ -106,6 +106,9 @@ def _ensure_init():
 def _auto_put_large_args(rt, args, kwargs):
     """Large array args are placed in the object store and passed by ref
     (reference: put_threshold in core_worker task arg inlining)."""
+    if not args and not kwargs:
+        return args, kwargs
+
     def conv(a):
         if isinstance(a, np.ndarray) and a.nbytes > AUTO_PUT_THRESHOLD:
             return rt.put(a)

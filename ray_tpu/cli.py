@@ -86,6 +86,44 @@ def cmd_persistence(args):
               "restored nodes")
 
 
+def cmd_dispatch(args):
+    """Batched-dispatch plane health: submit batch sizes, worker-lease
+    grants/revokes, direct actor calls, control messages per direction
+    (docs/SCHEDULING.md)."""
+    s = _fetch(args.address, "/api/dispatch")
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return
+    if not s.get("enabled"):
+        print("dispatch stats unavailable on this runtime")
+        return
+    print(f"batching:            "
+          f"{'on' if s.get('batching_enabled') else 'OFF (RAY_TPU_BATCH=0)'}"
+          f"  (flush {s.get('flush_max_tasks')} tasks / "
+          f"{s.get('flush_window_s')}s window)")
+    print(f"binary wire:         "
+          f"{'on' if s.get('binary_wire_enabled') else 'OFF'}")
+    print(f"submit batches:      {s.get('submit_batches')}"
+          f"  ({s.get('batched_submits')} tasks, avg "
+          f"{s.get('avg_submit_batch')})")
+    print(f"explicit submit_many:{s.get('submit_many_calls')}")
+    print(f"leases:              {s.get('lease_grants')} granted / "
+          f"{s.get('lease_revokes')} revoked "
+          f"(cap {s.get('lease_slots')} slots; actor pipeline "
+          f"{s.get('actor_pipeline')})")
+    print(f"dispatch frames:     {s.get('dispatch_frames')}"
+          f"  ({s.get('dispatched_tasks')} tasks)")
+    print(f"direct actor calls:  {s.get('direct_actor_calls', 0)}"
+          f"  ({s.get('direct_call_fallbacks', 0)} fell back to the "
+          f"driver path)")
+    print(f"inbound ctrl frames: {s.get('ctrl_frames_in')}")
+    msgs = s.get("ctrl_msgs_in") or {}
+    top = sorted(msgs.items(), key=lambda kv: -kv[1])[:8]
+    if top:
+        print("inbound ctrl msgs:   "
+              + ", ".join(f"{k}={v}" for k, v in top))
+
+
 def cmd_list(args):
     route = {"actors": "/api/actors", "tasks": "/api/tasks",
              "objects": "/api/objects", "nodes": "/api/nodes",
@@ -455,6 +493,13 @@ def main(argv=None):
              "WAL length, last-snapshot age, resume replay count)")
     pp.add_argument("--json", action="store_true")
     pp.set_defaults(fn=cmd_persistence)
+
+    dpp = sub.add_parser(
+        "dispatch",
+        help="batched-dispatch plane health (submit batches, worker "
+             "leases, direct actor calls, control-message counts)")
+    dpp.add_argument("--json", action="store_true")
+    dpp.set_defaults(fn=cmd_dispatch)
 
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("kind", choices=["actors", "tasks", "objects", "nodes",

@@ -55,8 +55,13 @@ class ActorHandle:
             raise AttributeError(name)
         opts = self.__dict__.get("_method_opts", {}).get(name, {})
         # concurrency_group is applied at TaskSpec build, not here
-        return ActorMethod(self, name,
-                           num_returns=opts.get("num_returns", 1))
+        m = ActorMethod(self, name,
+                        num_returns=opts.get("num_returns", 1))
+        # cache on the instance: hot call loops (`h.ping.remote()` per
+        # request) stop paying __getattr__ + an ActorMethod alloc per
+        # call; __reduce__ controls pickling, so the cache never ships
+        self.__dict__[name] = m
+        return m
 
     def _make_task_spec(self, method_name: str, args, kwargs,
                         num_returns=1):

@@ -182,7 +182,13 @@ class NodeAgent:
                 evs = events_mod.drain()
                 if evs:
                     pending.append(("events", evs))
-                while pending:
+                # one coalesced frame per interval (compact binary
+                # codec), not one frame per telemetry kind; a single
+                # leftover skips the envelope
+                if len(pending) > 1:
+                    self.conn.send(("batch", list(pending)))
+                    del pending[:]
+                elif pending:
                     self.conn.send(pending[0])
                     pending.pop(0)
             except (ConnectionClosed, OSError):

@@ -34,6 +34,20 @@ class ObjectRef:
         return isinstance(other, ObjectRef) and other.id == self.id
 
     def __reduce__(self):
+        # Escape hook for the direct-call plane: serializing a ref means
+        # it may reach a reader that resolves through the driver, so a
+        # worker holding this oid as a LOCAL direct-call future must
+        # publish the value driver-side (WorkerRuntime.on_ref_serialized;
+        # no-op on the driver and for ordinary refs).
+        from . import runtime  # noqa: PLC0415
+        rt = runtime._runtime
+        if rt is not None:
+            hook = getattr(rt, "on_ref_serialized", None)
+            if hook is not None:
+                try:
+                    hook(self.id)
+                except Exception:
+                    pass
         return (ObjectRef, (self.id, self._owner_hint))
 
     # Support `await ref` inside async actors / drivers.

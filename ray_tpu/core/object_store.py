@@ -30,13 +30,20 @@ from ..exceptions import ObjectStoreFullError, ObjectLostError
 INLINE_MAX = 64 * 1024
 
 
+_mcat_mod = None
+
+
 def record_read(result: str) -> None:
     """Count one object read by outcome ("inline" | "hit" | "spill").
     Shared by ShmStore and the native arena binding; never raises — a
-    metrics hiccup must not fail a read."""
+    metrics hiccup must not fail a read. The catalog module is cached
+    after the first call (reads are per-get hot)."""
+    global _mcat_mod
     try:
-        from ..util import metrics_catalog as mcat  # noqa: PLC0415
-        mcat.get("ray_tpu_object_store_reads_total").inc(
+        if _mcat_mod is None:
+            from ..util import metrics_catalog  # noqa: PLC0415
+            _mcat_mod = metrics_catalog
+        _mcat_mod.get("ray_tpu_object_store_reads_total").inc(
             tags={"result": result})
     except Exception:
         pass

@@ -42,18 +42,29 @@ from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           TaskCancelledError, TaskError, WorkerCrashedError)
 
 
+_mcat_mod = None
+_ev_mod = None
+
+
 def _mcat():
     # lazy: ray_tpu.util's __init__ imports modules that import THIS
     # module, so a top-level util import would be circular during
-    # package init; every call site runs long after init completes
-    from ..util import metrics_catalog  # noqa: PLC0415
-    return metrics_catalog
+    # package init; cached after the first call (hot paths call this
+    # several times per task — the importlib machinery is measurable)
+    global _mcat_mod
+    if _mcat_mod is None:
+        from ..util import metrics_catalog  # noqa: PLC0415
+        _mcat_mod = metrics_catalog
+    return _mcat_mod
 
 
 def _ev():
-    # same lazy-import rationale as _mcat
-    from ..util import events  # noqa: PLC0415
-    return events
+    # same lazy-import-then-cache rationale as _mcat
+    global _ev_mod
+    if _ev_mod is None:
+        from ..util import events  # noqa: PLC0415
+        _ev_mod = events
+    return _ev_mod
 
 _runtime: Optional[Any] = None
 _runtime_lock = threading.Lock()
@@ -87,7 +98,7 @@ class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
                  "actor_id", "held_resources", "held_tpu_ids", "blocked",
                  "started_at", "purpose", "tpu_capable", "node_id",
-                 "func_calls")
+                 "func_calls", "lease", "direct_addr", "last_progress")
 
     def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
                  purpose=None, tpu_capable: bool = False,
@@ -98,6 +109,20 @@ class WorkerState:
         self.pid: Optional[int] = None
         self.state = "starting"        # starting|idle|busy|actor|dead
         self.current_task: Optional[str] = None
+        # task ids dispatched under this worker's current lease, in
+        # execution order (head = the task actually running; the worker
+        # executes its queue strictly FIFO). One-slot leases are the
+        # legacy single-dispatch case.
+        self.lease: collections.deque = collections.deque()
+        # listener address for direct worker->worker actor calls
+        # (registered at worker startup; None when the worker predates
+        # the direct-call plane or failed to bind)
+        self.direct_addr: Optional[str] = None
+        # last lease grant or completion: the lease progress watchdog
+        # reclaims unstarted slots when the head stalls without parking
+        # in a driver-visible verb (gang tasks spinning in a user-space
+        # rendezvous loop must not pin their peers behind them)
+        self.last_progress = 0.0
         self.actor_id: Optional[str] = None
         self.held_resources: Dict[str, float] = {}
         self.held_tpu_ids: List[int] = []
@@ -178,7 +203,13 @@ class Waiter:
                  needs_bytes: bool = True):
         self.waiter_id = next(Waiter._ids)
         self.oids = oids
-        self.num_returns = len(oids) if num_returns is None else num_returns
+        # settled ids accumulate here so each seal costs one membership
+        # update, not a rescan of every oid (a 1000-ref get used to pay
+        # O(N^2) _object_settled calls across its seals)
+        self.settled: set = set()
+        uniq = len(set(oids))
+        self.num_returns = uniq if num_returns is None \
+            else min(num_returns, uniq)
         self.callback = callback
         self.done = False
         # get-style waiters need the PAYLOAD (a device-resident object
@@ -337,6 +368,42 @@ class DriverRuntime:
         self._gen_evicted_set: set = set()
         # batched-submission round-trips (compiled DAG test hook)
         self.submit_many_calls = 0
+        # ---- decentralized batched dispatch (docs/SCHEDULING.md) ----
+        # .remote() submits coalesce into api_submit_many frames under a
+        # size + time flush window; dispatches grant multi-slot worker
+        # leases; actor dispatch pipelines past max_concurrency (the
+        # worker enforces the real execution bound). RAY_TPU_BATCH=0 is
+        # the kill switch back to the legacy per-message paths.
+        self._batch_enabled = os.environ.get(
+            "RAY_TPU_BATCH", "1") not in ("0", "false")
+        self._flush_n = int(os.environ.get("RAY_TPU_BATCH_FLUSH_N", "64"))
+        self._flush_window = float(os.environ.get(
+            "RAY_TPU_BATCH_FLUSH_S", "0.001"))
+        self._lease_cap = int(os.environ.get("RAY_TPU_LEASE_SLOTS", "32"))
+        self._actor_pipeline = int(os.environ.get(
+            "RAY_TPU_ACTOR_PIPELINE", "32"))
+        if not self._batch_enabled:
+            self._lease_cap = 1
+            self._actor_pipeline = 0
+        self._submit_buf: List[TaskSpec] = []
+        self._submit_buf_lock = threading.Lock()
+        self._submit_buf_event = threading.Event()
+        # dispatch-plane telemetry (state API dispatch_summary / bench
+        # messages-per-task): flushed submit batches, lease lifecycle,
+        # frames and logical messages in each direction
+        self.submit_batches = 0
+        self.batched_submits = 0
+        self.lease_grants = 0
+        self.lease_revokes = 0
+        self.dispatch_frames = 0
+        self.dispatched_tasks = 0
+        self.ctrl_frames = 0
+        self.ctrl_msgs: collections.Counter = collections.Counter()
+        # (worker_id, task_id) pairs reclaimed from a blocked worker's
+        # lease: a result that slips in anyway (revoke raced a user
+        # thread) must be dropped, not double-sealed over the re-run
+        self._revoked_set: set = set()
+        self._revoked_q: collections.deque = collections.deque()
         self._kv_lock = threading.Lock()
         self.pending_actors: collections.deque = collections.deque()
         self.pending_restarts: collections.deque = collections.deque()
@@ -453,6 +520,10 @@ class DriverRuntime:
         # driver, so workers reach them over report_sync channels
         self.report_handlers["sys.cluster_view"] = self._sys_cluster_view
         self.report_handlers["sys.pg"] = self._sys_pg
+        # GCS actor directory for driver-bypass actor calls: a caller
+        # resolves the callee's direct-call address ONCE, then rides a
+        # worker->worker connection (docs/SCHEDULING.md)
+        self.report_handlers["sys.actor_addr"] = self._sys_actor_addr
 
         # restored remote-held objects parked until their node
         # reattaches: nid -> [(oid, loc), ...]; past the grace deadline
@@ -505,6 +576,9 @@ class DriverRuntime:
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="rtpu-reaper")
         self._reaper.start()
+        if self._batch_enabled:
+            threading.Thread(target=self._submit_flush_loop, daemon=True,
+                             name="rtpu-submit-flush").start()
 
     # ================= driver restart / resume =================
     def _restore_from(self, rec) -> None:
@@ -768,7 +842,8 @@ class DriverRuntime:
             msg = conn.recv()
             if msg[0] == "register":
                 wid = msg[1]
-                self.inbox.put(("register", wid, conn, msg[2]))
+                self.inbox.put(("register", wid, conn, msg[2],
+                                msg[3] if len(msg) > 3 else None))
                 while True:
                     m = conn.recv()
                     self.inbox.put(("worker_msg", wid, m))
@@ -822,6 +897,7 @@ class DriverRuntime:
         if kind == "tick":
             self._update_builtin_gauges()
             self._check_node_heartbeats()
+            self._check_lease_watchdog()
             self._check_reattach_grace()
             if self._persist is not None and \
                     self._persist.maybe_snapshot(self._snapshot_tables):
@@ -855,12 +931,14 @@ class DriverRuntime:
             item[1].set()
             return
         if kind == "register":
-            _, wid, conn, pid = item
+            _, wid, conn, pid = item[:4]
             w = self.workers.get(wid)
             if w is None:
                 conn.close()
                 return
             w.conn, w.pid = conn, pid
+            if len(item) > 4:
+                w.direct_addr = item[4]
             self._conn_by_wid[wid] = conn
             if w.purpose is not None:
                 w.state = "actor"
@@ -876,12 +954,14 @@ class DriverRuntime:
                 w.state = "idle"
         elif kind == "worker_msg":
             _, wid, m = item
+            self.ctrl_frames += 1
             self._handle_worker_msg(wid, m)
         elif kind == "worker_dead":
             self._on_worker_dead(item[1])
         elif kind == "register_node":
             self._on_register_node(item[1], item[2])
         elif kind == "node_msg":
+            self.ctrl_frames += 1
             self._handle_node_msg(item[1], item[2],
                                   item[3] if len(item) > 3 else None)
         elif kind == "node_dead":
@@ -956,9 +1036,16 @@ class DriverRuntime:
                 f"[ray_tpu driver] dropped undeserializable message from "
                 f"{wid}:\n{m[1]}")
             return
+        if mtype == "batch":
+            # coalesced worker->driver frame: the inner messages are
+            # ordinary control messages in their original send order
+            for sub in m[1]:
+                self._handle_worker_msg(wid, sub)
+            return
+        self.ctrl_msgs[mtype] += 1
         if w is not None and w.state == "dead" and mtype in (
                 "task_done", "gen_item", "actor_created", "actor_exit",
-                "put", "materialized", "actor_ckpt",
+                "put", "put_error", "materialized", "actor_ckpt",
                 "object_unreachable"):
             # incarnation fence: a worker already declared dead (its node
             # was heartbeat-declared dead, or it was terminated) may still
@@ -1005,6 +1092,15 @@ class DriverRuntime:
                     f"materialize: {m[2]}"))
         elif mtype == "submit":
             self._register_task(m[1])
+        elif mtype == "submit_many":
+            # a worker-side fan-out coalesced into one frame
+            for spec in m[1]:
+                self._register_task(spec)
+        elif mtype == "put_error":
+            # a direct-call result escaped this cluster's caller (its
+            # ref was serialized) but the call errored: fail the object
+            # so driver-side readers see the error, not a hang
+            self._fail_object(m[1], m[2])
         elif mtype == "submit_actor":
             self._register_actor_creation(m[1])
         elif mtype == "get_request":
@@ -1017,6 +1113,22 @@ class DriverRuntime:
             self._kill_actor(m[1], m[2])
         elif mtype == "actor_ckpt":
             self._on_actor_ckpt(wid, m[1], m[2])
+        elif mtype == "dwait":
+            # worker parked on a direct-call future past the grace
+            # window: lend its CPU and reclaim leased slots, exactly
+            # like a driver-path get_request would (symmetric unblock
+            # on dwait False; actor workers never lend, as before)
+            if w is not None and w.state == "busy":
+                if m[1] and not w.blocked:
+                    w.blocked = True
+                    res_mod.release(self._wnode_avail(w),
+                                    _cpu_only(w.held_resources))
+                    if len(w.lease) > 1:
+                        self._reclaim_lease(w)
+                elif not m[1] and w.blocked:
+                    w.blocked = False
+                    res_mod.acquire(self._wnode_avail(w),
+                                    _cpu_only(w.held_resources))
         elif mtype == "object_unreachable":
             self._on_object_unreachable(m[1], m[2],
                                         m[3] if len(m) > 3 else None)
@@ -1150,6 +1262,11 @@ class DriverRuntime:
             ns.heartbeat_missed = False
         mtype = m[0]
         if mtype == "heartbeat":
+            return
+        if mtype == "batch":
+            # agent-side telemetry kinds coalesced into one frame
+            for sub in m[1]:
+                self._handle_node_msg(nid, sub, conn)
             return
         if mtype == RECV_ERROR:
             sys.stderr.write(f"[ray_tpu driver] dropped undeserializable "
@@ -1928,6 +2045,7 @@ class DriverRuntime:
             w.blocked = True
             res_mod.release(self._wnode_avail(w),
                             _cpu_only(w.held_resources))
+            self._reclaim_lease(w)
 
         def cb(result, w=w, rid=rid, blocked_here=blocked_here):
             self._gen_worker_waiters.pop(rid, None)
@@ -1965,16 +2083,20 @@ class DriverRuntime:
     def _notify_object(self, oid: str) -> None:
         for waiter_id in self.object_waiters.pop(oid, []):
             w = self.waiters.get(waiter_id)
-            if w and not w.done:
-                self._check_waiter(w)
-                if not w.done and not self._object_settled(
-                        oid, w.needs_bytes):
-                    # still unsettled for this waiter — e.g. the seal
-                    # carried a DEVICE location and the bytes only land
-                    # with the holder's materialize re-seal: stay
-                    # subscribed or that re-seal would notify nobody
-                    self.object_waiters.setdefault(oid, []).append(
-                        waiter_id)
+            if w is None or w.done:
+                continue
+            if self._object_settled(oid, w.needs_bytes):
+                w.settled.add(oid)
+                if len(w.settled) >= w.num_returns:
+                    self._fire_waiter(waiter_id, timed_out=False)
+                    continue
+            else:
+                # still unsettled for this waiter — e.g. the seal
+                # carried a DEVICE location and the bytes only land
+                # with the holder's materialize re-seal: stay
+                # subscribed or that re-seal would notify nobody
+                self.object_waiters.setdefault(oid, []).append(
+                    waiter_id)
 
     def _object_settled(self, oid: str, needs_bytes: bool = True) -> bool:
         e = self.gcs.objects.get(oid)
@@ -2019,25 +2141,20 @@ class DriverRuntime:
 
     def _add_waiter(self, w: Waiter, timeout: Optional[float] = None):
         self.waiters[w.waiter_id] = w
-        pending = False
         for oid in w.oids:
             if oid not in self.gcs.objects:
                 self.gcs.add_pending_object(oid)
-            if not self._object_settled(oid, w.needs_bytes):
+            if self._object_settled(oid, w.needs_bytes):
+                w.settled.add(oid)
+            else:
                 self.object_waiters.setdefault(oid, []).append(w.waiter_id)
-                pending = True
-        self._check_waiter(w)
+        if len(w.settled) >= w.num_returns:
+            self._fire_waiter(w.waiter_id, timed_out=False)
         if not w.done and timeout is not None:
             t = threading.Timer(
                 timeout, lambda: self.inbox.put(("waiter_timeout", w.waiter_id)))
             t.daemon = True
             t.start()
-
-    def _check_waiter(self, w: Waiter):
-        settled = [oid for oid in w.oids
-                   if self._object_settled(oid, w.needs_bytes)]
-        if len(settled) >= w.num_returns:
-            self._fire_waiter(w.waiter_id, timed_out=False)
 
     def _fire_waiter(self, waiter_id: int, timed_out: bool):
         w = self.waiters.pop(waiter_id, None)
@@ -2419,11 +2536,25 @@ class DriverRuntime:
         # would repeatedly steal the one worker that can run it.
         tpu_demand = any(s.resources.get("TPU", 0) > 0
                          for s in self.pending_tasks)
+        # Placement for an unconstrained task depends only on its
+        # resource shape, so once one shape fails to place in this pass
+        # every identical task behind it would fail the same way — skip
+        # them (a 1k-task fan-out used to pay ~130 full placement
+        # evaluations PER TASK across the passes of its drain).
+        blocked_shapes: set = set()
         while self.pending_tasks:
             spec = self.pending_tasks.popleft()
             te = self.gcs.tasks[spec.task_id]
             if te.state == "CANCELLED":
                 continue
+            shape = None
+            if spec.placement_group_id is None and (
+                    spec.scheduling_strategy is None
+                    or spec.scheduling_strategy == "DEFAULT"):
+                shape = tuple(sorted(spec.resources.items()))
+                if shape in blocked_shapes:
+                    still.append(spec)
+                    continue
             dr = self._deps_ready(spec.dep_object_ids)
             if dr is None:
                 te.state = "FAILED"
@@ -2530,6 +2661,8 @@ class DriverRuntime:
                 else:
                     self._warn_if_stuck(spec.task_id,
                                         f"task {spec.name}", need)
+                if shape is not None:
+                    blocked_shapes.add(shape)
                 still.append(spec)
                 continue
             self._pending_since.pop(spec.task_id, None)
@@ -2539,26 +2672,81 @@ class DriverRuntime:
                     spec.placement_group_id, spec.bundle_index, w.node_id)
             else:
                 spec.tpu_ids = self._take_tpu_ids(node, need, w)
+            # Lease fill (raylet-style, collapsed to the worker level):
+            # grant this worker a bounded batch of compatible queued
+            # tasks in ONE frame. The worker executes them strictly
+            # FIFO against the single resource slot the lease holds;
+            # results return in batched frames. Fill is capped so other
+            # idle capacity still gets its share (a 2-CPU host splits a
+            # fan-out across both workers, never serializes it onto
+            # one), and reclaimed if the running head blocks in get().
+            lease = [spec]
+            if self._lease_cap > 1 and sched_mod.leaseable(spec):
+                fill = self._lease_fill_count(need)
+                while len(lease) < fill and self.pending_tasks:
+                    cand = self.pending_tasks[0]
+                    cte = self.gcs.tasks.get(cand.task_id)
+                    if cte is not None and cte.state == "CANCELLED":
+                        self.pending_tasks.popleft()
+                        continue
+                    if (not sched_mod.leaseable(cand)
+                            or cand.resources != spec.resources
+                            or self._deps_ready(cand.dep_object_ids)
+                            is not True):
+                        break   # contiguous prefix only: FIFO preserved
+                    self.pending_tasks.popleft()
+                    self._pending_since.pop(cand.task_id, None)
+                    lease.append(cand)
             try:
-                w.conn.send(("exec_task", spec))
+                if len(lease) == 1:
+                    w.conn.send(("exec_task", spec))
+                else:
+                    w.conn.send(("exec_task_many", lease))
             except ConnectionClosed:
                 # Worker socket just broke: its death event will arrive via
-                # the reader thread; requeue the spec and keep scheduling.
+                # the reader thread; requeue the specs and keep scheduling.
                 self._return_tpu_ids(w)
                 w.state = "dying"
-                still.append(spec)
+                still.extend(lease)
                 continue
+            self.dispatch_frames += 1
+            self.dispatched_tasks += len(lease)
+            if self._revoked_set:
+                # a task reclaimed from this worker earlier may be
+                # re-dispatched right back to it — its NEW result must
+                # not be dropped by the stale-result guard
+                for s in lease:
+                    self._revoked_set.discard((w.worker_id, s.task_id))
             res_mod.acquire(node.avail, need)
-            w.state, w.current_task = "busy", spec.task_id
+            w.state = "busy"
+            w.lease = collections.deque(s.task_id for s in lease)
+            w.current_task = spec.task_id
             w.held_resources = dict(need)
-            te.state, te.worker_id, te.started_at = ("RUNNING", w.worker_id,
-                                                     time.time())
-            if te.submitted_at:
-                _mcat().get("ray_tpu_task_sched_latency_s").observe(
-                    te.started_at - te.submitted_at)
-            self._emit("task.sched", task_id=spec.task_id,
-                       worker_id=w.worker_id, node_id=w.node_id,
-                       name=spec.name)
+            now = time.time()
+            w.last_progress = now
+            for s in lease:
+                ste = self.gcs.tasks[s.task_id]
+                ste.state, ste.worker_id, ste.started_at = (
+                    "RUNNING", w.worker_id, now)
+                if ste.submitted_at:
+                    _mcat().get("ray_tpu_task_sched_latency_s").observe(
+                        now - ste.submitted_at)
+                self._emit("task.sched", task_id=s.task_id,
+                           worker_id=w.worker_id, node_id=w.node_id,
+                           name=s.name)
+            if len(lease) > 1:
+                self.lease_grants += 1
+                self._emit("task.lease.grant",
+                           f"granted worker {w.worker_id} a "
+                           f"{len(lease)}-slot task lease",
+                           worker_id=w.worker_id, node_id=w.node_id,
+                           task_id=spec.task_id, slots=len(lease))
+                try:
+                    _mcat().get("ray_tpu_lease_grants_total").inc()
+                    _mcat().get("ray_tpu_dispatch_batch_size").observe(
+                        len(lease))
+                except Exception:
+                    pass
         self.pending_tasks = still
 
         # 3. actor tasks
@@ -2582,10 +2770,18 @@ class DriverRuntime:
                 continue
             maxc = self.actor_max_conc.get(aid, 1)
             group_limits = self.actor_group_conc.get(aid) or {}
+            # Pipeline window: dispatch up to `pipeline` calls BEYOND
+            # each lane's concurrency limit. Execution concurrency is
+            # enforced in the worker (thread/group pools, async lane
+            # semaphores), so the extra slots only pre-stage specs in
+            # the worker's queue — one batched frame replaces a
+            # dispatch round-trip per call.
+            pipeline = self._actor_pipeline
+            to_send: List[TaskSpec] = []
 
-            def dispatch(spec, group) -> "Optional[bool]":
-                """Send one spec. True = dispatched, False = consumed
-                without dispatch (failed/cancelled), None = conn died."""
+            def admit(spec, group) -> bool:
+                """Validate one spec for this dispatch round. False =
+                consumed without dispatch (dep-failed / cancelled)."""
                 if self._deps_ready(spec.dep_object_ids) is None:
                     err = TaskError("upstream dependency failed", "",
                                     spec.name)
@@ -2597,69 +2793,87 @@ class DriverRuntime:
                 te = self.gcs.tasks[spec.task_id]
                 if te.state == "CANCELLED":
                     return False
-                try:
-                    w.conn.send(("exec_actor_task", spec))
-                except ConnectionClosed:
-                    return None
                 self.actor_group_inflight[(aid, group)] = \
                     self.actor_group_inflight.get((aid, group), 0) + 1
                 te.concurrency_group = group
-                te.state, te.worker_id, te.started_at = ("RUNNING",
-                                                         w.worker_id,
-                                                         time.time())
-                if te.submitted_at:
-                    _mcat().get("ray_tpu_task_sched_latency_s").observe(
-                        te.started_at - te.submitted_at)
-                self._emit("task.sched", task_id=spec.task_id,
-                           worker_id=w.worker_id, node_id=w.node_id,
-                           actor_id=aid, name=spec.name)
+                to_send.append(spec)
                 return True
 
             if not group_limits:
                 # fast path (no concurrency groups): strict-FIFO
                 # popleft, O(1) per dispatch
                 while q and self.actor_group_inflight.get(
-                        (aid, None), 0) < maxc:
+                        (aid, None), 0) < maxc + pipeline:
                     dr = self._deps_ready(q[0].dep_object_ids)
                     if dr is False:
                         break
+                    admit(q.popleft(), None)
+            else:
+                # Group-aware dispatch (reference: python/ray/actor.py
+                # concurrency_groups): each named group has an
+                # independent in-flight limit, so a saturated/
+                # dep-blocked group is skipped while OTHER groups'
+                # tasks behind it still run — a health-check method
+                # never starves behind a long call. One rotation pass
+                # of the deque (O(n), no remove scans); order WITHIN a
+                # group stays strictly FIFO (blocked set).
+                blocked: set = set()
+                for _ in range(len(q)):
                     spec = q.popleft()
-                    if dispatch(spec, None) is None:
-                        # conn died mid-dispatch: put the spec BACK so
-                        # the actor-death path fails it with
-                        # ActorDiedError — dropping it here leaves its
-                        # return objects pending forever (observed as a
-                        # flaky get() timeout after actor_exit raced a
-                        # method call)
-                        q.appendleft(spec)
-                        break
+                    group = (spec.concurrency_group
+                             if spec.concurrency_group in group_limits
+                             else None)   # None = the default maxc lane
+                    limit = (group_limits[group] if group else maxc) \
+                        + pipeline
+                    if (group in blocked
+                            or self.actor_group_inflight.get(
+                                (aid, group), 0) >= limit
+                            or self._deps_ready(spec.dep_object_ids)
+                            is False):
+                        blocked.add(group)
+                        q.append(spec)   # rotate to the back, order kept
+                        continue
+                    admit(spec, group)
+            if not to_send:
                 continue
-            # Group-aware dispatch (reference: python/ray/actor.py
-            # concurrency_groups): each named group has an independent
-            # in-flight limit, so a saturated/dep-blocked group is
-            # skipped while OTHER groups' tasks behind it still run —
-            # a health-check method never starves behind a long call.
-            # One rotation pass of the deque (O(n), no remove scans);
-            # order WITHIN a group stays strictly FIFO (blocked set).
-            blocked: set = set()
-            conn_dead = False
-            for _ in range(len(q)):
-                spec = q.popleft()
-                group = (spec.concurrency_group
-                         if spec.concurrency_group in group_limits
-                         else None)   # None = the default maxc lane
-                limit = group_limits[group] if group else maxc
-                if (conn_dead or group in blocked
-                        or self.actor_group_inflight.get(
-                            (aid, group), 0) >= limit
-                        or self._deps_ready(spec.dep_object_ids)
-                        is False):
-                    blocked.add(group)
-                    q.append(spec)   # rotate to the back, order kept
-                    continue
-                if dispatch(spec, group) is None:
-                    q.append(spec)
-                    conn_dead = True
+            try:
+                if len(to_send) == 1:
+                    w.conn.send(("exec_actor_task", to_send[0]))
+                else:
+                    w.conn.send(("exec_actor_task_many", to_send))
+            except ConnectionClosed:
+                # conn died mid-dispatch: unwind the bookkeeping and put
+                # the specs BACK so the actor-death path fails them with
+                # ActorDiedError — dropping them here leaves their
+                # return objects pending forever (observed as a flaky
+                # get() timeout after actor_exit raced a method call)
+                for spec in reversed(to_send):
+                    te = self.gcs.tasks[spec.task_id]
+                    gkey = (aid, te.concurrency_group)
+                    self.actor_group_inflight[gkey] = max(
+                        0, self.actor_group_inflight.get(gkey, 0) - 1)
+                    q.appendleft(spec)
+                continue
+            self.dispatch_frames += 1
+            self.dispatched_tasks += len(to_send)
+            now = time.time()
+            for spec in to_send:
+                te = self.gcs.tasks[spec.task_id]
+                te.state, te.worker_id, te.started_at = ("RUNNING",
+                                                         w.worker_id,
+                                                         now)
+                if te.submitted_at:
+                    _mcat().get("ray_tpu_task_sched_latency_s").observe(
+                        now - te.submitted_at)
+                self._emit("task.sched", task_id=spec.task_id,
+                           worker_id=w.worker_id, node_id=w.node_id,
+                           actor_id=aid, name=spec.name)
+            if len(to_send) > 1:
+                try:
+                    _mcat().get("ray_tpu_dispatch_batch_size").observe(
+                        len(to_send))
+                except Exception:
+                    pass
 
     def _pg_tpu_ids(self, pg_id: Optional[str], bundle_index: int,
                     node_id: str) -> List[int]:
@@ -2697,6 +2911,98 @@ class DriverRuntime:
             node.free_tpu_ids = sorted(
                 set(node.free_tpu_ids) | set(w.held_tpu_ids))
         w.held_tpu_ids = []
+
+    # ---------------- worker leases ----------------
+    def _lease_fill_count(self, need: Dict[str, float]) -> int:
+        """How many queued tasks one lease grant may take: bounded by
+        RAY_TPU_LEASE_SLOTS and by the queue's fair share of the
+        cluster's parallelism for this resource shape — a 2-CPU host
+        splits a fan-out across both workers instead of serializing it
+        onto whichever was found first."""
+        remaining = len(self.pending_tasks) + 1
+        par = 0
+        for n in self._alive_nodes():
+            cap = None
+            for r, v in need.items():
+                if v <= 0:
+                    continue
+                c = int(n.total.get(r, 0.0) // v)
+                cap = c if cap is None else min(cap, c)
+            if cap is None:
+                cap = int(n.total.get("CPU", 1)) or 1
+            par += cap
+        par = max(1, par)
+        return max(1, min(self._lease_cap, -(-remaining // par)))
+
+    def _check_lease_watchdog(self) -> None:
+        """Reaper-tick backstop: a leased head that stalls WITHOUT
+        parking in a driver-visible verb (a gang task spinning in a
+        user-space rendezvous poll, a long compute) keeps its unstarted
+        slots pinned — the blocked-head reclaim never fires because the
+        driver never hears a get/wait. Past RAY_TPU_LEASE_HEAD_S of no
+        completions, reclaim the followers; long tasks don't benefit
+        from batching anyway, and gang peers stuck behind the head get
+        to run elsewhere (pre-lease, one-task-per-dispatch gave them
+        separate workers unconditionally)."""
+        if self._lease_cap <= 1:
+            return
+        stall = float(os.environ.get("RAY_TPU_LEASE_HEAD_S", "1.0"))
+        if stall <= 0:
+            return
+        now = time.time()
+        for w in self.workers.values():
+            if (w.state == "busy" and len(w.lease) > 1
+                    and not w.blocked
+                    and now - w.last_progress > stall):
+                self._reclaim_lease(w)
+
+    def _revoked_add(self, wid: str, tid: str) -> None:
+        self._revoked_set.add((wid, tid))
+        self._revoked_q.append((wid, tid))
+        while len(self._revoked_q) > 4096:
+            self._revoked_set.discard(self._revoked_q.popleft())
+
+    def _reclaim_lease(self, w: WorkerState) -> None:
+        """A leased worker's running head blocked in get()/gen_next:
+        slots behind it would wait on the head (or deadlock, if the
+        head waits on one of them) — re-queue them for other workers
+        and fence this worker with revoke_tasks. The revoke frame is
+        sent BEFORE the blocking verb's reply, so on the FIFO
+        connection the worker sees it before its main thread can
+        resume; a result that slips through anyway (user-thread get)
+        is dropped via _revoked_set."""
+        if len(w.lease) <= 1:
+            return
+        head = w.lease.popleft()
+        revoked = list(w.lease)
+        w.lease = collections.deque([head])
+        w.current_task = head
+        for tid in revoked:
+            self._revoked_add(w.worker_id, tid)
+            te = self.gcs.tasks.get(tid)
+            spec = self._respawnable_specs.get(tid)
+            if te is not None and te.state == "RUNNING" \
+                    and spec is not None:
+                te.state, te.worker_id = "PENDING", None
+                self.pending_tasks.append(spec)
+        self.lease_revokes += 1
+        self._emit("task.lease.revoke",
+                   f"worker {w.worker_id} blocked in get(); "
+                   f"{len(revoked)} unstarted lease slots re-queued",
+                   worker_id=w.worker_id, node_id=w.node_id,
+                   task_id=head, slots=len(revoked) + 1)
+        try:
+            _mcat().get("ray_tpu_lease_revokes_total").inc(
+                tags={"reason": "worker_blocked"})
+        except Exception:
+            pass
+        try:
+            w.conn.send(("revoke_tasks", revoked))
+        except (ConnectionClosed, AttributeError):
+            # dying worker: the slots are already re-queued above and no
+            # longer in w.lease, so the death path won't double-queue;
+            # a zombie's stray results are dropped via _revoked_set
+            pass
 
     def _wnode_avail(self, w: WorkerState) -> Dict[str, float]:
         """The avail dict of the worker's node (a throwaway dict if the
@@ -2898,6 +3204,12 @@ class DriverRuntime:
     def _on_task_done(self, wid: str, task_id: str, sealed, error):
         te = self.gcs.tasks.get(task_id)
         w = self.workers.get(wid)
+        if (wid, task_id) in self._revoked_set:
+            # reclaimed lease slot that executed anyway (the revoke
+            # raced a user thread in the worker): the task was already
+            # re-queued elsewhere — drop this result
+            self._revoked_set.discard((wid, task_id))
+            return
         if te is None:
             return
         spec_returns = []
@@ -2947,7 +3259,25 @@ class DriverRuntime:
             self.actor_group_inflight[gkey] = max(
                 0, self.actor_group_inflight.get(gkey, 0) - 1)
         elif w is not None:
-            res_mod.release(self._wnode_avail(w), w.held_resources)
+            w.last_progress = time.time()
+            if task_id in w.lease:
+                try:
+                    w.lease.remove(task_id)
+                except ValueError:
+                    pass
+            if w.state == "busy" and w.lease:
+                # more leased slots queued behind this one: the worker
+                # keeps its resource slot and runs the next task
+                w.current_task = w.lease[0]
+                return
+            if w.blocked:
+                # its CPU was already lent while parked (dwait/get) and
+                # the symmetric unblock never arrived: release only the
+                # non-CPU remainder (mirrors _on_worker_dead)
+                res_mod.release(self._wnode_avail(w),
+                                _non_cpu(w.held_resources))
+            else:
+                res_mod.release(self._wnode_avail(w), w.held_resources)
             self._return_tpu_ids(w)
             w.held_resources = {}
             w.state, w.current_task, w.blocked = "idle", None, False
@@ -3017,34 +3347,61 @@ class DriverRuntime:
         self._emit("worker.death", task_id=w.current_task,
                    actor_id=w.actor_id, worker_id=wid,
                    node_id=w.node_id)
-        # running normal task -> retry or fail
-        if w.current_task:
-            te = self.gcs.tasks.get(w.current_task)
-            if te is not None and te.state == "RUNNING":
-                spec = self._respawnable_specs.get(w.current_task)
-                # Streaming tasks never retry: already-consumed items
-                # would replay and duplicate the stream.
-                if (te.retries_left > 0 and spec is not None
-                        and not getattr(spec, "streaming", False)):
+        # running / leased normal tasks -> retry or fail. Only the
+        # lease HEAD can have started (the worker executes its lease
+        # strictly FIFO), so slots behind it re-queue without burning a
+        # retry — a revoked lease must mean zero lost tasks even at
+        # max_retries=0.
+        leased = list(w.lease) if w.lease else (
+            [w.current_task] if w.current_task else [])
+        w.lease = collections.deque()
+        if len(leased) > 1:
+            self.lease_revokes += 1
+            self._emit("task.lease.revoke",
+                       f"worker {wid} died holding a {len(leased)}-slot "
+                       f"lease; unstarted slots re-queue without "
+                       f"burning a retry",
+                       worker_id=wid, node_id=w.node_id,
+                       task_id=leased[0], slots=len(leased))
+            try:
+                _mcat().get("ray_tpu_lease_revokes_total").inc(
+                    tags={"reason": "worker_death"})
+            except Exception:
+                pass
+        for idx, tid in enumerate(leased):
+            te = self.gcs.tasks.get(tid)
+            if te is None or te.state != "RUNNING":
+                continue
+            spec = self._respawnable_specs.get(tid)
+            # Streaming tasks never retry: already-consumed items
+            # would replay and duplicate the stream.
+            streaming = spec is not None and getattr(spec, "streaming",
+                                                     False)
+            if spec is not None and not streaming and (
+                    idx > 0 or te.retries_left > 0):
+                if idx == 0:
                     te.retries_left -= 1
-                    te.state = "PENDING"
-                    self.pending_tasks.append(spec)
-                    self._emit("task.retry",
-                               f"worker {wid} died while running "
-                               f"{te.name}; resubmitting",
-                               task_id=w.current_task, worker_id=wid,
-                               node_id=w.node_id, name=te.name,
-                               retries_left=te.retries_left)
-                else:
-                    te.state = "FAILED"
-                    err = WorkerCrashedError(
-                        f"worker {wid} died while running {te.name}")
-                    self._emit("task.fail", str(err),
-                               task_id=w.current_task, worker_id=wid,
-                               node_id=w.node_id, name=te.name)
-                    for oid in self._return_ids_of(w.current_task):
-                        self._fail_object(oid, err)
-                    self._gen_settle(w.current_task, err)
+                te.state = "PENDING"
+                te.worker_id = None
+                self.pending_tasks.append(spec)
+                self._emit("task.retry",
+                           (f"worker {wid} died while running "
+                            f"{te.name}; resubmitting") if idx == 0 else
+                           (f"lease on dead worker {wid} revoked before "
+                            f"{te.name} started; resubmitting"),
+                           task_id=tid, worker_id=wid,
+                           node_id=w.node_id, name=te.name,
+                           retries_left=te.retries_left)
+            else:
+                te.state = "FAILED"
+                err = WorkerCrashedError(
+                    f"worker {wid} died while running {te.name}")
+                self._emit("task.fail", str(err),
+                           task_id=tid, worker_id=wid,
+                           node_id=w.node_id, name=te.name)
+                for oid in self._return_ids_of(tid):
+                    self._fail_object(oid, err)
+                self._gen_settle(tid, err)
         # actor hosted here -> restart or mark dead FIRST: sealed
         # objects this worker still held (device-resident returns) must
         # fail with the actor's death_cause, not a bare ObjectLostError
@@ -3292,6 +3649,16 @@ class DriverRuntime:
             res_mod.release(self._wnode_avail(w),
                             _cpu_only(w.held_resources))
         self._add_waiter(waiter, timeout=timeout)
+        if w is not None and w.blocked and not waiter.done \
+                and len(w.lease) > 1:
+            # The get actually PARKED (args-already-ready gets — every
+            # leased task resolving its arg refs — fire synchronously
+            # above and never reach here): leased slots behind the
+            # blocked head would wait on it, or deadlock if the head
+            # waits on one of THEM via a nested ref — pull them back
+            # for other workers. Still ordered before the eventual
+            # get_reply, so the worker is fenced first.
+            self._reclaim_lease(w)
 
     def _worker_wait(self, w, rid, oids, num_returns, timeout):
         def cb(results, ready, w=w, rid=rid):
@@ -3302,6 +3669,12 @@ class DriverRuntime:
                     pass
         waiter = Waiter(oids, num_returns, cb, needs_bytes=False)
         self._add_waiter(waiter, timeout=timeout)
+        if not waiter.done and w is not None and w.state == "busy" \
+                and len(w.lease) > 1:
+            # a lease head parked in wait() pins its unstarted slots
+            # exactly like a parked get() — and can deadlock the same
+            # way if it waits on one of them via a nested ref
+            self._reclaim_lease(w)
 
     # ---------------- control ----------------
     def _cancel(self, task_id: str, force: bool):
@@ -3427,9 +3800,50 @@ class DriverRuntime:
 
     # ================= public API (called from any thread) =================
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        """Register one task. Submits coalesce into api_submit_many
+        batches under a size (RAY_TPU_BATCH_FLUSH_N) + time
+        (RAY_TPU_BATCH_FLUSH_S) flush window, so a `[f.remote() for ...]`
+        fan-out costs the dispatcher one inbox frame per batch — and one
+        scheduling pass per batch — instead of one per call. Verbs whose
+        semantics depend on a prior submit having landed (get/cancel/
+        gen_next/...) flush first; otherwise the pending-object
+        machinery tolerates the ≤1ms reorder."""
         self._respawnable_specs[spec.task_id] = spec
-        self.inbox.put(("api_submit", spec))
+        if not self._batch_enabled:
+            self.inbox.put(("api_submit", spec))
+            return [ObjectRef(oid) for oid in spec.return_ids]
+        with self._submit_buf_lock:
+            self._submit_buf.append(spec)
+            n = len(self._submit_buf)
+        if n >= self._flush_n:
+            self._flush_submits()
+        else:
+            self._submit_buf_event.set()
         return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def _flush_submits(self) -> None:
+        with self._submit_buf_lock:
+            if not self._submit_buf:
+                return
+            buf, self._submit_buf = self._submit_buf, []
+        self.inbox.put(("api_submit_many", buf))
+        self.submit_batches += 1
+        self.batched_submits += len(buf)
+        try:
+            _mcat().get("ray_tpu_submit_batch_size").observe(len(buf))
+        except Exception:
+            pass
+
+    def _submit_flush_loop(self) -> None:
+        """Time bound of the flush window: a solo .remote() with no
+        follow-up verb still lands within ~RAY_TPU_BATCH_FLUSH_S."""
+        while not self._shutdown.is_set():
+            if not self._submit_buf_event.wait(timeout=0.5):
+                continue
+            self._submit_buf_event.clear()
+            if self._flush_window > 0:
+                time.sleep(self._flush_window)
+            self._flush_submits()
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         return self.submit(spec)
@@ -3439,6 +3853,7 @@ class DriverRuntime:
         dispatcher round-trip — compiled DAG levels come through here
         (SURVEY C16: batched submissions; vs one inbox message per
         .remote() call)."""
+        self._flush_submits()   # keep inter-batch submission order
         specs = list(specs)
         for spec in specs:
             self._respawnable_specs[spec.task_id] = spec
@@ -3458,6 +3873,7 @@ class DriverRuntime:
             box["r"] = result
             ev.set()
 
+        self._flush_submits()   # the stream's submit may still be buffered
         self.inbox.put(("api_gen_next", task_id, cb, abandoned))
         if not ev.wait(timeout):
             abandoned[0] = True
@@ -3494,6 +3910,7 @@ class DriverRuntime:
             box.update(results)
             ev.set()
 
+        self._flush_submits()   # no flush-window latency on submit->get
         waiter = Waiter(oids, None, cb)
         self.inbox.put(("api_waiter", waiter))
         if not ev.wait(timeout):
@@ -3547,6 +3964,7 @@ class DriverRuntime:
             box["ready"] = ready
             ev.set()
 
+        self._flush_submits()
         waiter = Waiter([r.id for r in refs], num_returns, cb,
                         needs_bytes=False)
         self.inbox.put(("api_waiter", waiter))
@@ -3563,16 +3981,22 @@ class DriverRuntime:
         return ready, not_ready
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._flush_submits()   # queued calls must land before the kill
         self.inbox.put(("api_kill_actor", actor_id, no_restart))
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        # cancel resolves object -> producing task in the dispatcher:
+        # the submit that created the object must be in the inbox first
+        self._flush_submits()
         self.inbox.put(("api_cancel_obj", ref.id, force))
 
     def cancel_task(self, task_id: str, force: bool = False) -> None:
         """Cancel by task id (streaming-generator handles)."""
+        self._flush_submits()
         self.inbox.put(("api_cancel", task_id, force))
 
     def free(self, refs: List[ObjectRef]) -> None:
+        self._flush_submits()
         self.inbox.put(("api_free", [r.id for r in refs]))
 
     def report(self, channel: str, payload: Any) -> None:
@@ -3743,6 +4167,53 @@ class DriverRuntime:
         return (aid, ae.class_name,
                 getattr(ae.create_spec, "method_opts", {}) or {})
 
+    def _sys_actor_addr(self, _wid, actor_id):
+        """GCS actor directory (report_sync): the callee's direct-call
+        address for driver-bypass actor calls. One lookup per
+        (caller, actor) pair steady-state. None = never reachable
+        direct (dead, or its worker runs no direct server — the caller
+        backs off for a while); "pending" = constructing/restarting
+        (the caller retries almost immediately, so the first calls of a
+        fresh actor don't condemn a whole burst to the driver path)."""
+        ae = self.gcs.actors.get(actor_id)
+        if ae is None or ae.state == "DEAD":
+            return None
+        if ae.state != "ALIVE" or not ae.worker_id:
+            return "pending"
+        w = self.workers.get(ae.worker_id)
+        if w is None or w.state == "dead":
+            return "pending"   # death determination/restart in flight
+        if not w.direct_addr:
+            return None        # worker has no direct-call listener
+        return (ae.worker_id, w.direct_addr, ae.num_restarts)
+
+    def dispatch_stats(self) -> Dict[str, Any]:
+        """Dispatch-plane counters for the state API / CLI / bench:
+        submit batching, lease lifecycle, frame and logical-message
+        counts (messages-per-task is the control-plane amplification
+        the batching exists to kill)."""
+        from .protocol import wire_enabled  # noqa: PLC0415
+        return {
+            "batching_enabled": self._batch_enabled,
+            "binary_wire_enabled": wire_enabled(),
+            "flush_max_tasks": self._flush_n,
+            "flush_window_s": self._flush_window,
+            "lease_slots": self._lease_cap,
+            "actor_pipeline": self._actor_pipeline,
+            "submit_many_calls": self.submit_many_calls,
+            "submit_batches": self.submit_batches,
+            "batched_submits": self.batched_submits,
+            "avg_submit_batch": round(
+                self.batched_submits / self.submit_batches, 2)
+            if self.submit_batches else None,
+            "lease_grants": self.lease_grants,
+            "lease_revokes": self.lease_revokes,
+            "dispatch_frames": self.dispatch_frames,
+            "dispatched_tasks": self.dispatched_tasks,
+            "ctrl_frames_in": self.ctrl_frames,
+            "ctrl_msgs_in": dict(self.ctrl_msgs),
+        }
+
     def _sys_cluster_view(self, _wid, _payload) -> List[Dict]:
         """report_sync channel: live node capacity views for worker-side
         schedulers (the serve autoscaler's bin-pack feasibility)."""
@@ -3823,7 +4294,9 @@ class DriverRuntime:
     def shutdown(self) -> None:
         if self._shutdown.is_set():
             return
+        self._flush_submits()
         self._shutdown.set()
+        self._submit_buf_event.set()   # unblock the flush loop
         if self._persist is not None:
             # final snapshot BEFORE teardown: it must capture the live
             # cluster (ALIVE actors, sealed objects), not the storm of

@@ -48,6 +48,23 @@ def strategy_plan(strategy, pg_allowed: List[str]):
     return [[]], False
 
 
+def leaseable(spec) -> bool:
+    """True when a task may ride a multi-slot worker lease (batched
+    dispatch, runtime._schedule): placement must be unconstrained —
+    leased slots execute wherever the lease head landed — and the task
+    must be safe to re-queue without side effects on the worker pool.
+    Excluded: placement groups and affinity/SPREAD strategies (their
+    placement is per-task), TPU tasks (chip reservations are per-task),
+    streaming generators (their item protocol is per-dispatch), and
+    max_calls tasks (worker recycling counts individual dispatches)."""
+    return (getattr(spec, "placement_group_id", None) is None
+            and (spec.scheduling_strategy is None
+                 or spec.scheduling_strategy == "DEFAULT")
+            and not getattr(spec, "streaming", False)
+            and getattr(spec, "max_calls", 0) == 0
+            and spec.resources.get("TPU", 0) <= 0)
+
+
 def hard_affinity_node(strategy) -> Optional[str]:
     if (isinstance(strategy, NodeAffinitySchedulingStrategy)
             and not strategy.soft):

@@ -98,8 +98,13 @@ def extract_arg_deps(args: Tuple, kwargs: Dict[str, Any]) -> List[str]:
     substitutes their values before invoking the function (same contract as
     the reference: nested refs are passed through un-resolved)."""
     from .object_ref import ObjectRef  # noqa: PLC0415
+    if not args and not kwargs:
+        return []
     deps = []
-    for a in list(args) + list(kwargs.values()):
+    for a in args:
+        if isinstance(a, ObjectRef):
+            deps.append(a.id)
+    for a in kwargs.values():
         if isinstance(a, ObjectRef):
             deps.append(a.id)
     return deps

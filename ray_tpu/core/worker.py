@@ -10,6 +10,7 @@ Run as: python -m ray_tpu.core.worker <socket_path> <worker_id>
 """
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import sys
@@ -26,11 +27,189 @@ from .object_ref import ObjectRef
 from .object_store import ShmStore, ObjectLocation, INLINE_MAX, make_store
 from .protocol import Connection, ConnectionClosed, connect_address
 from .task import TaskSpec, ActorCreationSpec
-from ..exceptions import TaskError, GetTimeoutError, ObjectLostError
+from ..exceptions import (ActorDiedError, TaskError, GetTimeoutError,
+                          ObjectLostError)
 from ..util import events as events_mod
 from ..util import metrics as metrics_mod
 from ..util import metrics_catalog as mcat
 from ..util import tracing
+
+
+class _MsgBatcher:
+    """Coalesces worker->driver control messages (task_done / put /
+    gen_item / submit) into ("batch", [...]) frames under a size + time
+    flush window, so a map-style fan-out (or a storm of sub-millisecond
+    completions) costs one frame per batch instead of one per message.
+    Send order is preserved across message kinds — dependent verbs
+    (get_request after a buffered put) flush first. urgent=True flushes
+    synchronously: the task queue drained, or a verb the driver must
+    see NOW (actor_exit's final result) depends on the message."""
+
+    def __init__(self, conn: Connection, max_n: int = 64,
+                 window: float = 0.001, enabled: bool = True):
+        self.conn = conn
+        self.max_n = max_n
+        self.window = window
+        self.enabled = enabled and max_n > 1
+        self._buf: List[tuple] = []
+        self._lock = threading.Lock()
+        # serializes swap+send so flush() only returns once every
+        # message buffered BEFORE the call is on the socket — the
+        # ordering fences (actor_exit / kill / get after buffered put)
+        # rely on that, and a bare buffer-swap in the loop thread would
+        # let flush() return with the frame still unsent
+        self._send_lock = threading.Lock()
+        self._event = threading.Event()
+        if self.enabled:
+            threading.Thread(target=self._loop, daemon=True,
+                             name="worker-msg-flush").start()
+
+    def send(self, msg: tuple, urgent: bool = False) -> None:
+        if not self.enabled:
+            self.conn.send(msg)
+            return
+        with self._lock:
+            self._buf.append(msg)
+            n = len(self._buf)
+        if urgent or n >= self.max_n:
+            self.flush()
+        else:
+            self._event.set()
+
+    def flush(self) -> None:
+        with self._send_lock:
+            with self._lock:
+                if not self._buf:
+                    return
+                buf, self._buf = self._buf, []
+            if len(buf) == 1:
+                self.conn.send(buf[0])
+            else:
+                self.conn.send(("batch", buf))
+
+    def _loop(self) -> None:
+        while True:
+            if not self._event.wait(timeout=0.5):
+                continue
+            self._event.clear()
+            if self.window > 0:
+                time.sleep(self.window)
+            try:
+                self.flush()
+            except Exception:
+                pass   # ConnectionClosed: read loop handles the death
+
+
+class _DirectFuture:
+    """Local future for one driver-bypass actor call (the caller owns
+    the result; the driver never hears about the task). `failover`
+    flips when the channel died and the spec was resubmitted through
+    the driver — the oid then resolves via the normal get path."""
+    __slots__ = ("ev", "payload", "error", "failover", "publish",
+                 "_published")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.payload: Optional[bytes] = None   # serialization.pack(...)
+        self.error: Optional[BaseException] = None
+        self.failover = False
+        # an escaped ref (serialized out of this process) must seal the
+        # value driver-side so any reader anywhere can resolve it
+        self.publish = False
+        self._published = False
+
+
+class _DirectChannel:
+    """Caller side of one worker->worker direct-call connection
+    (resolved once via the sys.actor_addr directory, then every call
+    rides this socket with zero driver messages)."""
+
+    def __init__(self, rt: "WorkerRuntime", actor_id: str,
+                 worker_id: str, addr: str):
+        self.rt = rt
+        self.actor_id = actor_id
+        self.worker_id = worker_id
+        self.conn = connect_address(addr, timeout=5.0)
+        self.dead = False
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._pending: Dict[int, tuple] = {}   # rid -> (spec, future)
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name=f"dcall-{actor_id[-8:]}").start()
+
+    def call(self, spec: TaskSpec, fut: _DirectFuture) -> bool:
+        with self._lock:
+            if self.dead:
+                return False
+            self._rid += 1
+            rid = self._rid
+            self._pending[rid] = (spec, fut)
+        try:
+            self.conn.send(("dcall", rid, spec))
+        except ConnectionClosed as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+            self._fail(f"send failed: {e}")
+            return False
+        return True
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                m = self.conn.recv()
+            except ConnectionClosed as e:
+                self._fail(f"connection lost: {e}")
+                return
+            if m[0] == "dresult":
+                _, rid, ok, payload = m
+                with self._lock:
+                    entry = self._pending.pop(rid, None)
+                if entry is None:
+                    continue
+                _spec, fut = entry
+                if ok:
+                    fut.payload = payload
+                else:
+                    fut.error = payload if isinstance(
+                        payload, BaseException) else TaskError(str(payload))
+                self.rt._direct_resolved(fut)
+            elif m[0] == "dreject":
+                # stale address (the actor moved / died): every pending
+                # call fails over through the driver and the channel is
+                # retired — the next call re-resolves the directory
+                self._fail("callee rejected (stale address)")
+                return
+
+    def _fail(self, reason: str = "") -> None:
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            pending, self._pending = self._pending, {}
+        if pending or reason:
+            sys.stderr.write(
+                f"[ray_tpu worker] direct channel to actor "
+                f"{self.actor_id} failed ({reason}); "
+                f"{len(pending)} in-flight calls fail over to the "
+                f"driver path\n")
+        self.rt._drop_direct_channel(self.actor_id, self)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        # in-flight direct calls FAIL OVER to the driver path: the
+        # driver then applies its normal actor semantics (queue behind
+        # a restart, or ActorDiedError with the death cause)
+        for _rid, (spec, fut) in pending.items():
+            fut.failover = True
+            try:
+                self.rt._batch.send(("submit", spec), urgent=True)
+            except Exception:
+                fut.failover = False
+                fut.error = ActorDiedError(
+                    f"direct call to actor {self.actor_id} lost its "
+                    f"channel and the driver connection is gone")
+            self.rt._direct_resolved(fut)
 
 
 class WorkerRuntime:
@@ -41,6 +220,11 @@ class WorkerRuntime:
     """
 
     is_driver = False
+
+    # resolved direct-call results retained past this bound evict
+    # oldest-first (their refs were never re-read); a late get of an
+    # evicted one raises ObjectLostError naming the bound
+    _DIRECT_RESULT_RETAIN = 8192
 
     def __init__(self, conn: Connection, worker_id: str, store: ShmStore):
         self.conn = conn
@@ -60,6 +244,45 @@ class WorkerRuntime:
         # as RuntimeContext.was_current_actor_reconstructed)
         self.actor_restored = False
         self.job_id = os.environ.get("RAY_TPU_JOB_ID", "job-default")
+        # outbound control-message batcher (WorkerLoop swaps in the
+        # real one before the first task runs); the default passthrough
+        # keeps early sends working
+        self._batch = _MsgBatcher(conn, enabled=False)
+        # ---- driver-bypass actor calls (docs/SCHEDULING.md) ----
+        self._direct_enabled = os.environ.get(
+            "RAY_TPU_DIRECT_CALLS", "1") not in ("0", "false")
+        self._direct_lock = threading.Lock()
+        self._direct_chans: Dict[str, _DirectChannel] = {}
+        self._direct_retry_after: Dict[str, float] = {}
+        # oid -> _DirectFuture for calls this process fired direct;
+        # insertion-ordered so resolution-retention can evict oldest
+        self._direct_results: "collections.OrderedDict[str, _DirectFuture]" \
+            = collections.OrderedDict()
+        self._direct_evicted: set = set()
+        self._direct_cv = threading.Condition()
+        # threads inside force_driver_path() route actor calls through
+        # the driver (rendezvous/polling patterns whose LIVENESS depends
+        # on the scheduler seeing their blocking verbs — the driver path
+        # lends the worker's CPU while it waits; util/collective.py)
+        self._no_direct = threading.local()
+        self.direct_calls = 0
+        self.direct_fallbacks = 0
+
+    def force_driver_path(self):
+        """Context manager: actor calls from this thread take the
+        driver dispatch path even when a direct channel exists."""
+        import contextlib  # noqa: PLC0415
+        rt = self
+
+        @contextlib.contextmanager
+        def cm():
+            prev = getattr(rt._no_direct, "on", False)
+            rt._no_direct.on = True
+            try:
+                yield
+            finally:
+                rt._no_direct.on = prev
+        return cm()
 
     # ---- request/reply over the driver connection -------------------------
     def _new_req(self) -> str:
@@ -96,29 +319,195 @@ class WorkerRuntime:
         if q is not None:
             q.put(payload)
 
+    # ---- direct actor calls ----------------------------------------------
+    def _direct_resolved(self, fut: _DirectFuture) -> None:
+        """Channel-reader-side resolution: wake waiters and run the
+        escape publication if this result's ref left the process."""
+        fut.ev.set()
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        if fut.publish and not fut.failover:
+            for oid, f in list(self._direct_results.items()):
+                if f is fut:
+                    self._publish_direct(oid, fut)
+                    break
+
+    def _publish_direct(self, oid: str, fut: _DirectFuture) -> None:
+        """Seal a direct-call result into the driver's object table: its
+        ref escaped this process (was serialized into a spec / put /
+        return value), so readers anywhere must be able to resolve it."""
+        if fut._published or fut.failover:
+            return
+        fut._published = True
+        try:
+            # straight to the socket, NOT through the batcher: this can
+            # run from inside a batch flush (ObjectRef.__reduce__ fires
+            # while the flush pickles a buffered spec, under the
+            # batcher's non-reentrant send lock — an urgent batched send
+            # here would self-deadlock). Connection.send encodes outside
+            # its socket lock, so the nested frame is safe and lands
+            # BEFORE the spec that references the oid.
+            if fut.error is not None:
+                self.conn.send(("put_error", oid, fut.error))
+            else:
+                loc = self.store.put_packed(oid, fut.payload)
+                self.conn.send(("put", oid, loc))
+        except Exception:
+            pass   # driver gone: nothing to publish to
+
+    def on_ref_serialized(self, oid: str) -> None:
+        """ObjectRef.__reduce__ hook: a ref leaving this process by
+        serialization may reach readers that resolve through the
+        driver — publish direct-call results so they can."""
+        fut = self._direct_results.get(oid)
+        if fut is None or fut.publish or fut.failover:
+            return
+        fut.publish = True
+        if fut.ev.is_set():
+            self._publish_direct(oid, fut)
+
+    def _register_direct_future(self, oid: str, fut: _DirectFuture) -> None:
+        self._direct_results[oid] = fut
+        while len(self._direct_results) > self._DIRECT_RESULT_RETAIN:
+            old_oid, old = next(iter(self._direct_results.items()))
+            if not old.ev.is_set():
+                break   # oldest still in flight: don't evict live calls
+            del self._direct_results[old_oid]
+            if old._published or old.failover:
+                # the value lives driver-side (escaped-ref publication /
+                # failover resubmit): later local gets resolve it over
+                # the normal driver path — only a never-published local
+                # result is actually lost
+                continue
+            self._direct_evicted.add(old_oid)
+            while len(self._direct_evicted) > 4 * self._DIRECT_RESULT_RETAIN:
+                self._direct_evicted.pop()
+
+    def _drop_direct_channel(self, actor_id: str,
+                             ch: _DirectChannel) -> None:
+        with self._direct_lock:
+            if self._direct_chans.get(actor_id) is ch:
+                del self._direct_chans[actor_id]
+
+    def _direct_channel(self, actor_id: str) -> Optional[_DirectChannel]:
+        with self._direct_lock:
+            ch = self._direct_chans.get(actor_id)
+            if ch is not None and not ch.dead:
+                return ch
+        if self._direct_retry_after.get(actor_id, 0) > time.monotonic():
+            return None
+        try:
+            info = self.report_sync("sys.actor_addr", actor_id,
+                                    timeout=10.0)
+        except Exception:
+            info = None
+        if info == "pending":
+            # callee still constructing (or restarting): this call falls
+            # back, and the NEXT call retries the directory immediately.
+            # No timed backoff here — driver-path calls run in ~1ms, so
+            # even a 50ms pause let entire short bursts complete before
+            # the channel ever got a chance to establish; one extra
+            # report_sync per call, bounded by construction time, is
+            # cheaper than condemning the burst to the fallback path.
+            return None
+        if not info:
+            self._direct_retry_after[actor_id] = time.monotonic() + 1.0
+            return None
+        callee_wid, addr, _epoch = info
+        try:
+            ch = _DirectChannel(self, actor_id, callee_wid, addr)
+        except Exception:
+            self._direct_retry_after[actor_id] = time.monotonic() + 1.0
+            return None
+        with self._direct_lock:
+            live = self._direct_chans.get(actor_id)
+            if live is not None and not live.dead:
+                try:
+                    ch.conn.close()
+                except Exception:
+                    pass
+                return live
+            self._direct_chans[actor_id] = ch
+        events_mod.emit(
+            "task.dispatch.local",
+            f"direct call channel to actor {actor_id} "
+            f"(worker {callee_wid}) established; steady-state calls "
+            f"bypass the driver",
+            actor_id=actor_id, worker_id=self.worker_id)
+        return ch
+
+    def _try_direct_call(self, spec: TaskSpec) -> bool:
+        ch = self._direct_channel(spec.actor_id)
+        if ch is None:
+            self.direct_fallbacks += 1
+            try:
+                mcat.get("ray_tpu_direct_call_fallbacks_total").inc(
+                    tags={"reason": "no_address"})
+            except Exception:
+                pass
+            return False
+        oid = spec.return_ids[0]
+        fut = _DirectFuture()
+        self._register_direct_future(oid, fut)
+        if not ch.call(spec, fut):
+            self._direct_results.pop(oid, None)
+            self.direct_fallbacks += 1
+            try:
+                mcat.get("ray_tpu_direct_call_fallbacks_total").inc(
+                    tags={"reason": "channel_died"})
+            except Exception:
+                pass
+            return False
+        self.direct_calls += 1
+        try:
+            mcat.get("ray_tpu_direct_actor_calls_total").inc()
+        except Exception:
+            pass
+        return True
+
     # ---- core verbs -------------------------------------------------------
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         oids = [r.id for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
         # device-resident fast path: objects THIS worker produced are
         # served from the in-process table — no driver round-trip, no
         # D2H, no deserialization (core/device_store.py)
         from . import device_store  # noqa: PLC0415
         local = {}
+        direct: Dict[str, _DirectFuture] = {}
         for oid in oids:
             try:
                 local[oid] = device_store.get(oid)
+                continue
             except KeyError:
                 pass
+            fut = self._direct_results.get(oid)
+            if fut is not None:
+                direct[oid] = fut
+            elif oid in self._direct_evicted:
+                raise ObjectLostError(
+                    f"direct-call result {oid} was evicted (held past "
+                    f"the {self._DIRECT_RESULT_RETAIN}-entry retention "
+                    f"bound without being read)")
         if len(local) == len(oids):
             return [local[oid] for oid in oids]
-        remote_oids = [oid for oid in oids if oid not in local]
-        rid = self._new_req()
-        self.conn.send(("get_request", rid, remote_oids, timeout))
-        results = self._take_reply(rid, timeout)  # {oid: (kind, payload)}
+        remote_oids = [oid for oid in oids
+                       if oid not in local and oid not in direct]
+        results: Dict[str, tuple] = {}
+        rid = None
+        if remote_oids:
+            self._batch.flush()   # a buffered put/submit may feed this
+            rid = self._new_req()
+            self.conn.send(("get_request", rid, remote_oids, timeout))
+            results = self._take_reply(rid, timeout)
         out = []
         for oid in oids:
             if oid in local:
                 out.append(local[oid])
+                continue
+            if oid in direct:
+                out.append(self._resolve_direct(oid, direct[oid],
+                                                deadline))
                 continue
             kind, payload = results[oid]
             if kind == "error":
@@ -142,6 +531,48 @@ class WorkerRuntime:
                     # re-hosted bytes). One retry closes the race.
                     out.append(self._get_one_fresh(oid, timeout))
         return out
+
+    def _resolve_direct(self, oid: str, fut: _DirectFuture,
+                        deadline: Optional[float]) -> Any:
+        if not fut.ev.is_set():
+            # short grace first: a round-trip-fast direct reply must not
+            # cost driver messages (the zero-message property). Past it,
+            # tell the driver we are BLOCKED so it lends this worker's
+            # CPU and reclaims leased slots — exactly what a driver-path
+            # get_request would have triggered (capacity-tight gang
+            # workloads rely on that lend for liveness).
+            grace = 0.005 if deadline is None \
+                else max(0.0, min(0.005, deadline - time.monotonic()))
+            if not fut.ev.wait(grace):
+                notified = False
+                try:
+                    self.conn.send(("dwait", True))
+                    notified = True
+                except Exception:
+                    pass
+                try:
+                    remaining = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    ok = fut.ev.wait(remaining)
+                finally:
+                    if notified:
+                        try:
+                            self.conn.send(("dwait", False))
+                        except Exception:
+                            pass
+                if not ok:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for direct call "
+                        f"result {oid}")
+        if fut.failover:
+            # the channel died mid-call and the spec was resubmitted
+            # through the driver: resolve the oid the normal way
+            remaining = None if deadline is None \
+                else max(0.1, deadline - time.monotonic())
+            return self._get_one_fresh(oid, remaining)
+        if fut.error is not None:
+            raise fut.error
+        return serialization.unpack(fut.payload)
 
     def _get_one_fresh(self, oid: str, timeout: Optional[float],
                        _retried: bool = False) -> Any:
@@ -181,10 +612,11 @@ class WorkerRuntime:
         # materialized copy only if a consumer elsewhere needs it
         loc = device_store.try_keep(self.store, self.worker_id, oid,
                                     value)
-        self.conn.send(("put", oid, loc))
+        self._batch.send(("put", oid, loc))
         return ObjectRef(oid)
 
-    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+    def _driver_wait(self, refs, num_returns, timeout):
+        self._batch.flush()
         rid = self._new_req()
         self.conn.send(("wait_request", rid, [r.id for r in refs],
                         num_returns, timeout))
@@ -193,24 +625,85 @@ class WorkerRuntime:
         not_ready = [r for r in refs if r.id not in ready_ids]
         return ready, not_ready
 
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        direct = {r.id: f for r in refs
+                  if (f := self._direct_results.get(r.id)) is not None
+                  and not f.failover}
+        if not direct:
+            return self._driver_wait(refs, num_returns, timeout)
+        # Mixed wait: direct-call futures settle locally (errored counts
+        # as ready, like any settled object), driver-owned refs settle
+        # through wait_request. The driver leg runs in bounded slices so
+        # a direct completion is observed within ~0.2s.
+        deadline = None if timeout is None \
+            else time.monotonic() + (timeout or 0)
+        others = [r for r in refs if r.id not in direct]
+        ready_ids: set = set()
+        while True:
+            # a channel death mid-wait flips futures to failover (the
+            # spec was resubmitted through the driver): migrate those
+            # refs to the driver leg or they would never settle here
+            flipped = [oid for oid, f in direct.items() if f.failover]
+            if flipped:
+                for oid in flipped:
+                    del direct[oid]
+                others.extend(r for r in refs
+                              if r.id in flipped and r.id not in ready_ids)
+            ready_ids |= {oid for oid, f in direct.items()
+                          if f.ev.is_set()}
+            need = num_returns - len(ready_ids)
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if need <= 0 or (remaining is not None and remaining <= 0):
+                break
+            if others:
+                slice_t = 0.2 if remaining is None \
+                    else max(0.0, min(0.2, remaining))
+                got, _ = self._driver_wait(
+                    others, min(need, len(others)), slice_t)
+                ready_ids |= {r.id for r in got}
+                others = [r for r in others if r.id not in ready_ids]
+            else:
+                with self._direct_cv:
+                    self._direct_cv.wait(
+                        0.2 if remaining is None else min(0.2, remaining))
+        ready = [r for r in refs if r.id in ready_ids]
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        self.conn.send(("submit", spec))
+        self._batch.send(("submit", spec))
         return [ObjectRef(oid) for oid in spec.return_ids]
 
     def create_actor(self, acspec: ActorCreationSpec) -> None:
         self.conn.send(("submit_actor", acspec))
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self.conn.send(("submit", spec))
+        # Driver-bypass fast path: actor-to-actor (and any worker->
+        # actor) unary calls resolve the callee's address once via the
+        # GCS actor directory, then ride a direct worker->worker
+        # connection — zero driver control messages steady-state. The
+        # driver path stays as the instrumented fallback (streaming and
+        # multi-return calls always use it).
+        if (self._direct_enabled and spec.actor_id
+                and not getattr(spec, "streaming", False)
+                and len(spec.return_ids) == 1
+                and not getattr(self._no_direct, "on", False)
+                and self._try_direct_call(spec)):
+            return [ObjectRef(spec.return_ids[0])]
+        self._batch.send(("submit", spec))
         return [ObjectRef(oid) for oid in spec.return_ids]
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._batch.flush()   # buffered calls must land before the kill
         self.conn.send(("kill_actor", actor_id, no_restart))
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._batch.flush()
         self.conn.send(("cancel", ref.id, force))
 
     def cancel_task(self, task_id: str, force: bool = False) -> None:
+        self._batch.flush()
         self.conn.send(("cancel", task_id, force))
 
     def report(self, channel: str, payload: Any) -> None:
@@ -218,6 +711,7 @@ class WorkerRuntime:
         self.conn.send(("report", channel, payload))
 
     def report_sync(self, channel: str, payload: Any, timeout=None) -> Any:
+        self._batch.flush()
         rid = self._new_req()
         self.conn.send(("report_sync", rid, channel, payload))
         return self._take_reply(rid, timeout)
@@ -227,6 +721,7 @@ class WorkerRuntime:
         driver for the next item ref (blocks until one streams in)."""
         from .object_ref import ObjectRef  # noqa: PLC0415
         from ..exceptions import TaskError  # noqa: PLC0415
+        self._batch.flush()
         rid = self._new_req()
         self.conn.send(("gen_next_request", rid, task_id))
         try:
@@ -263,6 +758,8 @@ class WorkerRuntime:
 
 def _resolve_args(rt: WorkerRuntime, args, kwargs):
     """Fetch top-level ObjectRef args (deps are ready by scheduling time)."""
+    if not args and not kwargs:
+        return args, kwargs
     refs = [a for a in list(args) + list(kwargs.values())
             if isinstance(a, ObjectRef)]
     if not refs:
@@ -274,6 +771,84 @@ def _resolve_args(rt: WorkerRuntime, args, kwargs):
     new_kwargs = {k: (table[v.id] if isinstance(v, ObjectRef) else v)
                   for k, v in kwargs.items()}
     return new_args, new_kwargs
+
+
+class DirectCallServer:
+    """Per-worker listener for driver-bypass actor calls. An incoming
+    ("dcall", rid, spec) enqueues into the SAME execution lanes as
+    driver dispatch (main loop / thread pools / async loop), so
+    max_concurrency and concurrency groups hold; the reply carries the
+    packed VALUE straight back — no store seal, no driver message."""
+
+    def __init__(self, loop: "WorkerLoop", driver_address: str):
+        import tempfile  # noqa: PLC0415
+        self._loop = loop
+        self._conns: List[Connection] = []
+        if str(driver_address).startswith("tcp://"):
+            # remote-node worker: peers on other hosts must reach us
+            from .protocol import tcp_listener  # noqa: PLC0415
+            from ..util.netutil import routable_ip  # noqa: PLC0415
+            self._listener = tcp_listener("0.0.0.0", 0)
+            port = self._listener.getsockname()[1]
+            self.address = f"tcp://{routable_ip()}:{port}"
+        else:
+            from .protocol import unix_listener  # noqa: PLC0415
+            # prefer the driver's log dir (cleaned up at driver
+            # shutdown) over a per-worker tmpdir that os._exit leaks
+            base = os.environ.get("RAY_TPU_LOG_DIR")
+            if not base or not os.path.isdir(base):
+                base = tempfile.mkdtemp(prefix="ray_tpu_dcall_")
+            path = os.path.join(
+                base, f"dcall-{loop.worker_id}-{os.getpid()}.sock")
+            self._listener = unix_listener(path)
+            self.address = path
+        threading.Thread(target=self._accept, daemon=True,
+                         name="dcall-accept").start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = Connection(sock)
+            self._conns.append(conn)
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True, name="dcall-reader").start()
+
+    def _reader(self, conn: Connection) -> None:
+        while True:
+            try:
+                m = conn.recv()
+            except ConnectionClosed:
+                return
+            if m[0] != "dcall":
+                continue
+            _, rid, spec = m
+            rt = self._loop.rt
+            if (spec.actor_id != rt.current_actor_id
+                    or self._loop._actor_instance is None):
+                # stale directory entry (actor moved/died since the
+                # caller resolved it): the caller fails over and
+                # re-resolves — never execute under a wrong identity
+                try:
+                    conn.send(("dreject", rid))
+                except ConnectionClosed:
+                    return
+                continue
+            spec._direct_ch = (conn, rid)
+            self._loop._task_q.put(("actor_task", spec))
+
+    def close(self) -> None:
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except Exception:
+                pass
 
 
 class WorkerLoop:
@@ -292,7 +867,36 @@ class WorkerLoop:
         self._actor_pool: Optional[ThreadPoolExecutor] = None
         self._group_pools: Dict[str, ThreadPoolExecutor] = {}
         self._async_loop = None
+        self._async_sems: Dict[Optional[str], Any] = {}
         self._cancelled: set = set()
+        # lease slots the driver reclaimed (blocked-head revoke): skip
+        # silently when they surface in the queue. _queued_tasks mirrors
+        # the ids sitting in _task_q so a revoke can tell "not started
+        # yet" (fence it) from "already running/finished" (leave it to
+        # the driver's revoked-pair guard) — fencing a started task
+        # would leave a stale entry that silently swallows a future
+        # re-dispatch of the same id to this worker (no task_done ever,
+        # caller hangs)
+        self._revoked: set = set()
+        self._queued_tasks: set = set()
+        # worker->driver control-message batcher: completions, seals
+        # and nested submits coalesce into ("batch", ...) frames
+        batch_on = os.environ.get("RAY_TPU_BATCH", "1") \
+            not in ("0", "false")
+        self._batch = _MsgBatcher(
+            self.conn,
+            max_n=int(os.environ.get("RAY_TPU_BATCH_FLUSH_N", "64")),
+            window=float(os.environ.get("RAY_TPU_BATCH_FLUSH_S",
+                                        "0.001")),
+            enabled=batch_on)
+        self.rt._batch = self._batch
+        # direct-call plane listener (RAY_TPU_DIRECT_CALLS=0 disables)
+        self._direct_server = None
+        if self.rt._direct_enabled:
+            try:
+                self._direct_server = DirectCallServer(self, socket_path)
+            except Exception:
+                self._direct_server = None
         # telemetry plane: metric deltas + execution spans ship to the
         # driver over the existing conn (report channels sys.metrics /
         # sys.spans) after each task and on a periodic heartbeat, so
@@ -310,7 +914,9 @@ class WorkerLoop:
     def run(self) -> None:
         from . import runtime as runtime_mod  # noqa: PLC0415
         runtime_mod.set_runtime(self.rt)
-        self.conn.send(("register", self.worker_id, os.getpid()))
+        self.conn.send(("register", self.worker_id, os.getpid(),
+                        self._direct_server.address
+                        if self._direct_server else None))
         reader = threading.Thread(target=self._read_loop, daemon=True)
         reader.start()
         interval = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S",
@@ -327,6 +933,10 @@ class WorkerLoop:
                 continue
             kind, payload = item
             if kind == "task":
+                # un-queue BEFORE running so a concurrent revoke_tasks
+                # classifies this id as started (program order makes
+                # the discard visible before _run_task's fence check)
+                self._queued_tasks.discard(payload.task_id)
                 self._run_task(payload)
             elif kind == "create_actor":
                 self._create_actor(payload)
@@ -352,7 +962,27 @@ class WorkerLoop:
                     f"undeserializable message:\n{msg[1]}")
                 continue
             if mtype == "exec_task":
+                self._queued_tasks.add(msg[1].task_id)
                 self._task_q.put(("task", msg[1]))
+            elif mtype == "exec_task_many":
+                # a multi-slot lease grant: the specs execute strictly
+                # FIFO off this queue against the lease's resource slot
+                for spec in msg[1]:
+                    self._queued_tasks.add(spec.task_id)
+                    self._task_q.put(("task", spec))
+            elif mtype == "exec_actor_task_many":
+                for spec in msg[1]:
+                    self._task_q.put(("actor_task", spec))
+            elif mtype == "revoke_tasks":
+                # driver reclaimed unstarted lease slots (blocked head):
+                # fence only ids still waiting in the local queue — an
+                # id that already started (watchdog reclaim racing the
+                # head's in-flight completion) must NOT be fenced, or
+                # the stale entry would swallow a later re-dispatch of
+                # the same task; its duplicate result is dropped by the
+                # driver's revoked-pair guard instead
+                self._revoked.update(
+                    tid for tid in msg[1] if tid in self._queued_tasks)
             elif mtype == "create_actor":
                 # (acspec, checkpoint|None) — the checkpoint is the
                 # actor's latest __ray_save__ state around a restart
@@ -493,9 +1123,55 @@ class WorkerLoop:
         device_store.drop(oid)
         self.conn.send(("materialized", oid, loc))
 
+    # sealed payloads past this size flush their completion immediately:
+    # the driver's watermark spiller must learn about big arena writes
+    # NOW, not a batch later — leased tasks produce back-to-back with no
+    # dispatch round-trip pacing them, and a lagging spiller lets the
+    # arena evict unspilled segments under pressure
+    _URGENT_SEAL_BYTES = 1 << 20
+
+    def _task_done(self, task_id: str, sealed, error) -> None:
+        """Completion message via the batcher: flush immediately when
+        the local queue drained (no latency added to the last result of
+        a batch) or the seal is big, else coalesce with the ones right
+        behind."""
+        big = any((getattr(loc, "size", 0) or 0) >= self._URGENT_SEAL_BYTES
+                  for _oid, loc in sealed)
+        self._batch.send(("task_done", task_id, sealed, error),
+                         urgent=big or self._task_q.empty())
+        if big:
+            self._store_backpressure()
+
+    def _store_backpressure(self, max_wait_s: float = 2.0) -> None:
+        """Bounded wait for the driver's watermark spiller after a big
+        seal. Pre-lease, production was paced by the dispatch round
+        trip — the spiller ran between a task's seal and the next
+        dispatch, so the arena never outran it. Leased/pipelined tasks
+        produce back-to-back; without this, a burst of large returns
+        can fill the arena and evict not-yet-spilled segments (data
+        loss turned reconstruction churn). Only engages above the
+        spill watermark, and gives up after max_wait_s so a stuck
+        spiller degrades to the old racy behavior instead of stalling
+        the worker."""
+        cap = getattr(self.store, "capacity", 0) or 0
+        if cap <= 0:
+            return
+        from .spilling import spill_threshold  # noqa: PLC0415
+        limit = cap * spill_threshold()
+        if self.store.used_bytes() <= limit:
+            return
+        deadline = time.monotonic() + max_wait_s
+        while time.monotonic() < deadline \
+                and self.store.used_bytes() > limit:
+            time.sleep(0.005)
+
     def _run_task(self, spec: TaskSpec) -> None:
+        if spec.task_id in self._revoked:
+            # reclaimed lease slot: the driver already re-queued it
+            self._revoked.discard(spec.task_id)
+            return
         if spec.task_id in self._cancelled:
-            self.conn.send(("task_done", spec.task_id, [], "cancelled"))
+            self._task_done(spec.task_id, [], "cancelled")
             return
         self.rt.current_task_id = spec.task_id
         # Dispatcher-assigned chip indices (disjoint across concurrent
@@ -519,15 +1195,15 @@ class WorkerLoop:
                     cancelled = self._stream_items(spec, result)
                     if cancelled:
                         status = "cancelled"
-                    self.conn.send(("task_done", spec.task_id, [],
-                                    "cancelled" if cancelled else None))
+                    self._task_done(spec.task_id, [],
+                                    "cancelled" if cancelled else None)
                     return
             sealed = self._seal_returns(spec, result)
-            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._task_done(spec.task_id, sealed, None)
         except BaseException as e:  # noqa: BLE001
             status = "error"
             err = TaskError(repr(e), traceback.format_exc(), spec.name)
-            self.conn.send(("task_done", spec.task_id, [], err))
+            self._task_done(spec.task_id, [], err)
         finally:
             self.rt.current_task_id = None
             logging_mod.mark_current_task(None)
@@ -609,7 +1285,7 @@ class WorkerLoop:
         from .spilling import put_value_or_spill  # noqa: PLC0415
         oid = new_object_id()
         loc = put_value_or_spill(self.store, oid, item)
-        self.conn.send(("gen_item", spec.task_id, oid, loc))
+        self._batch.send(("gen_item", spec.task_id, oid, loc))
 
     def _stream_items(self, spec: TaskSpec, iterable) -> bool:
         """Put each yielded item and announce it to the driver in order
@@ -659,8 +1335,37 @@ class WorkerLoop:
             # triggered it; the actor just restarts from an older one
             pass
 
+    def _actor_reply(self, spec: TaskSpec, result, error) -> None:
+        """Route one actor-call completion: direct calls reply with the
+        packed VALUE over the caller's channel (no store seal, no driver
+        message); driver-dispatched calls seal returns and batch a
+        task_done like before."""
+        direct = getattr(spec, "_direct_ch", None)
+        if direct is not None:
+            conn, rid = direct
+            try:
+                if error is not None:
+                    conn.send(("dresult", rid, False, error))
+                else:
+                    conn.send(("dresult", rid, True,
+                               serialization.pack(result)))
+            except Exception:  # noqa: BLE001
+                pass   # caller gone: nobody is waiting for this value
+            return
+        if error is not None:
+            self._task_done(spec.task_id, [], error)
+        else:
+            self._task_done(spec.task_id, self._seal_returns(spec, result),
+                            None)
+
     def _run_actor_task(self, spec: TaskSpec) -> None:
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
+        if spec.task_id in self._cancelled:
+            # pipelined dispatch: a cancel can land while the call is
+            # still queued in this process — honor it like _run_task
+            self._cancelled.discard(spec.task_id)
+            self._task_done(spec.task_id, [], "cancelled")
+            return
         t0 = time.time()
         exec_span = tracing.new_span_id()
         status = "ok"
@@ -675,18 +1380,17 @@ class WorkerLoop:
                     cancelled = self._stream_items(spec, result)
                     if cancelled:
                         status = "cancelled"
-                    self.conn.send(("task_done", spec.task_id, [],
-                                    "cancelled" if cancelled else None))
+                    self._task_done(spec.task_id, [],
+                                    "cancelled" if cancelled else None)
                     self._maybe_checkpoint()
                     return
-            sealed = self._seal_returns(spec, result)
-            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._actor_reply(spec, result, None)
             self._maybe_checkpoint()
         except ActorExitRequest:
             # graceful self-exit: this call returns None, then the actor
             # goes down for good (no restart)
-            sealed = self._seal_returns(spec, None)
-            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._actor_reply(spec, None, None)
+            self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)  # works from threadpool threads too
         except BaseException as e:  # noqa: BLE001
@@ -694,10 +1398,28 @@ class WorkerLoop:
             err = TaskError(repr(e), traceback.format_exc(),
                             f"{type(self._actor_instance).__name__}."
                             f"{spec.method_name}")
-            self.conn.send(("task_done", spec.task_id, [], err))
+            self._actor_reply(spec, None, err)
         finally:
             logging_mod.mark_current_task(None)
             self._finish_task_telemetry(spec, exec_span, t0, status)
+
+    def _async_sem(self, group: Optional[str]):
+        """Per-lane asyncio semaphore enforcing max_concurrency /
+        concurrency-group limits IN the worker. With pipelined actor
+        dispatch the driver intentionally sends past the limit (the
+        extra slots just pre-stage specs), so the execution bound for
+        async methods — which all share one event loop — must live
+        here. Loop-thread only."""
+        import asyncio  # noqa: PLC0415
+        groups = getattr(self._actor_spec, "concurrency_groups",
+                         None) or {}
+        key = group if group in groups else None
+        sem = self._async_sems.get(key)
+        if sem is None:
+            limit = groups.get(key) if key else max(
+                1, getattr(self._actor_spec, "max_concurrency", 1))
+            sem = self._async_sems[key] = asyncio.Semaphore(limit or 1)
+        return sem
 
     async def _run_actor_task_asyncgen(self, spec: TaskSpec) -> None:
         """Streaming from an `async def ... yield` actor method. Requires
@@ -708,34 +1430,38 @@ class WorkerLoop:
         exec_span = tracing.new_span_id()
         status = "ok"
         try:
-            method = getattr(self._actor_instance, spec.method_name)
-            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
-            agen = method(*args, **kwargs)
-            if not getattr(spec, "streaming", False):
-                raise TypeError(
-                    f"{spec.method_name} is an async generator; call it "
-                    "with num_returns=\"streaming\"")
-            cancelled = False
-            async for item in agen:
-                if spec.task_id in self._cancelled:
-                    cancelled = True
-                    await agen.aclose()
-                    break
-                self._put_gen_item(spec, item)
-            if cancelled:
-                status = "cancelled"
-            self.conn.send(("task_done", spec.task_id, [],
-                            "cancelled" if cancelled else None))
-            self._maybe_checkpoint()
+            async with self._async_sem(
+                    getattr(spec, "concurrency_group", None)):
+                method = getattr(self._actor_instance, spec.method_name)
+                args, kwargs = _resolve_args(self.rt, spec.args,
+                                             spec.kwargs)
+                agen = method(*args, **kwargs)
+                if not getattr(spec, "streaming", False):
+                    raise TypeError(
+                        f"{spec.method_name} is an async generator; "
+                        "call it with num_returns=\"streaming\"")
+                cancelled = False
+                async for item in agen:
+                    if spec.task_id in self._cancelled:
+                        cancelled = True
+                        await agen.aclose()
+                        break
+                    self._put_gen_item(spec, item)
+                if cancelled:
+                    status = "cancelled"
+                self._task_done(spec.task_id, [],
+                                "cancelled" if cancelled else None)
+                self._maybe_checkpoint()
         except ActorExitRequest:
-            self.conn.send(("task_done", spec.task_id, [], None))
+            self._task_done(spec.task_id, [], None)
+            self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
             status = "error"
             err = TaskError(repr(e), traceback.format_exc(),
                             f"asyncgen.{spec.method_name}")
-            self.conn.send(("task_done", spec.task_id, [], err))
+            self._task_done(spec.task_id, [], err)
         finally:
             # no tracing.active here: interleaved coroutines share the
             # loop thread, so a thread-local context would leak between
@@ -744,26 +1470,32 @@ class WorkerLoop:
 
     async def _run_actor_task_async(self, spec: TaskSpec) -> None:
         from ..exceptions import ActorExitRequest  # noqa: PLC0415
+        if spec.task_id in self._cancelled:
+            self._cancelled.discard(spec.task_id)
+            self._task_done(spec.task_id, [], "cancelled")
+            return
         t0 = time.time()
         exec_span = tracing.new_span_id()
         status = "ok"
         try:
-            method = getattr(self._actor_instance, spec.method_name)
-            args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
-            result = await method(*args, **kwargs)
-            sealed = self._seal_returns(spec, result)
-            self.conn.send(("task_done", spec.task_id, sealed, None))
+            async with self._async_sem(
+                    getattr(spec, "concurrency_group", None)):
+                method = getattr(self._actor_instance, spec.method_name)
+                args, kwargs = _resolve_args(self.rt, spec.args,
+                                             spec.kwargs)
+                result = await method(*args, **kwargs)
+            self._actor_reply(spec, result, None)
             self._maybe_checkpoint()
         except ActorExitRequest:
-            sealed = self._seal_returns(spec, None)
-            self.conn.send(("task_done", spec.task_id, sealed, None))
+            self._actor_reply(spec, None, None)
+            self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
             status = "error"
             err = TaskError(repr(e), traceback.format_exc(),
                             f"async.{spec.method_name}")
-            self.conn.send(("task_done", spec.task_id, [], err))
+            self._actor_reply(spec, None, err)
         finally:
             self._finish_task_telemetry(spec, exec_span, t0, status)
 

@@ -6,6 +6,8 @@ panel the reference renders is available as JSON:
 
   GET /api/cluster     — cluster summary
   GET /api/persistence — control-plane WAL/snapshot health
+  GET /api/dispatch    — batched-dispatch plane counters (submit
+                         batches, worker leases, direct actor calls)
   GET /api/nodes       — node table
   GET /api/actors      — actor table
   GET /api/tasks       — task table
@@ -93,6 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(state_mod.cluster_summary())
             elif route == "/api/persistence":
                 self._json(state_mod.persistence_summary())
+            elif route == "/api/dispatch":
+                self._json(state_mod.dispatch_summary())
             elif route == "/api/nodes":
                 self._json(state_mod.list_nodes(limit=limit))
             elif route == "/api/actors":
@@ -172,6 +176,7 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/actors", "/api/tasks",
                                        "/api/objects", "/api/workers",
                                        "/api/placement_groups",
+                                       "/api/dispatch",
                                        "/api/serve",
                                        "/api/serve/router",
                                        "/api/serve/autoscaler",

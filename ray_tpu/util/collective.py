@@ -14,10 +14,25 @@ allreduce() warns once past _PAYLOAD_WARN_BYTES to catch misuse.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+
+def _driver_path_cm():
+    """Rendezvous verbs must ride the DRIVER dispatch path, not the
+    direct worker->worker channel: the driver lends a worker's CPU
+    while it parks in get() (and reclaims leased slots), which is what
+    lets the remaining ranks of a gang schedule when the cluster is at
+    capacity. A rank polling over fast direct calls never parks past
+    the dwait grace, so its slot would stay held and the gang would
+    deadlock until the round timed out (WorkerRuntime.force_driver_path)."""
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    fn = getattr(rt, "force_driver_path", None)
+    return fn() if fn is not None else contextlib.nullcontext()
 
 _OPS = {
     "sum": lambda xs: np.sum(xs, axis=0),
@@ -118,7 +133,9 @@ class CollectiveGroup:
             # the loser's actor died on the name collision and lookup
             # returns the winner for everyone.
             self.actor = ray_tpu.get_actor(name)
-        self.epoch = ray_tpu.get(self.actor.join.remote(rank, world_size))
+        with _driver_path_cm():
+            self.epoch = ray_tpu.get(
+                self.actor.join.remote(rank, world_size))
 
     def _round(self, kind: str, payload, op: Optional[str],
                timeout: float = 60.0):
@@ -126,20 +143,22 @@ class CollectiveGroup:
         seq = self._seq.get(kind, 0)
         self._seq[kind] = seq + 1
         key = (self.epoch, kind, seq)
-        ray_tpu.get(self.actor.contribute.remote(key, self.rank, payload))
-        deadline = time.monotonic() + timeout
-        delay = 0.001
-        while True:
-            ready, result = ray_tpu.get(
-                self.actor.poll.remote(key, op, self.rank))
-            if ready:
-                return result
-            if time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"collective {kind}#{seq} timed out "
-                    f"({self.world_size} ranks expected)")
-            time.sleep(delay)
-            delay = min(delay * 2, 0.02)
+        with _driver_path_cm():
+            ray_tpu.get(
+                self.actor.contribute.remote(key, self.rank, payload))
+            deadline = time.monotonic() + timeout
+            delay = 0.001
+            while True:
+                ready, result = ray_tpu.get(
+                    self.actor.poll.remote(key, op, self.rank))
+                if ready:
+                    return result
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"collective {kind}#{seq} timed out "
+                        f"({self.world_size} ranks expected)")
+                time.sleep(delay)
+                delay = min(delay * 2, 0.02)
 
     def barrier(self, timeout: float = 60.0) -> None:
         self._round("barrier", None, "barrier", timeout)

@@ -72,16 +72,17 @@ class EventBuffer:
             severity = events_catalog.spec(event_type)[0]
         ev: Dict[str, Any] = {"type": event_type, "ts": time.time(),
                               "severity": severity, "message": message}
-        attrs = {}
-        for k, v in fields.items():
-            if v is None:
-                continue
-            if k in ID_KEYS:
-                ev[k] = v
-            else:
-                attrs[k] = v
-        if attrs:
-            ev["attrs"] = attrs
+        if fields:
+            attrs = None
+            for k, v in fields.items():
+                if v is None:
+                    continue
+                if k in ID_KEYS:
+                    ev[k] = v
+                elif attrs is None:
+                    attrs = ev["attrs"] = {k: v}
+                else:
+                    attrs[k] = v
         with self._lock:
             self._seq += 1
             ev["src_seq"] = self._seq
@@ -179,14 +180,16 @@ class ClusterEventStore:
                batch: Sequence[Dict[str, Any]]) -> None:
         if not batch:
             return
-        src = source_tags or {}
+        src = list((source_tags or {}).items())
         with self._lock:
             for ev in batch:
                 if not isinstance(ev, dict) or "type" not in ev:
                     continue
-                ev = dict(ev)
-                for k, v in src.items():
-                    ev.setdefault(k, v)
+                # ingest OWNS the batch (drain()/decode hand the dicts
+                # over), so tags stamp in place — no per-event copy
+                for k, v in src:
+                    if k not in ev:
+                        ev[k] = v
                 self._seq += 1
                 ev["seq"] = self._seq
                 if len(self._events) >= self.maxlen:

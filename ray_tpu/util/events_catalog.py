@@ -36,6 +36,20 @@ BUILTIN: Dict[str, _SPEC] = {
         "lineage reconstruction (message holds the cause)"),
     "task.finish": (
         "info", "task completed successfully"),
+    "task.lease.grant": (
+        "info", "a worker was granted a multi-slot task lease (one "
+        "dispatch frame carrying several queued tasks; attrs carry the "
+        "slot count)"),
+    "task.lease.revoke": (
+        "warning", "a task lease ended before every slot ran: the "
+        "worker died, or its running head blocked in get() and the "
+        "unstarted slots were reclaimed for other workers (zero lost "
+        "tasks either way — unstarted slots re-queue without burning "
+        "a retry)"),
+    "task.dispatch.local": (
+        "info", "a direct worker->worker call channel was established "
+        "via the sys.actor_addr directory; steady-state calls on it "
+        "bypass the driver entirely"),
     "task.fail": (
         "error", "task reached FAILED (message holds the error)"),
     "task.cancel": (

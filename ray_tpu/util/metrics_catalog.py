@@ -69,6 +69,29 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_gcs_snapshots_total": (
         "counter", "control-plane snapshots written (each rotates the "
         "WAL)", (), "snapshots", None),
+    # ---- batched dispatch plane (docs/SCHEDULING.md) ----
+    "ray_tpu_submit_batch_size": (
+        "histogram", "tasks per flushed api_submit_many batch (the "
+        "size+time flush window coalescing .remote() storms)", (),
+        "tasks", (1, 2, 4, 8, 16, 32, 64, 128, 256)),
+    "ray_tpu_dispatch_batch_size": (
+        "histogram", "tasks per multi-slot dispatch frame (worker "
+        "lease grants and pipelined actor batches)", (), "tasks",
+        (2, 4, 8, 16, 32, 64, 128)),
+    "ray_tpu_lease_grants_total": (
+        "counter", "multi-slot worker task leases granted", (),
+        "leases", None),
+    "ray_tpu_lease_revokes_total": (
+        "counter", "worker task leases revoked before every slot ran "
+        "(worker death, or reclaimed from a blocked worker)",
+        ("reason",), "leases", None),
+    "ray_tpu_direct_actor_calls_total": (
+        "counter", "actor calls dispatched over a direct worker->"
+        "worker channel, bypassing the driver", (), "calls", None),
+    "ray_tpu_direct_call_fallbacks_total": (
+        "counter", "actor calls that fell back to the driver dispatch "
+        "path (no direct address, or the channel died)", ("reason",),
+        "calls", None),
     "ray_tpu_node_memory_pressure": (
         "gauge", "host memory pressure (1 - available/total); the RSS "
         "watchdog kills a worker as it approaches 1.0", (), "ratio",

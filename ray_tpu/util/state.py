@@ -225,6 +225,36 @@ def summarize_objects() -> Dict[str, Any]:
                                             None)}
 
 
+def dispatch_summary() -> Dict[str, Any]:
+    """Batched-dispatch plane health (docs/SCHEDULING.md): submit
+    coalescing, worker-lease lifecycle, direct-call counters, and the
+    control-plane message/frame counts the batching exists to shrink.
+    Also folds in worker-reported direct-call series from the cluster
+    metrics store when present."""
+    rt = get_runtime()
+    out: Dict[str, Any] = {"enabled": True}
+    fn = getattr(rt, "dispatch_stats", None)
+    if callable(fn):
+        out.update(fn())
+    else:   # thin client / worker runtime: no dispatcher-side stats
+        out["enabled"] = False
+    try:
+        from . import metrics as metrics_mod  # noqa: PLC0415
+        expo = metrics_mod.cluster_exposition(rt.cluster_metrics)
+        direct = 0
+        fallbacks = 0
+        for line in expo.splitlines():
+            if line.startswith("ray_tpu_direct_actor_calls_total"):
+                direct += int(float(line.rsplit(" ", 1)[-1]))
+            elif line.startswith("ray_tpu_direct_call_fallbacks_total"):
+                fallbacks += int(float(line.rsplit(" ", 1)[-1]))
+        out["direct_actor_calls"] = direct
+        out["direct_call_fallbacks"] = fallbacks
+    except Exception:
+        pass
+    return out
+
+
 def persistence_summary() -> Dict[str, Any]:
     """Control-plane persistence health (core/persistence.py): driver
     incarnation, WAL length/bytes, last-snapshot age, and — after a

@@ -13,18 +13,19 @@ from __future__ import annotations
 
 import contextlib
 import threading
-import uuid
 from typing import Optional, Tuple
+
+from ..core.ids import rand_hex
 
 _local = threading.local()
 
 
 def new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return rand_hex(16)
 
 
 def new_trace_id() -> str:
-    return uuid.uuid4().hex
+    return rand_hex(32)
 
 
 def current() -> Optional[Tuple[str, str]]:

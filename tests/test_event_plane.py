@@ -94,6 +94,15 @@ def test_catalog_requires_serve_scaleout_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_dispatch_plane_events():
+    """ISSUE 10's lease protocol is forensics-bearing: the chaos tests
+    key on the lease grant/revoke chain and the direct-call plane's
+    channel events — the catalog must keep carrying them."""
+    for required in ("task.lease.grant", "task.lease.revoke",
+                     "task.dispatch.local"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

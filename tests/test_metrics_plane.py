@@ -103,6 +103,21 @@ def test_catalog_requires_driver_persistence_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_dispatch_plane_metrics():
+    """The batched-dispatch plane's telemetry backs the state API's
+    dispatch_summary, the `dispatch` CLI and the core bench's
+    messages-per-task numbers — the catalog must keep carrying it."""
+    for required, kind in (
+            ("ray_tpu_submit_batch_size", "histogram"),
+            ("ray_tpu_dispatch_batch_size", "histogram"),
+            ("ray_tpu_lease_grants_total", "counter"),
+            ("ray_tpu_lease_revokes_total", "counter"),
+            ("ray_tpu_direct_actor_calls_total", "counter"),
+            ("ray_tpu_direct_call_fallbacks_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_no_uncataloged_builtin_metric_literals():
     """Lint: any Counter/Gauge/Histogram constructed with a literal name
     inside the package must use a cataloged ray_tpu_ name (user-facing

@@ -1,0 +1,256 @@
+"""Binary wire codec hardening (ISSUE 10 satellite).
+
+Covers: round-trips of every hot message kind (framework-pure bodies,
+ObjectLocation/TaskSpec/exception extension types, tuple map keys),
+property-style fuzzing of random nested payloads (pure bodies take the
+binary path, impure ones must fall back losslessly to pickle framing),
+torn frames, oversized-frame rejection against MAX_MSG, foreign wire
+versions rejected (not misparsed as pickle), and empty batches.
+"""
+import os
+import random
+import socket
+import string
+import threading
+
+import pytest
+
+from ray_tpu.core import protocol as proto
+from ray_tpu.core.object_store import ObjectLocation
+from ray_tpu.core.task import TaskSpec, make_task_spec
+from ray_tpu.exceptions import TaskError
+
+
+def roundtrip(msg):
+    data = proto.encode_message(msg)
+    assert data is not None, f"expected binary encode for {msg[0]!r}"
+    assert data[0] == 0xB0 | proto.WIRE_VERSION
+    return proto.decode_message(data)
+
+
+# ---------- representative hot-kind round trips ----------
+
+def test_task_done_with_locations_roundtrip():
+    loc = ObjectLocation(kind="shm", size=123, name="seg-1",
+                         node_id="nod-1", seal_seq=7)
+    inline = ObjectLocation(kind="inline", size=4, data=b"\x80\x05ab")
+    out = roundtrip(("task_done", "tsk-1",
+                     [("obj-1", loc), ("obj-2", inline)], None))
+    assert out[0] == "task_done" and out[1] == "tsk-1"
+    (o1, l1), (o2, l2) = out[2]
+    assert (o1, l1.kind, l1.name, l1.seal_seq) == \
+        ("obj-1", "shm", "seg-1", 7)
+    assert (o2, l2.kind, l2.data) == ("obj-2", "inline", b"\x80\x05ab")
+    assert out[3] is None
+
+
+def test_exception_payload_roundtrip():
+    err = TaskError("boom", "tb", "f")
+    out = roundtrip(("task_done", "t", [], err))
+    assert isinstance(out[3], TaskError)
+    assert "boom" in str(out[3])
+
+
+def test_task_spec_envelope_pickles_only_user_payload():
+    def f(x, y=1):
+        return x + y
+
+    spec = make_task_spec(f, ({"k": [1, 2]},), {"y": 5},
+                          resources={"CPU": 1.0}, max_retries=2)
+    out = roundtrip(("exec_task", spec))
+    s2 = out[1]
+    assert isinstance(s2, TaskSpec)
+    assert s2.task_id == spec.task_id and s2.name == spec.name
+    assert s2.args == ({"k": [1, 2]},) and s2.kwargs == {"y": 5}
+    assert s2.resources == {"CPU": 1.0} and s2.max_retries == 2
+    assert s2.func_bytes == spec.func_bytes
+    assert s2.return_ids == spec.return_ids
+
+
+def test_argless_spec_skips_user_blob():
+    def f():
+        return None
+
+    spec = make_task_spec(f, (), {})
+    out = roundtrip(("exec_task_many", [spec, spec]))
+    for s2 in out[1]:
+        assert s2.args == () and s2.kwargs == {}
+        assert s2.scheduling_strategy is None
+        assert s2.runtime_env is None
+
+
+def test_tuple_map_keys_survive():
+    out = roundtrip(("get_reply", "r1", {("a", 1): 2, "k": [3, 4]}))
+    assert out[2] == {("a", 1): 2, "k": [3, 4]}
+
+
+def test_batch_envelope_and_empty_batch():
+    inner = [("heartbeat", 123.5), ("put", "obj-1",
+              ObjectLocation(kind="inline", size=1, data=b"x"))]
+    out = roundtrip(("batch", inner))
+    assert out[0] == "batch" and len(out[1]) == 2
+    assert out[1][0][0] == "heartbeat"
+    # empty batch: legal frame, decodes to an empty list
+    out = roundtrip(("batch", []))
+    assert out[0] == "batch" and list(out[1]) == []
+
+
+def test_non_whitelisted_kind_falls_back():
+    assert proto.encode_message(("register", "w1", 42)) is None
+    assert proto.encode_message("not-a-tuple") is None
+    assert proto.encode_message(()) is None
+
+
+def test_impure_payload_falls_back():
+    class Weird:
+        pass
+
+    assert proto.encode_message(("task_done", "t", [], Weird())) is None
+    # sets are not msgpack-able either
+    assert proto.encode_message(("get_reply", "r", {1, 2})) is None
+
+
+# ---------- fuzz: random nested payloads ----------
+
+def _rand_value(rng, depth=0):
+    kinds = ["int", "float", "str", "bytes", "bool", "none"]
+    if depth < 3:
+        kinds += ["list", "dict", "loc"]
+    k = rng.choice(kinds)
+    if k == "int":
+        return rng.randint(-2**40, 2**40)
+    if k == "float":
+        return rng.random() * 1e6
+    if k == "str":
+        return "".join(rng.choices(string.printable, k=rng.randint(0, 20)))
+    if k == "bytes":
+        return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 16)))
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "none":
+        return None
+    if k == "list":
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randint(0, 4))]
+    if k == "dict":
+        return {f"k{i}": _rand_value(rng, depth + 1)
+                for i in range(rng.randint(0, 4))}
+    return ObjectLocation(kind="shm", size=rng.randint(0, 1 << 30),
+                          name=f"seg-{rng.randint(0, 999)}",
+                          node_id=None if rng.random() < 0.5
+                          else f"nod-{rng.randint(0, 9)}")
+
+
+def _norm(v):
+    """tuples decode as lists; normalize for comparison."""
+    if isinstance(v, (list, tuple)):
+        return [_norm(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in v.items()}
+    if isinstance(v, ObjectLocation):
+        return ("LOC", v.kind, v.size, v.name, v.node_id, v.seal_seq)
+    return v
+
+
+def test_fuzz_pure_payload_roundtrips():
+    rng = random.Random(1234)
+    for _ in range(200):
+        msg = ("get_reply", f"r{rng.randint(0, 99)}", _rand_value(rng))
+        data = proto.encode_message(msg)
+        assert data is not None
+        out = proto.decode_message(data)
+        assert _norm(out[2]) == _norm(msg[2])
+
+
+def test_fuzz_impure_payloads_never_crash_encode():
+    class Opaque:
+        def __init__(self, x):
+            self.x = x
+
+    rng = random.Random(99)
+    for _ in range(50):
+        v = _rand_value(rng)
+        msg = ("get_reply", "r", {"v": v, "bad": Opaque(v)})
+        assert proto.encode_message(msg) is None  # clean fallback
+
+
+# ---------- framing-level hardening over real sockets ----------
+
+def _pair():
+    a, b = socket.socketpair()
+    return proto.Connection(a), proto.Connection(b)
+
+
+def test_connection_roundtrip_binary_and_pickle():
+    c1, c2 = _pair()
+    try:
+        c1.send(("heartbeat", 1.25))                 # binary path
+        assert c2.recv() == ("heartbeat", 1.25)
+        c1.send(("register", "w1", 42))              # pickle path
+        assert c2.recv() == ("register", "w1", 42)
+        # wire kill switch: both framings always decodable
+        proto.set_wire_enabled(False)
+        try:
+            c1.send(("heartbeat", 2.5))
+            assert c2.recv() == ("heartbeat", 2.5)
+        finally:
+            proto.set_wire_enabled(True)
+    finally:
+        c1.close()
+        c2.close()
+
+
+def test_torn_frame_closes_connection():
+    a, b = socket.socketpair()
+    conn = proto.Connection(b)
+    # header promises 100 bytes; send 3 and slam the socket
+    a.sendall(proto._HDR.pack(100) + b"abc")
+    a.close()
+    with pytest.raises(proto.ConnectionClosed):
+        conn.recv()
+    conn.close()
+
+
+def test_oversized_frame_rejected():
+    a, b = socket.socketpair()
+    conn = proto.Connection(b)
+    a.sendall(proto._HDR.pack(proto.MAX_MSG + 1))
+    with pytest.raises(proto.ConnectionClosed):
+        conn.recv()
+    a.close()
+    conn.close()
+
+
+def test_version_mismatch_rejected_not_misparsed():
+    # a frame from a hypothetical wire v2 must surface as a drop, never
+    # decode as pickle garbage
+    data = bytes([0xB2]) + b"\x93\x01\x02\x03"
+    with pytest.raises(proto.WireVersionError):
+        proto.decode_message(data)
+    # over a Connection it surfaces as the RECV_ERROR marker (the
+    # connection survives and later frames still flow)
+    a, b = socket.socketpair()
+    conn = proto.Connection(b)
+    a.sendall(proto._HDR.pack(len(data)) + data)
+    out = conn.recv()
+    assert out[0] == proto.RECV_ERROR
+    t = threading.Thread(target=lambda: proto.Connection(a).send(
+        ("heartbeat", 3.0)))
+    t.start()
+    assert conn.recv() == ("heartbeat", 3.0)
+    t.join()
+    a.close()
+    conn.close()
+
+
+def test_unknown_extension_rejected():
+    import msgpack
+    body = msgpack.packb([msgpack.ExtType(99, b"xx")])
+    with pytest.raises(proto.WireVersionError):
+        proto.decode_message(bytes([0xB0 | proto.WIRE_VERSION]) + body)
+
+
+def test_max_msg_guard_still_applies_to_wire_frames():
+    # the length guard is framing-level, shared by both codecs
+    assert proto.MAX_MSG == 1 << 30
+    assert os.environ.get("RAY_TPU_WIRE", "1") not in ("0",)
