@@ -132,6 +132,12 @@ def _pack_default(obj):
         return msgpack.ExtType(_EXT_LOC, msgpack.packb(
             [getattr(obj, f) for f in _LOC_FIELDS], use_bin_type=True))
     if cls_name == "TaskSpec" and isinstance(obj, _spec_cls()):
+        if getattr(obj, "wire_error", None):
+            # a poisoned spec (payload failed to unpickle on a hop) must
+            # keep its error across re-encodes: the compact envelope
+            # would re-ship empty args and run silently wrong — the
+            # cloudpickle fallback round-trips the attribute instead
+            raise TypeError("spec carries wire_error; not wire-pure")
         pure = [getattr(obj, f) for f in _SPEC_PURE_FIELDS]
         if not obj.args and not obj.kwargs \
                 and obj.scheduling_strategy is None \
@@ -166,9 +172,20 @@ def _ext_hook(code: int, data: bytes):
             ext_hook=_ext_hook, object_pairs_hook=_map_hook)
         spec = _spec_cls()(**dict(zip(_SPEC_PURE_FIELDS, pure)),
                            func_bytes=func_bytes)
-        (spec.args, spec.kwargs, spec.scheduling_strategy,
-         spec.runtime_env) = pickle.loads(blob) if blob else \
-            ((), {}, None, None)
+        spec.args, spec.kwargs = (), {}
+        spec.scheduling_strategy = spec.runtime_env = None
+        if blob:
+            try:
+                (spec.args, spec.kwargs, spec.scheduling_strategy,
+                 spec.runtime_env) = pickle.loads(blob)
+            except BaseException as e:  # noqa: BLE001
+                # The user payload references something only importable
+                # on the submitter (e.g. a driver-only module). Failing
+                # the DECODE would drop the whole frame and park the
+                # caller forever; instead the spec carries the error and
+                # the worker fails the task with it (worker.py
+                # _check_spec_payload).
+                spec.wire_error = f"{type(e).__name__}: {e}"
         return spec
     if code == _EXT_PICKLE:
         return pickle.loads(data)

@@ -756,6 +756,23 @@ class WorkerRuntime:
         return fn
 
 
+def _check_spec_payload(spec) -> None:
+    """Fail fast on a spec whose user payload could not be unpickled on
+    THIS worker (protocol.py stamps `wire_error` instead of dropping
+    the frame). Raising here routes the cause through the normal
+    task-failure reporting — the alternative (a silently dropped exec
+    frame) leaves the task RUNNING forever and its caller parked
+    (observed: a multihost rank payload referencing a module only
+    importable on the driver node)."""
+    we = getattr(spec, "wire_error", None)
+    if we:
+        raise RuntimeError(
+            f"task payload could not be deserialized on this worker: "
+            f"{we} — is every module the payload references importable "
+            "on this node (shared filesystem / PYTHONPATH / runtime_env "
+            "py_modules)?")
+
+
 def _resolve_args(rt: WorkerRuntime, args, kwargs):
     """Fetch top-level ObjectRef args (deps are ready by scheduling time)."""
     if not args and not kwargs:
@@ -1183,6 +1200,7 @@ class WorkerLoop:
         status = "ok"
         try:
             from . import runtime_env as renv_mod  # noqa: PLC0415
+            _check_spec_payload(spec)
             fn = self.rt.load_func(spec)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
             # execution runs under this task's span so nested .remote()
@@ -1215,6 +1233,7 @@ class WorkerLoop:
             from . import runtime_env as renv_mod  # noqa: PLC0415
             # dedicated worker: the actor's runtime_env holds for its life
             renv_mod.apply_permanent(acspec.runtime_env)
+            _check_spec_payload(acspec)
             cls = serialization.loads_call(acspec.class_bytes)
             args, kwargs = _resolve_args(self.rt, acspec.args, acspec.kwargs)
             self._actor_instance = cls(*args, **kwargs)
@@ -1371,6 +1390,7 @@ class WorkerLoop:
         status = "ok"
         logging_mod.mark_current_task(spec.task_id)
         try:
+            _check_spec_payload(spec)
             method = getattr(self._actor_instance, spec.method_name)
             args, kwargs = _resolve_args(self.rt, spec.args, spec.kwargs)
             with tracing.active(getattr(spec, "trace_id", "") or "",
@@ -1430,6 +1450,7 @@ class WorkerLoop:
         exec_span = tracing.new_span_id()
         status = "ok"
         try:
+            _check_spec_payload(spec)
             async with self._async_sem(
                     getattr(spec, "concurrency_group", None)):
                 method = getattr(self._actor_instance, spec.method_name)
@@ -1478,6 +1499,7 @@ class WorkerLoop:
         exec_span = tracing.new_span_id()
         status = "ok"
         try:
+            _check_spec_payload(spec)
             async with self._async_sem(
                     getattr(spec, "concurrency_group", None)):
                 method = getattr(self._actor_instance, spec.method_name)

@@ -142,6 +142,38 @@ def classify_request_failure(exc: BaseException) -> str:
     return "error"
 
 
+# ---- elastic training fault tolerance --------------------------------------
+# Gang-plane failures cross the actor boundary wrapped in TaskError
+# (repr string), so like the serve plane these are matched by class
+# name (error_cause_is) — keep the names stable.
+
+class CollectiveRankDiedError(RayTpuError):
+    """A member rank of a collective gang died mid-round. Surviving
+    ranks parked in `poll` get this immediately (naming the dead rank
+    and the round) instead of spinning out the round timeout, so the
+    elastic layer can tear the gang down and reform within seconds."""
+
+    def __init__(self, message: str, *, rank: int = -1,
+                 round_key=None):
+        self.rank = rank
+        self.round_key = round_key
+        super().__init__(message)
+
+
+class CollectiveStaleGenerationError(RayTpuError):
+    """A contribute/poll arrived stamped with a superseded gang
+    generation: the gang reformed while this rank was parked or
+    stalled, and its world no longer exists. The rank must exit (the
+    elastic layer already replaced it) — mirrors the node-incarnation
+    fencing of PR 4."""
+
+
+class GangReformError(RayTpuError):
+    """The elastic gang could not be reformed: no feasible world (not
+    even a shrunken one) within RAY_TPU_GANG_REFORM_TIMEOUT_S, or the
+    re-gang itself failed."""
+
+
 class StreamInterruptedError(RayTpuError):
     """A streaming response died AFTER yielding its first chunk (replica
     death or wedged engine mid-stream). Transparent resubmission would

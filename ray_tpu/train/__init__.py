@@ -4,6 +4,10 @@ Layer map:
   spmd.py          the jitted SPMD step (replaces DDP/FSDP/NCCL wiring)
   trainer.py       JaxTrainer: actor-per-host function trainer
   spmd_trainer.py  SpmdTrainer: declarative model+mesh trainer
+                   + ElasticSpmdTrainer: gang-supervised fit with
+                   checkpoint-resume into a (possibly resharded) mesh
+  elastic.py       gang supervision, death detection, mesh resharding
+  multihost.py     MultiHostSpmd gang (supervised/elastic mode)
   session.py       report()/get_context() worker session
   checkpoint.py    orbax sharded checkpoints
   config.py        ScalingConfig/RunConfig/FailureConfig/CheckpointConfig
@@ -28,11 +32,14 @@ from .utils import prepare_module, prepare_loader
 from . import adapters  # noqa: F401  (lazy torch/transformers inside)
 
 from .multihost import MultiHostSpmd
+from .elastic import GangSupervisor, RankDeath, reshard_mesh_spec
+from .spmd_trainer import ElasticSpmdTrainer
 from .lora import (LoraConfig, init_lora, merge_lora, lora_param_count,
                    make_lora_train_step)
 
 __all__ = [
-    "MultiHostSpmd",
+    "MultiHostSpmd", "GangSupervisor", "RankDeath", "reshard_mesh_spec",
+    "ElasticSpmdTrainer",
     "JaxBackendConfig", "setup_worker", "form_mesh", "detect_rank",
     "detect_world_size", "prepare_module", "prepare_loader", "adapters",
     "TrainState", "make_train_step", "next_token_loss", "SpmdStep",

@@ -34,7 +34,7 @@ class Checkpoint:
         return dest
 
     def metadata(self) -> Dict[str, Any]:
-        meta = os.path.join(self.path, "ckpt_meta.json")
+        meta = os.path.join(self.path, META_NAME)
         if os.path.exists(meta):
             with open(meta) as f:
                 return json.load(f)
@@ -44,20 +44,125 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+#: committed checkpoints carry this meta sidecar; it is written INSIDE
+#: the tmp- staging dir before the atomic rename, so its presence in a
+#: `checkpoint_*` directory == the save committed. Torn saves leave only
+#: an uncommitted `tmp-*` sibling (or a meta-less dir from pre-atomic
+#: writers) that latest()/_prune() never select.
+META_NAME = "ckpt_meta.json"
+_TMP_PREFIX = "tmp-"
+_OLD_PREFIX = _TMP_PREFIX + "old-"
+
+
+def is_committed(path: str) -> bool:
+    """True when `path` is a fully committed checkpoint directory."""
+    return (os.path.isdir(path)
+            and not os.path.basename(path).startswith(_TMP_PREFIX)
+            and os.path.exists(os.path.join(path, META_NAME)))
+
+
 def save_pytree(state: Any, path: str, *, step: Optional[int] = None,
                 metadata: Optional[Dict[str, Any]] = None) -> Checkpoint:
-    """Save a (possibly sharded) pytree with orbax; blocking."""
-    import orbax.checkpoint as ocp
+    """Save a (possibly sharded) pytree with orbax; blocking.
+
+    Crash-safe commit protocol: the state is written to a `tmp-` sibling
+    in the same directory, the meta sidecar is fsynced, and one atomic
+    rename publishes the checkpoint. A crash at ANY instant leaves either
+    the previous committed checkpoint intact or the new one committed —
+    never a torn directory that latest() would select (the old code
+    rmtree'd the destination first, so a crash mid-save destroyed the
+    checkpoint it was replacing)."""
+    import uuid
+
     path = os.path.abspath(path)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state)
+    parent, base = os.path.split(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f"{_TMP_PREFIX}{base}-{uuid.uuid4().hex[:8]}")
+    ckptr = _checkpointer()
+    ckptr.save(tmp, state)
     meta = dict(metadata or {})
     meta.update({"step": step, "saved_at": time.time()})
-    with open(os.path.join(path, "ckpt_meta.json"), "w") as f:
+    meta_path = os.path.join(tmp, META_NAME)
+    with open(meta_path, "w") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    old = None
+    if os.path.exists(path):
+        # The previous checkpoint at this exact path slides aside first
+        # (rename over a non-empty dir is not atomic); it is reclaimed
+        # only after the new one is committed. A crash BETWEEN the two
+        # renames leaves it under the tmp-old- name with its meta intact
+        # — _recover_slide_aside promotes it back on the next latest()/
+        # prune, so the "committed checkpoint at any instant" invariant
+        # holds across the overwrite window too.
+        old = os.path.join(parent,
+                           f"{_OLD_PREFIX}{base}-{uuid.uuid4().hex[:8]}")
+        os.rename(path, old)
+    os.rename(tmp, path)                       # the commit point
+    _fsync_dir(parent)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
     return Checkpoint(path)
+
+
+def _checkpointer():
+    """A PyTree checkpointer whose barriers never span processes: in a
+    multi-process jax world the default orbax Checkpointer.save runs
+    `sync_global_processes` barriers that expect EVERY process to call
+    save — but the elastic trainer commits from rank 0 only (state is
+    replicated), so a cross-process barrier would deadlock the gang
+    (observed: 30 s gloo rendezvous timeout killing the whole world).
+    Scoping active_processes to the caller keeps the save local."""
+    import orbax.checkpoint as ocp
+    try:
+        import jax
+        if jax.process_count() > 1:
+            me = jax.process_index()
+            return ocp.Checkpointer(
+                ocp.PyTreeCheckpointHandler(),
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    barrier_sync_key_prefix=f"rtpu-p{me}"))
+    except Exception:  # noqa: BLE001 — orbax/jax API drift: default path
+        pass
+    return ocp.PyTreeCheckpointer()
+
+
+def _recover_slide_aside(root: str) -> None:
+    """Undo a crash caught between save_pytree's two overwrite renames:
+    the previously committed checkpoint sits under tmp-old-<base>-<id>
+    (meta intact) with nothing at <base> — promote it back. Only safe
+    to run from the committing process or after the saver is known dead
+    (the elastic trainer's single-writer rank-0 discipline): promoting
+    mid-save would collide with the saver's final rename."""
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return
+    for d in entries:
+        if not d.startswith(_OLD_PREFIX):
+            continue
+        base = d[len(_OLD_PREFIX):].rsplit("-", 1)[0]
+        target = os.path.join(root, base)
+        src = os.path.join(root, d)
+        if not os.path.exists(target) \
+                and os.path.exists(os.path.join(src, META_NAME)):
+            try:
+                os.rename(src, target)
+            except OSError:
+                pass    # a concurrent promote/save won the race
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
 
 
 def restore_pytree(path: str, *, target: Any = None,
@@ -89,17 +194,40 @@ class CheckpointManager:
         self._prune()
         return ckpt
 
+    def _committed(self):
+        return sorted(d for d in os.listdir(self.root)
+                      if d.startswith("checkpoint_")
+                      and is_committed(os.path.join(self.root, d)))
+
     def latest(self) -> Optional[Checkpoint]:
-        entries = sorted(d for d in os.listdir(self.root)
-                         if d.startswith("checkpoint_"))
+        """Newest COMMITTED checkpoint; torn saves (a crash mid-save
+        leaves a tmp- sibling or a meta-less directory) never selected.
+        A checkpoint caught mid-overwrite by a crash is promoted back
+        from its slide-aside name first."""
+        _recover_slide_aside(self.root)
+        entries = self._committed()
         if not entries:
             return None
         return Checkpoint(os.path.join(self.root, entries[-1]))
 
+    # staging dirs older than this are crash leftovers; younger ones may
+    # be a concurrent save still writing, so they are left alone
+    TMP_TTL_S = 3600.0
+
     def _prune(self):
+        _recover_slide_aside(self.root)
+        # abandoned tmp- staging dirs from crashed saves are garbage
+        now = time.time()
+        for d in os.listdir(self.root):
+            p = os.path.join(self.root, d)
+            if d.startswith(_TMP_PREFIX):
+                try:
+                    age = now - os.path.getmtime(p)
+                except OSError:
+                    continue
+                if age > self.TMP_TTL_S:
+                    shutil.rmtree(p, ignore_errors=True)
         if self.num_to_keep is None:
             return
-        entries = sorted(d for d in os.listdir(self.root)
-                         if d.startswith("checkpoint_"))
-        for d in entries[:-self.num_to_keep]:
+        for d in self._committed()[:-self.num_to_keep]:
             shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)

@@ -20,6 +20,10 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..exceptions import (CollectiveRankDiedError,
+                          CollectiveStaleGenerationError, TaskError,
+                          error_cause_is)
+
 
 def _driver_path_cm():
     """Rendezvous verbs must ride the DRIVER dispatch path, not the
@@ -44,7 +48,14 @@ _OPS = {
 
 
 class _CollectiveActor:
-    """Rendezvous state per (group, sequence-number round)."""
+    """Rendezvous state per (group, sequence-number round).
+
+    Gang-aware: the elastic layer (train/elastic.py) reports member
+    deaths via `mark_rank_dead`, which fails every parked poller of an
+    incomplete round with a typed CollectiveRankDiedError instead of
+    letting it spin out the round timeout; gang reforms advance a
+    GENERATION number that fences contributions/polls from ranks of
+    the superseded world (CollectiveStaleGenerationError)."""
 
     def __init__(self, world_size: int):
         self.world = world_size
@@ -52,33 +63,98 @@ class _CollectiveActor:
         self._results: Dict[tuple, Any] = {}
         self._fetched: Dict[tuple, set] = {}
         self._epochs: Dict[int, int] = {}
+        self._dead: Dict[int, str] = {}        # rank -> death cause
+        self._members: Dict[int, str] = {}     # rank -> actor id (info)
+        self._generation = 0
 
-    def join(self, rank: int, world_size: int) -> int:
+    def _sync_generation(self, generation: Optional[int],
+                         world_size: Optional[int] = None) -> None:
+        """Fence OLD generations; ADOPT newer ones. A rank arriving
+        with a newer generation than this actor knows means the gang
+        reformed but the advance never landed here — e.g. the previous
+        rendezvous actor died with the preempted host and this is its
+        fresh (generation-0) replacement. Fencing that rank would lock
+        the NEW world out forever; adopting (and clearing the dead
+        world's rounds) is always safe because a newer generation is
+        authoritative by construction."""
+        if generation is None:
+            return
+        if generation > self._generation:
+            self.advance_generation(generation, world_size)
+        elif generation != self._generation:
+            raise CollectiveStaleGenerationError(
+                f"collective generation {generation} superseded by "
+                f"{self._generation}: the gang reformed; this rank "
+                "belongs to a dead world")
+
+    def join(self, rank: int, world_size: int,
+             actor_id: Optional[str] = None,
+             generation: Optional[int] = None) -> int:
         """Per-rank init counter. Each CollectiveGroup handle gets its own
         epoch, namespacing its round keys so a re-created group for the
         same name never collides with cached results of the previous one.
         Symmetric usage (every rank re-inits together) keeps epochs equal."""
+        self._sync_generation(generation, world_size)
         if world_size != self.world:
             raise ValueError(
                 f"collective group has world_size={self.world}, "
                 f"got {world_size}")
+        if actor_id:
+            self._members[rank] = actor_id
+        self._dead.pop(rank, None)   # a re-joined rank is alive again
         e = self._epochs.get(rank, 0)
         self._epochs[rank] = e + 1
         return e
 
-    def contribute(self, key: tuple, rank: int, payload) -> None:
+    def mark_rank_dead(self, rank: int, cause: str = "") -> None:
+        """Driver-side supervision reports a member death. Every parked
+        poller of a round still missing this rank fails fast on its next
+        poll (sub-poll-interval, not the 60 s round timeout)."""
+        self._dead[rank] = cause or "rank actor died"
+
+    def advance_generation(self, generation: int,
+                           world_size: Optional[int] = None) -> None:
+        """The gang reformed (possibly resharded to a smaller world):
+        fence the old world's rounds and accept the new membership."""
+        if generation <= self._generation:
+            return
+        self._generation = generation
+        if world_size is not None:
+            self.world = world_size
+        self._rounds.clear()
+        self._results.clear()
+        self._fetched.clear()
+        self._dead.clear()
+        self._members.clear()
+
+    def members(self) -> Dict[str, Any]:
+        return {"world": self.world, "generation": self._generation,
+                "members": dict(self._members), "dead": dict(self._dead)}
+
+    def contribute(self, key: tuple, rank: int, payload,
+                   generation: Optional[int] = None) -> None:
+        self._sync_generation(generation)
         self._rounds.setdefault(key, {})[rank] = payload
 
-    def poll(self, key: tuple, op: Optional[str], rank: int = -1):
+    def poll(self, key: tuple, op: Optional[str], rank: int = -1,
+             generation: Optional[int] = None):
         """Returns (ready, result). Result computed once per round, then
         retained until every rank has fetched it (a result evicted before
         a slow rank polls would strand that rank in a timeout spin)."""
+        self._sync_generation(generation)
         if key in self._results:
             result = self._results[key]
             self._mark_fetched(key, rank)
             return True, result
         room = self._rounds.get(key, {})
         if len(room) < self.world:
+            missing_dead = [r for r in range(self.world)
+                            if r not in room and r in self._dead]
+            if missing_dead:
+                r = missing_dead[0]
+                raise CollectiveRankDiedError(
+                    f"rank {r} died during collective round {key}: "
+                    f"{self._dead[r]}", rank=r, round_key=key)
             return False, None
         ordered = [room[r] for r in sorted(room)]
         if op is None:                     # allgather
@@ -113,14 +189,39 @@ class _CollectiveActor:
             self._fetched.pop(oldest, None)
 
 
-class CollectiveGroup:
-    """One rank's handle; ranks coordinate via the shared named actor."""
+def _raise_typed(exc: BaseException):
+    """Re-raise actor-boundary-wrapped gang failures as their typed
+    forms (TaskError carries only the repr; error_cause_is matches by
+    class name like the serve plane does)."""
+    if isinstance(exc, (CollectiveRankDiedError,
+                        CollectiveStaleGenerationError)):
+        raise exc
+    if error_cause_is(exc, "CollectiveRankDiedError"):
+        raise CollectiveRankDiedError(
+            getattr(exc, "cause_repr", "") or str(exc)) from exc
+    if error_cause_is(exc, "CollectiveStaleGenerationError"):
+        raise CollectiveStaleGenerationError(
+            getattr(exc, "cause_repr", "") or str(exc)) from exc
+    raise exc
 
-    def __init__(self, group_name: str, world_size: int, rank: int):
+
+class CollectiveGroup:
+    """One rank's handle; ranks coordinate via the shared named actor.
+
+    `generation` (optional) stamps every verb with the elastic gang
+    generation: after a reform, verbs from ranks of the old world fail
+    with CollectiveStaleGenerationError instead of corrupting the new
+    world's rounds. A rank whose gang-mate dies mid-round gets
+    CollectiveRankDiedError from the parked verb within the poll
+    interval (the elastic supervisor calls mark_rank_dead)."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 *, generation: Optional[int] = None):
         import ray_tpu
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
+        self.generation = generation
         self._seq: Dict[str, int] = {}
         name = f"rtpu_collective:{group_name}"
         try:
@@ -133,9 +234,17 @@ class CollectiveGroup:
             # the loser's actor died on the name collision and lookup
             # returns the winner for everyone.
             self.actor = ray_tpu.get_actor(name)
+        try:
+            actor_id = ray_tpu.get_runtime_context().get_actor_id()
+        except Exception:  # noqa: BLE001 — plain-task ranks have none
+            actor_id = None
         with _driver_path_cm():
-            self.epoch = ray_tpu.get(
-                self.actor.join.remote(rank, world_size))
+            try:
+                self.epoch = ray_tpu.get(
+                    self.actor.join.remote(rank, world_size, actor_id,
+                                           generation))
+            except TaskError as e:
+                _raise_typed(e)
 
     def _round(self, kind: str, payload, op: Optional[str],
                timeout: float = 60.0):
@@ -144,21 +253,26 @@ class CollectiveGroup:
         self._seq[kind] = seq + 1
         key = (self.epoch, kind, seq)
         with _driver_path_cm():
-            ray_tpu.get(
-                self.actor.contribute.remote(key, self.rank, payload))
-            deadline = time.monotonic() + timeout
-            delay = 0.001
-            while True:
-                ready, result = ray_tpu.get(
-                    self.actor.poll.remote(key, op, self.rank))
-                if ready:
-                    return result
-                if time.monotonic() >= deadline:
-                    raise TimeoutError(
-                        f"collective {kind}#{seq} timed out "
-                        f"({self.world_size} ranks expected)")
-                time.sleep(delay)
-                delay = min(delay * 2, 0.02)
+            try:
+                ray_tpu.get(
+                    self.actor.contribute.remote(key, self.rank, payload,
+                                                 self.generation))
+                deadline = time.monotonic() + timeout
+                delay = 0.001
+                while True:
+                    ready, result = ray_tpu.get(
+                        self.actor.poll.remote(key, op, self.rank,
+                                               self.generation))
+                    if ready:
+                        return result
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"collective {kind}#{seq} timed out "
+                            f"({self.world_size} ranks expected)")
+                    time.sleep(delay)
+                    delay = min(delay * 2, 0.02)
+            except TaskError as e:
+                _raise_typed(e)
 
     def barrier(self, timeout: float = 60.0) -> None:
         self._round("barrier", None, "barrier", timeout)
@@ -193,6 +307,43 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> CollectiveGroup:
     """Reference: ray.util.collective.init_collective_group."""
     return CollectiveGroup(group_name, world_size, rank)
+
+
+def notify_rank_death(group_name: str, rank: int, cause: str = "",
+                      timeout: float = 10.0) -> bool:
+    """Tell a group's rendezvous actor that a member rank died, failing
+    its parked pollers fast with CollectiveRankDiedError. Called by the
+    gang supervisor (train/elastic.py) from its death watch; best-effort
+    (False when the group doesn't exist or the actor itself is gone —
+    e.g. it lived on the dead node, in which case pollers already get
+    ActorDiedError)."""
+    import ray_tpu
+    try:
+        actor = ray_tpu.get_actor(f"rtpu_collective:{group_name}",
+                                  timeout=0.0)
+        ray_tpu.get(actor.mark_rank_dead.remote(rank, cause),
+                    timeout=timeout)
+        return True
+    except Exception:  # noqa: BLE001 — supervision must not fail on this
+        return False
+
+
+def advance_group_generation(group_name: str, generation: int,
+                             world_size: Optional[int] = None,
+                             timeout: float = 10.0) -> bool:
+    """Fence a group's previous world after a gang reform: rounds from
+    generations < `generation` fail with CollectiveStaleGenerationError
+    and the (possibly resharded) world size takes effect. Best-effort,
+    same contract as notify_rank_death."""
+    import ray_tpu
+    try:
+        actor = ray_tpu.get_actor(f"rtpu_collective:{group_name}",
+                                  timeout=0.0)
+        ray_tpu.get(actor.advance_generation.remote(generation, world_size),
+                    timeout=timeout)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

@@ -180,6 +180,25 @@ BUILTIN: Dict[str, _SPEC] = {
         "info", "the serve autoscaler lowered a deployment's replica "
         "target; the controller gracefully drains the least-busy "
         "replicas first"),
+    # ---- elastic training fault tolerance ----
+    "train.gang.rank_death": (
+        "error", "a rank actor of a supervised SPMD gang died "
+        "(preempted host, killed worker); the supervisor fails parked "
+        "collective rounds fast (CollectiveRankDiedError) and arms a "
+        "gang reform"),
+    "train.gang.reform": (
+        "warning", "the gang tore down its doomed jax.distributed "
+        "world and re-ganged under a bumped generation (attrs: "
+        "old_world -> world, seconds; kind replaced|resharded)"),
+    "train.gang.reshard": (
+        "warning", "no replacement capacity for the requested gang "
+        "size: the gang reformed RESHARDED onto the surviving world "
+        "(dp axis shrunk; mesh layout is a function of the surviving "
+        "world, not fixed job state)"),
+    "train.restore": (
+        "info", "a (re)formed gang restored the last committed "
+        "checkpoint onto its mesh and resumed from state.step (attrs: "
+        "step, world, generation, seconds)"),
     # ---- event plane itself ----
     "events.dropped": (
         "warning", "a process's local event buffer overflowed between "

@@ -196,6 +196,15 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_train_mfu": (
         "gauge", "model FLOPs utilization (mirrors the reported mfu "
         "metric)", (), "ratio", None),
+    # ---- elastic training fault tolerance ----
+    "ray_tpu_train_gang_reforms_total": (
+        "counter", "supervised SPMD gang reforms after a rank death "
+        "(kind: replaced = full size on fresh capacity, resharded = "
+        "shrunk onto the surviving world)", ("kind",), "reforms", None),
+    "ray_tpu_train_restore_seconds": (
+        "histogram", "committed-checkpoint restore time onto the "
+        "(re)formed gang's mesh (the dominant share of training MTTR "
+        "after a preemption)", (), "seconds", None),
 }
 
 _create_lock = threading.Lock()

@@ -103,6 +103,15 @@ def test_catalog_requires_dispatch_plane_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_train_fault_tolerance_events():
+    """ISSUE 11's elastic-training chain (rank death -> gang reform /
+    reshard -> checkpoint restore) is what tests/test_train_ft.py and
+    the train_ft bench key on — the catalog must keep carrying it."""
+    for required in ("train.gang.rank_death", "train.gang.reform",
+                     "train.gang.reshard", "train.restore"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

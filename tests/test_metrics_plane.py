@@ -103,6 +103,17 @@ def test_catalog_requires_driver_persistence_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_train_fault_tolerance_metrics():
+    """The elastic-training FT plane's reform counter and restore-time
+    histogram back the train_ft bench's MTTR accounting — the catalog
+    must keep carrying them."""
+    for required, kind in (
+            ("ray_tpu_train_gang_reforms_total", "counter"),
+            ("ray_tpu_train_restore_seconds", "histogram")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_catalog_requires_dispatch_plane_metrics():
     """The batched-dispatch plane's telemetry backs the state API's
     dispatch_summary, the `dispatch` CLI and the core bench's

@@ -19,14 +19,17 @@ def _psum_fn(rank, world):
     import jax
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:                     # jax < 0.5 keeps it in
+        from jax.experimental.shard_map import shard_map  # noqa: PLC0415
     mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
     n = jax.device_count()
     x = jax.make_array_from_callback(
         (n,), NamedSharding(mesh, P("dp")),
         lambda idx: np.ones((1,)) * (rank + 1))   # one element per device
-    out = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "dp"),
-                                mesh=mesh, in_specs=P("dp"),
-                                out_specs=P("dp")))(x)
+    out = jax.jit(shard_map(lambda v: jax.lax.psum(v, "dp"),
+                            mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp")))(x)
     return float(np.asarray(out.addressable_shards[0].data)[0])
 
 

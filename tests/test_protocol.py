@@ -79,6 +79,51 @@ def test_argless_spec_skips_user_blob():
         assert s2.runtime_env is None
 
 
+def test_undeserializable_payload_poisons_spec_not_frame():
+    """A spec whose user-arg blob references a module only importable
+    on the SENDER must still decode — carrying `wire_error` — so the
+    receiving worker can FAIL the task with the cause. Dropping the
+    whole frame leaves the task RUNNING forever and its caller parked
+    (ISSUE 11: a multihost rank payload referencing a driver-only
+    module hung the gang)."""
+    import sys
+    import tempfile
+    import textwrap
+
+    with tempfile.TemporaryDirectory() as d:
+        mod = os.path.join(d, "rtpu_ghost_mod.py")
+        with open(mod, "w") as f:
+            f.write(textwrap.dedent("""
+                def payload_fn():
+                    return 42
+            """))
+        sys.path.insert(0, d)
+        try:
+            import rtpu_ghost_mod
+            spec = make_task_spec(lambda p: p(), (rtpu_ghost_mod.payload_fn,),
+                                  {})
+            data = proto.encode_message(("exec_task", spec))
+            assert data is not None
+        finally:
+            sys.path.remove(d)
+            sys.modules.pop("rtpu_ghost_mod", None)
+    # the module is gone: decode on the "other side" must not raise —
+    # the spec lands poisoned and names the import failure
+    out = proto.decode_message(data)
+    s2 = out[1]
+    assert isinstance(s2, TaskSpec)
+    assert s2.task_id == spec.task_id
+    err = getattr(s2, "wire_error", None)
+    assert err and "rtpu_ghost_mod" in err
+    assert s2.args == () and s2.kwargs == {}
+    # a re-encode must NOT silently ship the emptied args: the poisoned
+    # spec falls back to the pickle path, which keeps wire_error
+    assert proto.encode_message(("exec_task", s2)) is None
+    import cloudpickle
+    s3 = __import__("pickle").loads(cloudpickle.dumps(s2))
+    assert getattr(s3, "wire_error", None) == err
+
+
 def test_tuple_map_keys_survive():
     out = roundtrip(("get_reply", "r1", {("a", 1): 2, "k": [3, 4]}))
     assert out[2] == {("a", 1): 2, "k": [3, 4]}
