@@ -21,6 +21,7 @@ import threading
 from typing import Any
 
 from .build import build_library
+from ..util import knobs
 from ..core import serialization
 from ..core.object_store import INLINE_MAX, ObjectLocation
 from ..exceptions import ObjectLostError, ObjectStoreFullError
@@ -121,7 +122,7 @@ class NativeStore:
             name = f"/rtpu_arena_{os.getpid()}_{os.urandom(4).hex()}"
             os.environ[_ENV_NAME] = name
         else:
-            name = os.environ.get(_ENV_NAME, "")
+            name = knobs.get_str(_ENV_NAME, default="")
             if not name:
                 raise RuntimeError(
                     "no arena to attach: RAY_TPU_ARENA_NAME unset "

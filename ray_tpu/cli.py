@@ -124,6 +124,33 @@ def cmd_dispatch(args):
               + ", ".join(f"{k}={v}" for k, v in top))
 
 
+def cmd_lint(args):
+    """Run the raylint static-analysis gate (tools/raylint): the
+    concurrency/invariant checks RT001-RT005 over the package, exiting
+    non-zero on any unsuppressed finding (docs/STATIC_ANALYSIS.md).
+    Runs locally against source — no driver needed."""
+    try:
+        from tools.raylint.__main__ import main as raylint_main
+    except ImportError:
+        # installed-package invocation: tools/ lives next to the repo's
+        # ray_tpu/, so try the checkout root before giving up
+        import ray_tpu
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        sys.path.insert(0, repo)
+        try:
+            from tools.raylint.__main__ import main as raylint_main
+        except ImportError:
+            sys.stderr.write(
+                "error: raylint needs the repo checkout (tools/raylint "
+                "is not shipped in the installed package)\n")
+            sys.exit(2)
+    argv = list(args.raylint_args or [])
+    if argv and argv[0] == "--":   # `ray_tpu lint -- -o json`
+        argv = argv[1:]
+    sys.exit(raylint_main(argv))
+
+
 def cmd_list(args):
     route = {"actors": "/api/actors", "tasks": "/api/tasks",
              "objects": "/api/objects", "nodes": "/api/nodes",
@@ -500,6 +527,15 @@ def main(argv=None):
              "leases, direct actor calls, control-message counts)")
     dpp.add_argument("--json", action="store_true")
     dpp.set_defaults(fn=cmd_dispatch)
+
+    ltp = sub.add_parser(
+        "lint",
+        help="raylint static-analysis gate (RT001-RT005 over ray_tpu/; "
+             "docs/STATIC_ANALYSIS.md); extra args pass through, e.g. "
+             "`ray_tpu lint -- -o json`")
+    ltp.add_argument("raylint_args", nargs=argparse.REMAINDER,
+                     help="arguments forwarded to python -m tools.raylint")
+    ltp.set_defaults(fn=cmd_lint)
 
     lp = sub.add_parser("list", help="list cluster entities")
     lp.add_argument("kind", choices=["actors", "tasks", "objects", "nodes",

@@ -33,10 +33,11 @@ source of truth and HBM is reclaimed.
 """
 from __future__ import annotations
 
-import os
 import sys
 import threading
 from typing import Any, Dict, Optional
+
+from ..util import knobs
 
 # kept-resident returns / local-table dep reads / D2H serializations
 COUNTERS = {"kept_device": 0, "device_hits": 0, "materialized": 0}
@@ -48,11 +49,11 @@ _LOCK = threading.Lock()
 # until consumed/freed/materialized). A full table does NOT evict —
 # new values simply refuse residency and serialize through the normal
 # shm path until frees/materializations make room.
-MAX_ENTRIES = int(os.environ.get("RAY_TPU_DEVICE_OBJECTS_MAX", "256"))
+MAX_ENTRIES = knobs.get_int("RAY_TPU_DEVICE_OBJECTS_MAX")
 
 
 def enabled() -> bool:
-    return os.environ.get("RAY_TPU_DEVICE_OBJECTS", "1") != "0"
+    return knobs.get_bool("RAY_TPU_DEVICE_OBJECTS")
 
 
 def should_keep(value: Any) -> bool:

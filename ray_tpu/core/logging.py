@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..util import knobs
+
 # Marker line format: TASK_MARKER<task_id or "-">TASK_MARKER_END + "\n".
 # Chosen to never collide with ordinary output and to survive
 # line-splitting readers (always written as one whole line).
@@ -100,8 +102,7 @@ def attribute_lines(text: str, current: Optional[str] = None
 # this many trailing bytes per file. A task whose attribution marker
 # fell before the window loses its oldest lines (best effort, same as
 # any tail).
-TAIL_READ_BYTES = int(os.environ.get("RAY_TPU_LOG_TAIL_BYTES",
-                                     str(4 << 20)))
+TAIL_READ_BYTES = knobs.get_int("RAY_TPU_LOG_TAIL_BYTES")
 
 
 def read_log_tail(path: str,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import select
 import subprocess
 import sys
 import tempfile
@@ -32,10 +33,11 @@ from . import resources as res_mod
 from .ids import new_node_id
 from .object_store import make_store
 from .protocol import ConnectionClosed, connect_address
+from ..util import knobs
 
 # Cross-node payloads stream in frames well under protocol.MAX_MSG so one
 # huge object can never poison the connection with an oversized frame.
-FETCH_CHUNK = int(os.environ.get("RAY_TPU_FETCH_CHUNK", str(64 << 20)))
+FETCH_CHUNK = knobs.get_int("RAY_TPU_FETCH_CHUNK")
 
 
 class NodeAgent:
@@ -51,8 +53,8 @@ class NodeAgent:
         # every ObjectLocation written here names this node.
         os.environ.pop("RAY_TPU_ARENA_NAME", None)
         os.environ["RAY_TPU_NODE_ID"] = self.node_id
-        cap = store_bytes or int(
-            os.environ.get("RAY_TPU_STORE_BYTES", str(2 << 30)))
+        cap = store_bytes \
+            or knobs.get_int("RAY_TPU_STORE_BYTES", default=2 << 30)
         self.store = make_store(capacity_bytes=cap, is_owner=True)
 
         node_res = res_mod.detect_node_resources(num_cpus, num_tpus)
@@ -61,8 +63,9 @@ class NodeAgent:
         self.resources = node_res
         self.labels = res_mod.detect_tpu_topology(
             int(node_res.get("TPU", 0)))
-        if os.environ.get("RAY_TPU_NODE_TYPE"):
-            self.labels["node-type"] = os.environ["RAY_TPU_NODE_TYPE"]
+        node_type = knobs.get_raw("RAY_TPU_NODE_TYPE")
+        if node_type:
+            self.labels["node-type"] = node_type
 
         self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_node_")
         self.log_dir = os.path.join(self._tmpdir, "logs")
@@ -70,8 +73,8 @@ class NodeAgent:
         # This node's workers spill put-overflow here (core/spilling.py;
         # the driver-side watermark spiller only covers the driver node).
         # Overrides any env inherited from a same-host driver in tests.
-        os.environ["RAY_TPU_SPILL_DIR"] = os.path.join(self._tmpdir,
-                                                       "spill")
+        spill_dir = os.path.join(self._tmpdir, "spill")
+        os.environ["RAY_TPU_SPILL_DIR"] = spill_dir
         self.workers: Dict[str, subprocess.Popen] = {}
         self.job_id = "job-default"
         # Fetches run on threads (a multi-GB read must not head-of-line
@@ -89,7 +92,7 @@ class NodeAgent:
         from .object_transfer import (PullManager,  # noqa: PLC0415
                                       TransferServer)
         self.transfer_server = TransferServer(
-            self.store, spill_dirs=[os.environ["RAY_TPU_SPILL_DIR"]])
+            self.store, spill_dirs=[spill_dir])
         self.pull_manager = PullManager(
             self.store, node_id=self.node_id, locate=self._locate,
             span_sink=self._span_sink)
@@ -112,19 +115,38 @@ class NodeAgent:
         # Metrics plane: this agent's registry (node-local store stats,
         # any user metrics recorded here) ships delta snapshots on the
         # node connection; the driver merges them tagged with node_id.
-        self._metrics_interval = float(os.environ.get(
-            "RAY_TPU_METRICS_INTERVAL_S", "1.0"))
+        self._metrics_interval = knobs.get_float(
+            "RAY_TPU_METRICS_INTERVAL_S")
         if self._metrics_interval > 0:
             threading.Thread(target=self._metrics_loop, daemon=True,
                              name="node-metrics").start()
         # Liveness pings for the driver's event plane: a stalled (not
         # just disconnected) agent surfaces as node.heartbeat_miss
         # before the socket-level death determination.
-        self._heartbeat_interval = float(os.environ.get(
-            "RAY_TPU_NODE_HEARTBEAT_S", "2.0"))
+        self._heartbeat_interval = knobs.get_float(
+            "RAY_TPU_NODE_HEARTBEAT_S")
         if self._heartbeat_interval > 0:
             threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="node-heartbeat").start()
+        # Agent-side mirror of the driver's heartbeat-declared death:
+        # the driver acks every heartbeat, so a healthy connection is
+        # never silent longer than the heartbeat interval. Total
+        # silence past RAY_TPU_DRIVER_SILENCE_S means the driver HOST
+        # is gone without a FIN/RST (preemption, partition) — recv()
+        # would park until the ~15min TCP retransmit timeout and this
+        # host's capacity would stay lost long after the driver
+        # restarts. run() treats that as a lost connection and rejoins.
+        self._silence_timeout = knobs.get_float("RAY_TPU_DRIVER_SILENCE_S")
+        self._last_driver_traffic = time.monotonic()
+        # True while run() is parked inside conn.recv(): with the
+        # select() gate that only happens when at least a frame HEADER
+        # arrived, so a long park here means the driver died mid-frame
+        # — the heartbeat loop then closes the conn to unblock the
+        # read (the same cross-thread unblock idiom the driver's death
+        # determination uses). A socket-level settimeout would be
+        # simpler but caps every sendall on this SHARED conn too, and
+        # the fetch path streams 64MB frames over it.
+        self._in_recv = False
 
     def _register_info(self) -> dict:
         return {
@@ -149,6 +171,15 @@ class NodeAgent:
                 continue
             except Exception:
                 pass
+            # mid-frame silence watchdog: run()'s select() gate cannot
+            # fire while recv() is parked on a partial frame
+            if (self._silence_timeout > 0 and self._in_recv
+                    and time.monotonic() - self._last_driver_traffic
+                    > self._silence_timeout):
+                try:
+                    self.conn.close()   # recv raises; run() rejoins
+                except Exception:
+                    pass
 
     def _metrics_loop(self) -> None:
         from ..util.metrics import DeltaExporter  # noqa: PLC0415
@@ -245,11 +276,50 @@ class NodeAgent:
                     pass
 
     # ---- command loop -----------------------------------------------------
+    def _await_driver_traffic(self) -> bool:
+        """Bounded wait for inbound driver frames. True when the
+        connection is readable (or the watchdog is disabled); False
+        when total driver silence exceeded RAY_TPU_DRIVER_SILENCE_S —
+        the half-open-peer case a blocking recv() can never notice."""
+        if self._silence_timeout <= 0 or self._heartbeat_interval <= 0:
+            return True   # no acks flowing -> silence proves nothing
+        while True:
+            try:
+                readable, _, _ = select.select(
+                    [self.conn.sock], [], [], 1.0)
+            except (OSError, ValueError):
+                return True   # socket dying: let recv() raise the cause
+            if readable:
+                return True
+            silent = time.monotonic() - self._last_driver_traffic
+            if silent > self._silence_timeout:
+                return False
+
     def run(self) -> None:
         try:
             while True:
                 try:
-                    m = self.conn.recv()
+                    if not self._await_driver_traffic():
+                        print(f"ray_tpu node {self.node_id}: driver "
+                              f"silent > {self._silence_timeout:.0f}s "
+                              "(no frames or heartbeat acks); treating "
+                              "the connection as dead", flush=True)
+                        try:
+                            self.conn.close()
+                        except Exception:
+                            pass
+                        raise ConnectionClosed("driver silence timeout")
+                    self._in_recv = True
+                    try:
+                        # raylint: disable=RT003 bounded two ways: recv
+                        # only runs after _await_driver_traffic saw
+                        # readability, and a mid-frame park is closed
+                        # out by the heartbeat loop's
+                        # RAY_TPU_DRIVER_SILENCE_S watchdog (_in_recv)
+                        m = self.conn.recv()
+                    finally:
+                        self._in_recv = False
+                    self._last_driver_traffic = time.monotonic()
                     self._handle(m)
                 except ConnectionClosed:
                     # Driver connection lost — noticed at recv OR at a
@@ -273,7 +343,7 @@ class NodeAgent:
         terminated first: the driver marked them dead at our death
         determination, and a zombie from the fenced incarnation must
         not double-execute anything."""
-        window = float(os.environ.get("RAY_TPU_NODE_REJOIN_S", "30"))
+        window = knobs.get_float("RAY_TPU_NODE_REJOIN_S")
         if window <= 0:
             return False
         for proc in self.workers.values():
@@ -295,6 +365,7 @@ class NodeAgent:
                 delay = min(delay * 2, 2.0)
                 continue
             self.conn = conn
+            self._last_driver_traffic = time.monotonic()
             print(f"ray_tpu node {self.node_id} rejoined "
                   f"{self.driver_address} as incarnation "
                   f"{self.incarnation}", flush=True)
@@ -313,6 +384,8 @@ class NodeAgent:
                 print(f"ray_tpu node {self.node_id} reattached to "
                       f"driver incarnation {inc}", flush=True)
             self.driver_incarnation = inc
+        elif mtype == "heartbeat_ack":
+            pass  # run() already stamped _last_driver_traffic
         elif mtype == "pull_object":
             _, rid, oid, candidates = m
             threading.Thread(target=self._serve_pull,

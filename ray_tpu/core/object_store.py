@@ -26,6 +26,7 @@ from multiprocessing import shared_memory, resource_tracker
 
 from . import serialization
 from ..exceptions import ObjectStoreFullError, ObjectLostError
+from ..util import knobs
 
 INLINE_MAX = 64 * 1024
 
@@ -73,7 +74,7 @@ class ObjectLocation:
 def current_node_id() -> Optional[str]:
     """The node this process's store writes into (env-inherited from the
     driver or node agent that spawned it)."""
-    return os.environ.get("RAY_TPU_NODE_ID") or None
+    return knobs.get_raw("RAY_TPU_NODE_ID")
 
 
 def _read_spill_loc(loc: "ObjectLocation") -> bytes:

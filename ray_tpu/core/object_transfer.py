@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..util import knobs
 from .protocol import (ConnectionClosed, read_exact, read_frame, read_obj,
                        tcp_listener, write_frame, write_obj)
 
@@ -49,26 +50,26 @@ ACK = b"\x01"
 
 
 def chunk_size_default() -> int:
-    return int(os.environ.get("RAY_TPU_TRANSFER_CHUNK", str(4 << 20)))
+    return knobs.get_int("RAY_TPU_TRANSFER_CHUNK")
 
 
 def _retries() -> int:
-    return int(os.environ.get("RAY_TPU_TRANSFER_RETRIES", "3"))
+    return knobs.get_int("RAY_TPU_TRANSFER_RETRIES")
 
 
 def _timeout_s() -> float:
-    return float(os.environ.get("RAY_TPU_TRANSFER_TIMEOUT_S", "20"))
+    return knobs.get_float("RAY_TPU_TRANSFER_TIMEOUT_S")
 
 
 def _backoff_s() -> float:
-    return float(os.environ.get("RAY_TPU_TRANSFER_BACKOFF_S", "0.05"))
+    return knobs.get_float("RAY_TPU_TRANSFER_BACKOFF_S")
 
 
 def _deadline_s() -> float:
     """Total wall-clock cap across ALL pull retry rounds: a dead holder
     must not stall a reader for the full retry budget before lineage
     reconstruction can kick in (0 disables the cap)."""
-    return float(os.environ.get("RAY_TPU_PULL_DEADLINE_S", "30"))
+    return knobs.get_float("RAY_TPU_PULL_DEADLINE_S")
 
 
 def _mcat():
@@ -120,7 +121,7 @@ class TransferServer:
         # the requester's loc comes off the wire, and an unvalidated
         # spill_path would be an arbitrary-file-read primitive
         dirs = spill_dirs if spill_dirs is not None else \
-            [d for d in (os.environ.get("RAY_TPU_SPILL_DIR"),) if d]
+            [d for d in (knobs.get_raw("RAY_TPU_SPILL_DIR"),) if d]
         self._spill_dirs = [os.path.realpath(d) for d in dirs]
         self._listener = tcp_listener(host, port)
         lh, lp = self._listener.getsockname()[:2]

@@ -50,6 +50,8 @@ import pickle
 
 import cloudpickle
 
+from ..util import knobs
+
 
 # Record kinds that can carry USER objects (actor constructor args in
 # the create spec, by-value task args in lineage specs): these must use
@@ -95,7 +97,7 @@ def _max_generation(state_dir: str) -> int:
 
 
 def default_state_dir() -> Optional[str]:
-    return os.environ.get("RAY_TPU_STATE_DIR") or None
+    return knobs.get_raw("RAY_TPU_STATE_DIR")
 
 
 @dataclasses.dataclass
@@ -296,12 +298,10 @@ class GCSPersistence:
         self.node_id = node_id
         self.listen = listen
         self._lock = threading.Lock()
-        self._fsync = os.environ.get("RAY_TPU_WAL_FSYNC", "0") \
-            not in ("0", "false", "")
-        self._interval = float(os.environ.get(
-            "RAY_TPU_GCS_SNAPSHOT_INTERVAL_S", "30"))
-        self._wal_cap = int(os.environ.get(
-            "RAY_TPU_GCS_SNAPSHOT_WAL_BYTES", str(32 << 20)))
+        self._fsync = knobs.get_bool("RAY_TPU_WAL_FSYNC")
+        self._interval = knobs.get_float(
+            "RAY_TPU_GCS_SNAPSHOT_INTERVAL_S")
+        self._wal_cap = knobs.get_int("RAY_TPU_GCS_SNAPSHOT_WAL_BYTES")
         os.makedirs(state_dir, exist_ok=True)
         # counters for the state API / CLI
         self.records_appended = 0

@@ -10,7 +10,6 @@ metadata; payloads go through the shared-memory object store.
 """
 from __future__ import annotations
 
-import os
 import pickle
 import socket
 import struct
@@ -19,6 +18,8 @@ import traceback
 from typing import Any, Optional
 
 import cloudpickle
+
+from ..util import knobs
 
 try:
     import msgpack
@@ -77,14 +78,13 @@ WIRE_KINDS = frozenset({
     # driver -> worker/agent
     "exec_task", "exec_actor_task", "exec_task_many",
     "exec_actor_task_many", "cancel", "materialize", "drop_device",
-    "revoke_tasks", "shutdown", "get_reply",
+    "revoke_tasks", "shutdown", "get_reply", "heartbeat_ack",
     # worker <-> worker (direct actor calls)
     "dcall", "dresult",
 })
 
 _wire_enabled = (msgpack is not None
-                 and os.environ.get("RAY_TPU_WIRE", "1")
-                 not in ("0", "false"))
+                 and knobs.get_bool("RAY_TPU_WIRE"))
 
 
 def set_wire_enabled(on: bool) -> None:
@@ -252,6 +252,9 @@ class Connection:
             data = cloudpickle.dumps(msg, protocol=5)
         with self._send_lock:
             try:
+                # raylint: disable=RT001 the send lock exists solely to
+                # serialize this socket write; no other state is
+                # guarded by it
                 self.sock.sendall(_HDR.pack(len(data)) + data)
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
@@ -293,6 +296,8 @@ def read_exact(sock: socket.socket, n: int) -> bytes:
     got = 0
     while got < n:
         try:
+            # raylint: disable=RT003 transport helper: callers own the
+            # timeout discipline (settimeout/select before calling)
             chunk = sock.recv(min(n - got, 1 << 20))
         except (ConnectionResetError, OSError) as e:
             raise ConnectionClosed(str(e)) from e

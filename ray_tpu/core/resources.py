@@ -15,6 +15,8 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional
 
+from ..util import knobs
+
 TPU_HEAD_FMT = "TPU-{pod_type}-head"
 
 
@@ -52,15 +54,15 @@ def detect_tpu_topology(num_chips: Optional[int] = None) -> Dict[str, str]:
     also be modeled in tests.
     """
     labels: Dict[str, str] = {}
-    pod_type = (os.environ.get("RAY_TPU_POD_TYPE")
+    pod_type = (knobs.get_raw("RAY_TPU_POD_TYPE")
                 or os.environ.get("TPU_ACCELERATOR_TYPE", ""))
     if pod_type:
         labels["tpu-pod-type"] = pod_type
-    slice_name = (os.environ.get("RAY_TPU_SLICE")
+    slice_name = (knobs.get_raw("RAY_TPU_SLICE")
                   or os.environ.get("TPU_NAME", ""))
     if slice_name:
         labels["tpu-slice"] = slice_name
-    worker_id = (os.environ.get("RAY_TPU_WORKER_ID")
+    worker_id = (knobs.get_raw("RAY_TPU_WORKER_ID")
                  or os.environ.get("TPU_WORKER_ID", ""))
     if worker_id:
         labels["tpu-worker-id"] = worker_id
@@ -75,9 +77,9 @@ def _detect_tpu_chips() -> int:
     # Avoid importing jax here (heavy, and workers may be CPU-only); trust
     # the environment first, mirroring reference TPU detection via env/
     # metadata (python/ray/_private/accelerators/tpu.py).
-    env = os.environ.get("RAY_TPU_CHIPS")
-    if env:
-        return int(env)
+    env = knobs.get_int("RAY_TPU_CHIPS")
+    if env is not None:   # 0 is a real override: force chipless
+        return env
     try:
         import jax  # noqa: PLC0415
         return sum(1 for d in jax.devices() if d.platform == "tpu")

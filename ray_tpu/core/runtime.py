@@ -37,6 +37,7 @@ from .object_store import make_store
 from .protocol import (Connection, ConnectionClosed, tcp_listener,
                        unix_listener)
 from .task import TaskSpec, ActorCreationSpec
+from ..util import knobs
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           PlacementGroupError, RuntimeNotInitializedError,
                           TaskCancelledError, TaskError, WorkerCrashedError)
@@ -287,8 +288,8 @@ class DriverRuntime:
                     # ObjectLocations, and forensics all keep naming a
                     # node that still exists
                     self.node_id = rec.node_id
-                if listen is None and not os.environ.get(
-                        "RAY_TPU_LISTEN"):
+                if listen is None \
+                        and not knobs.get_raw("RAY_TPU_LISTEN"):
                     # re-bind the crashed driver's control address so
                     # waiting node agents reattach to it
                     listen = rec.listen
@@ -310,16 +311,16 @@ class DriverRuntime:
             node_id=self.node_id, hostname=os.uname().nodename,
             resources=dict(node_res), labels=labels)
 
-        cap = object_store_memory or int(
-            os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30)))
+        cap = object_store_memory \
+            or knobs.get_int("RAY_TPU_STORE_BYTES")
         self.store = make_store(capacity_bytes=cap, is_owner=True)
-        self.max_workers = max_workers or int(
-            os.environ.get("RAY_TPU_MAX_WORKERS", "16"))
+        self.max_workers = max_workers \
+            or knobs.get_int("RAY_TPU_MAX_WORKERS")
 
         self._tmpdir = tempfile.mkdtemp(prefix="ray_tpu_")
         from .spilling import SpillManager  # noqa: PLC0415
         self._spill_env_owned = "RAY_TPU_SPILL_DIR" not in os.environ
-        spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
+        spill_dir = knobs.get_raw("RAY_TPU_SPILL_DIR") or os.path.join(
             self._tmpdir, "spill")
         os.environ["RAY_TPU_SPILL_DIR"] = spill_dir  # workers inherit
         self._spill = SpillManager(self.store, spill_dir, self.node_id)
@@ -327,7 +328,7 @@ class DriverRuntime:
         self._listener = unix_listener(self.socket_path)
         # Multi-host: optional TCP listener for remote node agents and the
         # workers they spawn ("host:port", port 0 = ephemeral).
-        listen = listen or os.environ.get("RAY_TPU_LISTEN")
+        listen = listen or knobs.get_raw("RAY_TPU_LISTEN")
         self._tcp_listener = None
         self.tcp_address: Optional[str] = None
         if listen:
@@ -374,14 +375,11 @@ class DriverRuntime:
         # leases; actor dispatch pipelines past max_concurrency (the
         # worker enforces the real execution bound). RAY_TPU_BATCH=0 is
         # the kill switch back to the legacy per-message paths.
-        self._batch_enabled = os.environ.get(
-            "RAY_TPU_BATCH", "1") not in ("0", "false")
-        self._flush_n = int(os.environ.get("RAY_TPU_BATCH_FLUSH_N", "64"))
-        self._flush_window = float(os.environ.get(
-            "RAY_TPU_BATCH_FLUSH_S", "0.001"))
-        self._lease_cap = int(os.environ.get("RAY_TPU_LEASE_SLOTS", "32"))
-        self._actor_pipeline = int(os.environ.get(
-            "RAY_TPU_ACTOR_PIPELINE", "32"))
+        self._batch_enabled = knobs.get_bool("RAY_TPU_BATCH")
+        self._flush_n = knobs.get_int("RAY_TPU_BATCH_FLUSH_N")
+        self._flush_window = knobs.get_float("RAY_TPU_BATCH_FLUSH_S")
+        self._lease_cap = knobs.get_int("RAY_TPU_LEASE_SLOTS")
+        self._actor_pipeline = knobs.get_int("RAY_TPU_ACTOR_PIPELINE")
         if not self._batch_enabled:
             self._lease_cap = 1
             self._actor_pipeline = 0
@@ -428,14 +426,12 @@ class DriverRuntime:
         self._lineage_specs: Dict[str, TaskSpec] = {}
         self._lineage_sizes: Dict[str, int] = {}
         self._lineage_bytes = 0
-        self._lineage_cap = int(os.environ.get(
-            "RAY_TPU_LINEAGE_BYTES", str(64 << 20)))
-        self._lineage_enabled = os.environ.get(
-            "RAY_TPU_LINEAGE", "1") not in ("0", "false")
+        self._lineage_cap = knobs.get_int("RAY_TPU_LINEAGE_BYTES")
+        self._lineage_enabled = knobs.get_bool("RAY_TPU_LINEAGE")
         # how long a reader blocks for a reconstruction it triggered
         # before giving up on the object
-        self._reconstruct_wait = float(os.environ.get(
-            "RAY_TPU_RECONSTRUCTION_WAIT_S", "60"))
+        self._reconstruct_wait = knobs.get_float(
+            "RAY_TPU_RECONSTRUCTION_WAIT_S")
         # latest __ray_save__ checkpoint per actor, handed back to the
         # replacement worker for __ray_restore__ around a restart
         self._actor_checkpoints: Dict[str, bytes] = {}
@@ -473,16 +469,16 @@ class DriverRuntime:
         # and post-mortem bundles
         from ..util.events import ClusterEventStore  # noqa: PLC0415
         self.cluster_events = ClusterEventStore()
-        self._node_hb_timeout = float(os.environ.get(
-            "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S", "10"))
+        self._node_hb_timeout = knobs.get_float(
+            "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S")
         # heartbeat-DECLARED death: a node silent past this long is
         # declared dead without waiting for its socket to close (a
         # SIGSTOPped/preempted host can hold a socket open for minutes);
         # its object copies are pruned and reconstruction starts
         # immediately. The fenced agent rejoins under a new incarnation.
-        self._node_death_timeout = float(os.environ.get(
+        self._node_death_timeout = knobs.get_float(
             "RAY_TPU_NODE_DEATH_TIMEOUT_S",
-            str(2.0 * self._node_hb_timeout)))
+            default=2.0 * self._node_hb_timeout)
 
         # peer-to-peer object transfer plane (core/object_transfer.py):
         # the GCS object table is the location directory; this maps each
@@ -637,9 +633,9 @@ class DriverRuntime:
             ns.restored = True
             ns.incarnation = int(info.get("incarnation", 0))
             self.cluster_nodes[nid] = ns
-        grace = float(os.environ.get(
+        grace = knobs.get_float(
             "RAY_TPU_RESUME_REATTACH_GRACE_S",
-            os.environ.get("RAY_TPU_NODE_REJOIN_S", "30")))
+            default=knobs.get_float("RAY_TPU_NODE_REJOIN_S"))
         self._reattach_deadline = time.time() + grace
 
         # ---- lineage + task table (reconstruction needs both)
@@ -845,12 +841,20 @@ class DriverRuntime:
                 self.inbox.put(("register", wid, conn, msg[2],
                                 msg[3] if len(msg) > 3 else None))
                 while True:
+                    # raylint: disable=RT003 driver-side reader: worker
+                    # process death closes the socket (EOF); host-level
+                    # silence is the heartbeat monitor's job, which
+                    # closes this conn on the node's death
+                    # determination, unblocking the read
                     m = conn.recv()
                     self.inbox.put(("worker_msg", wid, m))
             elif msg[0] == "register_node":
                 nid = msg[1]["node_id"]
                 self.inbox.put(("register_node", msg[1], conn))
                 while True:
+                    # raylint: disable=RT003 heartbeat-declared node
+                    # death closes this conn, so a silent peer unblocks
+                    # the read within RAY_TPU_NODE_DEATH_TIMEOUT_S
                     m = conn.recv()
                     # the conn travels with the message so the dispatcher
                     # can fence traffic from a superseded incarnation
@@ -881,6 +885,9 @@ class DriverRuntime:
 
     def _dispatch_loop(self):
         while True:
+            # raylint: disable=RT003 every control frame lands in this
+            # inbox and the reap loop posts a tick each interval: the
+            # blocking get is the dispatcher's idle state, never a park
             item = self.inbox.get()
             if item is None:
                 return
@@ -1262,6 +1269,15 @@ class DriverRuntime:
             ns.heartbeat_missed = False
         mtype = m[0]
         if mtype == "heartbeat":
+            # ack so the AGENT can tell a silent-dead driver host from
+            # an idle one (node.py's RAY_TPU_DRIVER_SILENCE_S watchdog;
+            # a half-open TCP peer never errors a blocking recv) —
+            # this is the agent-side mirror of heartbeat-declared death
+            if conn is not None:
+                try:
+                    conn.send(("heartbeat_ack", m[1]))
+                except Exception:
+                    pass  # reader will determine the death
             return
         if mtype == "batch":
             # agent-side telemetry kinds coalesced into one frame
@@ -1438,15 +1454,14 @@ class DriverRuntime:
     # ---------------- lineage / reconstruction ----------------
     @staticmethod
     def _max_reconstruction_depth() -> int:
-        return int(os.environ.get(
-            "RAY_TPU_MAX_RECONSTRUCTION_DEPTH", "16"))
+        return knobs.get_int("RAY_TPU_MAX_RECONSTRUCTION_DEPTH")
 
     @staticmethod
     def _max_reconstructions() -> int:
         """Per-task cap on REPEAT re-executions (distinct from the
         recursion depth cap): a flapping node must not re-run the same
         producer forever while a reader blocks."""
-        return int(os.environ.get("RAY_TPU_MAX_RECONSTRUCTIONS", "20"))
+        return knobs.get_int("RAY_TPU_MAX_RECONSTRUCTIONS")
 
     def _lineage_cost(self, spec) -> int:
         """Rough retained footprint of one lineage entry: func_bytes
@@ -2395,8 +2410,8 @@ class DriverRuntime:
                     # still be joining (a STRICT_SPREAD created before
                     # remote agents register must not fail instantly).
                     # Only declare infeasibility after a grace window.
-                    grace = float(os.environ.get(
-                        "RAY_TPU_PG_INFEASIBLE_GRACE_S", "10"))
+                    grace = knobs.get_float(
+                        "RAY_TPU_PG_INFEASIBLE_GRACE_S")
                     if time.time() - pg.created_at < grace:
                         continue
                     pg.state = "INFEASIBLE"
@@ -2946,7 +2961,7 @@ class DriverRuntime:
         separate workers unconditionally)."""
         if self._lease_cap <= 1:
             return
-        stall = float(os.environ.get("RAY_TPU_LEASE_HEAD_S", "1.0"))
+        stall = knobs.get_float("RAY_TPU_LEASE_HEAD_S")
         if stall <= 0:
             return
         now = time.time()
@@ -3524,8 +3539,7 @@ class DriverRuntime:
                 the reply tuple. Raises (notably ObjectLostError) on an
                 unreachable holder — the caller then triggers lineage
                 reconstruction and retries with the fresh location."""
-                chunk_sz = int(os.environ.get("RAY_TPU_FETCH_CHUNK",
-                                              str(64 << 20)))
+                chunk_sz = knobs.get_int("RAY_TPU_FETCH_CHUNK")
                 if getattr(loc, "kind", None) == "inline" or \
                         (loc.node_id or self.node_id) == wnode:
                     return ("loc", loc)  # reconstructed copy came local
@@ -4023,7 +4037,10 @@ class DriverRuntime:
                         # WAL via the dispatcher: an API-thread append
                         # racing a snapshot rotation could land in the
                         # WAL generation being deleted and vanish
-                        self.inbox.put(("wal", ("kvput", key, value)))
+                        # raylint: disable=RT001 self.inbox is an
+                        # unbounded queue.Queue; put never blocks
+                        self.inbox.put(
+                            ("wal", ("kvput", key, value)))
                 return existed
             if op == "get":
                 return kv.get(args[0])
@@ -4032,7 +4049,10 @@ class DriverRuntime:
             if op == "del":
                 key, by_prefix = args
                 if self._persist is not None:
-                    self.inbox.put(("wal", ("kvdel", key, by_prefix)))
+                    # raylint: disable=RT001 self.inbox is an
+                    # unbounded queue.Queue; put never blocks
+                    self.inbox.put(
+                        ("wal", ("kvdel", key, by_prefix)))
                 if by_prefix:
                     doomed = [k for k in kv if k.startswith(key)]
                     for k in doomed:
@@ -4363,7 +4383,7 @@ class DriverRuntime:
         # spill dir / node id instead of this runtime's dead paths.
         if self._spill_env_owned:
             os.environ.pop("RAY_TPU_SPILL_DIR", None)
-        if os.environ.get("RAY_TPU_NODE_ID") == self.node_id:
+        if knobs.get_raw("RAY_TPU_NODE_ID") == self.node_id:
             os.environ.pop("RAY_TPU_NODE_ID", None)
         import shutil
         shutil.rmtree(self._tmpdir, ignore_errors=True)

@@ -23,9 +23,11 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from ..util import knobs
+
 
 def spill_threshold() -> float:
-    return float(os.environ.get("RAY_TPU_SPILL_THRESHOLD", "0.6"))
+    return knobs.get_float("RAY_TPU_SPILL_THRESHOLD")
 
 
 class SpillManager:
@@ -117,7 +119,7 @@ def put_value_or_spill(store, oid: str, value):
     try:
         return store.put_value(oid, value)
     except ObjectStoreFullError:
-        spill_dir = os.environ.get("RAY_TPU_SPILL_DIR")
+        spill_dir = knobs.get_raw("RAY_TPU_SPILL_DIR")
         if not spill_dir:
             raise
         from . import serialization  # noqa: PLC0415

@@ -31,6 +31,7 @@ from ..exceptions import (ActorDiedError, TaskError, GetTimeoutError,
                           ObjectLostError)
 from ..util import events as events_mod
 from ..util import metrics as metrics_mod
+from ..util import knobs
 from ..util import metrics_catalog as mcat
 from ..util import tracing
 
@@ -83,8 +84,14 @@ class _MsgBatcher:
                     return
                 buf, self._buf = self._buf, []
             if len(buf) == 1:
+                # raylint: disable=RT001 deliberate: swap+send serialize under
+                # the send lock so the flush ordering fence holds (PR 8,
+                # SCHEDULING.md); the one re-entry path (_publish_direct)
+                # bypasses the batcher and sends straight on the Connection
                 self.conn.send(buf[0])
             else:
+                # raylint: disable=RT001 deliberate: same ordering fence as the
+                # single-message branch above
                 self.conn.send(("batch", buf))
 
     def _loop(self) -> None:
@@ -156,6 +163,10 @@ class _DirectChannel:
     def _read_loop(self) -> None:
         while True:
             try:
+                # raylint: disable=RT003 daemon reader; in-flight calls
+                # settle via driver-path failover once the callee's
+                # death is determined (SCHEDULING.md), so a half-open
+                # channel parks only this thread, never a caller
                 m = self.conn.recv()
             except ConnectionClosed as e:
                 self._fail(f"connection lost: {e}")
@@ -243,14 +254,13 @@ class WorkerRuntime:
         # this worker's actor began life via __ray_restore__ (surfaced
         # as RuntimeContext.was_current_actor_reconstructed)
         self.actor_restored = False
-        self.job_id = os.environ.get("RAY_TPU_JOB_ID", "job-default")
+        self.job_id = knobs.get_str("RAY_TPU_JOB_ID")
         # outbound control-message batcher (WorkerLoop swaps in the
         # real one before the first task runs); the default passthrough
         # keeps early sends working
         self._batch = _MsgBatcher(conn, enabled=False)
         # ---- driver-bypass actor calls (docs/SCHEDULING.md) ----
-        self._direct_enabled = os.environ.get(
-            "RAY_TPU_DIRECT_CALLS", "1") not in ("0", "false")
+        self._direct_enabled = knobs.get_bool("RAY_TPU_DIRECT_CALLS")
         self._direct_lock = threading.Lock()
         self._direct_chans: Dict[str, _DirectChannel] = {}
         self._direct_retry_after: Dict[str, float] = {}
@@ -599,7 +609,7 @@ class WorkerRuntime:
             # get(timeout=T) still bounds at ~T, not 2T
             self.conn.send(("object_unreachable", oid,
                             getattr(payload, "node_id", None)
-                            or os.environ.get("RAY_TPU_NODE_ID"),
+                            or knobs.get_raw("RAY_TPU_NODE_ID"),
                             getattr(payload, "seal_seq", None)))
             remaining = None if timeout is None else max(
                 0.1, timeout - (time.monotonic() - t0))
@@ -812,7 +822,7 @@ class DirectCallServer:
             from .protocol import unix_listener  # noqa: PLC0415
             # prefer the driver's log dir (cleaned up at driver
             # shutdown) over a per-worker tmpdir that os._exit leaks
-            base = os.environ.get("RAY_TPU_LOG_DIR")
+            base = knobs.get_raw("RAY_TPU_LOG_DIR")
             if not base or not os.path.isdir(base):
                 base = tempfile.mkdtemp(prefix="ray_tpu_dcall_")
             path = os.path.join(
@@ -836,6 +846,10 @@ class DirectCallServer:
     def _reader(self, conn: Connection) -> None:
         while True:
             try:
+                # raylint: disable=RT003 inbound direct-call conn: a dead
+                # caller's socket closes (EOF) and its calls were already
+                # failed over by the driver's death determination; a parked
+                # reader costs one daemon thread
                 m = conn.recv()
             except ConnectionClosed:
                 return
@@ -873,8 +887,9 @@ class WorkerLoop:
         # socket_path is a unix path for same-host workers or
         # "tcp://host:port" for workers spawned by a remote node agent.
         self.conn = connect_address(socket_path)
-        self.store = make_store(capacity_bytes=int(
-            os.environ.get("RAY_TPU_STORE_BYTES", str(8 << 30))), is_owner=False)
+        self.store = make_store(
+            capacity_bytes=knobs.get_int("RAY_TPU_STORE_BYTES"),
+            is_owner=False)
         self.rt = WorkerRuntime(self.conn, worker_id, self.store)
         self.worker_id = worker_id
         self._task_q: "queue.Queue" = queue.Queue()
@@ -898,14 +913,11 @@ class WorkerLoop:
         self._queued_tasks: set = set()
         # worker->driver control-message batcher: completions, seals
         # and nested submits coalesce into ("batch", ...) frames
-        batch_on = os.environ.get("RAY_TPU_BATCH", "1") \
-            not in ("0", "false")
         self._batch = _MsgBatcher(
             self.conn,
-            max_n=int(os.environ.get("RAY_TPU_BATCH_FLUSH_N", "64")),
-            window=float(os.environ.get("RAY_TPU_BATCH_FLUSH_S",
-                                        "0.001")),
-            enabled=batch_on)
+            max_n=knobs.get_int("RAY_TPU_BATCH_FLUSH_N"),
+            window=knobs.get_float("RAY_TPU_BATCH_FLUSH_S"),
+            enabled=knobs.get_bool("RAY_TPU_BATCH"))
         self.rt._batch = self._batch
         # direct-call plane listener (RAY_TPU_DIRECT_CALLS=0 disables)
         self._direct_server = None
@@ -936,8 +948,7 @@ class WorkerLoop:
                         if self._direct_server else None))
         reader = threading.Thread(target=self._read_loop, daemon=True)
         reader.start()
-        interval = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S",
-                                        "1.0"))
+        interval = knobs.get_float("RAY_TPU_METRICS_INTERVAL_S")
         self._heartbeat_on = interval > 0
         if interval > 0:
             threading.Thread(target=self._telemetry_loop,
@@ -968,6 +979,10 @@ class WorkerLoop:
         from .protocol import RECV_ERROR  # noqa: PLC0415
         while True:
             try:
+                # raylint: disable=RT003 the worker's own driver conn: driver
+                # process death closes it, and a silent driver HOST is the
+                # node agent's RAY_TPU_DRIVER_SILENCE_S watchdog's job — it
+                # terminates this worker when it rejoins
                 msg = self.conn.recv()
             except ConnectionClosed:
                 self._shutdown.set()
@@ -1041,7 +1056,7 @@ class WorkerLoop:
             "task_id": spec.task_id, "name": spec.name,
                 "start": start, "end": end, "status": status,
                 "pid": os.getpid(), "worker_id": self.worker_id,
-                "node_id": os.environ.get("RAY_TPU_NODE_ID"),
+                "node_id": knobs.get_raw("RAY_TPU_NODE_ID"),
             })
 
     def _flush_telemetry(self, min_interval: float = 0.0) -> None:
@@ -1334,8 +1349,8 @@ class WorkerLoop:
         interval = getattr(self._actor_spec, "checkpoint_interval_s",
                            None)
         if interval is None:
-            interval = float(os.environ.get(
-                "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S", "0"))
+            interval = knobs.get_float(
+                "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S")
         try:
             # pack AND send under the lock: with max_concurrency > 1,
             # an older blob sent after a newer one would roll the
@@ -1346,6 +1361,10 @@ class WorkerLoop:
                     return
                 blob = serialization.pack(save())
                 self._last_ckpt = now
+                # raylint: disable=RT001 deliberate pack+send
+                # atomicity (PR 4): _ckpt_lock serializes checkpoints
+                # only — a blocking send delays at most the next
+                # checkpoint, and Connection has its own send lock
                 self.conn.send(("actor_ckpt", self.rt.current_actor_id,
                                 blob))
             mcat.get("ray_tpu_actor_checkpoints_total").inc()
@@ -1532,7 +1551,7 @@ class WorkerLoop:
 
 def main() -> None:
     socket_path, worker_id = sys.argv[1], sys.argv[2]
-    log_dir = os.environ.get("RAY_TPU_LOG_DIR")
+    log_dir = knobs.get_raw("RAY_TPU_LOG_DIR")
     if log_dir:
         from .logging import redirect_process_output  # noqa: PLC0415
         redirect_process_output(

@@ -12,8 +12,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
-import os
 
+from ..util import knobs
 from .block import Block, block_size_bytes
 from .plan import Stage, call_block_fn, fuse_stages
 
@@ -23,8 +23,7 @@ MAX_IN_FLIGHT = 8
 # resource-budgeted streaming_executor_state; the count bound still
 # applies on top. At least one block is always admitted so a single
 # over-budget block can't deadlock the stream.
-MAX_IN_FLIGHT_BYTES = int(os.environ.get(
-    "RAY_TPU_DATA_INFLIGHT_BYTES", str(256 << 20)))
+MAX_IN_FLIGHT_BYTES = knobs.get_int("RAY_TPU_DATA_INFLIGHT_BYTES")
 
 
 class DatasetStats:

@@ -13,6 +13,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..util import knobs
+
 
 def _rss_bytes(pid: int) -> Optional[int]:
     try:
@@ -107,7 +109,7 @@ class MemoryMonitor:
                         "node.memory_pressure",
                         f"host available memory {frac:.1%} below "
                         f"threshold {self.min_available_frac:.1%}",
-                        node_id=os.environ.get("RAY_TPU_NODE_ID"),
+                        node_id=knobs.get_raw("RAY_TPU_NODE_ID"),
                         available_frac=round(frac, 4),
                         threshold=self.min_available_frac)
                 except Exception:

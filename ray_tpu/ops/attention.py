@@ -11,11 +11,12 @@ dependency in its model code (e.g. rllib models and train examples).
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..util import knobs
 
 logger = logging.getLogger("ray_tpu.ops.attention")
 
@@ -79,7 +80,7 @@ def _resolve_impl(impl: str, q: jax.Array, k: jax.Array, causal: bool,
     interpret-mode Pallas would crawl. RAY_TPU_ATTN_IMPL overrides the
     auto choice (benchmark A/B knob)."""
     if impl == "auto":
-        impl = os.environ.get("RAY_TPU_ATTN_IMPL", "auto")
+        impl = knobs.get_str("RAY_TPU_ATTN_IMPL")
     if impl != "auto":
         return impl
     if jax.default_backend() != "tpu":
@@ -226,8 +227,8 @@ def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v.astype(v_flat.dtype).reshape(b * s, *v.shape[2:]))
     new_lengths = jnp.maximum(lengths, positions[:, -1] + 1)
 
-    if cache.fresh and os.environ.get(
-            "RAY_TPU_PAGED_ATTN_IMPL", "auto") != "gather":
+    if cache.fresh \
+            and knobs.get_str("RAY_TPU_PAGED_ATTN_IMPL") != "gather":
         # pure prefill (all sequences start empty): no prior context to
         # gather — attend directly over the new tokens via the model's
         # configured attention impl (flash-eligible for long prompts on
@@ -245,7 +246,7 @@ def paged_cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # DIRECTLY via scalar-prefetched page tables — no (B, L, Hkv, D)
     # contiguous gather temp, and work scales with real sequence
     # lengths. RAY_TPU_PAGED_ATTN_IMPL: auto|gather|pallas.
-    impl = os.environ.get("RAY_TPU_PAGED_ATTN_IMPL", "auto")
+    impl = knobs.get_str("RAY_TPU_PAGED_ATTN_IMPL")
     if s == 1 and impl != "gather":
         on_tpu = jax.default_backend() == "tpu"
         if impl == "pallas" or on_tpu:

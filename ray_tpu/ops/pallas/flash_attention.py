@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...util import knobs
 from ...util.jax_compat import pallas_tpu_compiler_params \
     as _CompilerParams
 
@@ -344,11 +345,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     Block sizes default to 128x128; RAY_TPU_FLASH_BLOCK_Q/K override for
     on-chip tuning sweeps (bench.py --phase flash-ab).
     """
-    import os
     if block_q is None:
-        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "128"))
+        block_q = knobs.get_int("RAY_TPU_FLASH_BLOCK_Q")
     if block_k is None:
-        block_k = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "128"))
+        block_k = knobs.get_int("RAY_TPU_FLASH_BLOCK_K")
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if scale is None:

@@ -103,12 +103,8 @@ def default_request_timeout_s() -> float:
     """Per-request budget when the client supplies no deadline (HTTP
     X-Serve-Timeout-S header / gRPC deadline). Shared by both ingress
     proxies; replaces the old hardcoded 60s unary timeout."""
-    import os
-    try:
-        return float(os.environ.get(
-            "RAY_TPU_SERVE_REQUEST_TIMEOUT_S", "60"))
-    except ValueError:
-        return 60.0
+    from ..util import knobs
+    return knobs.get_float("RAY_TPU_SERVE_REQUEST_TIMEOUT_S")
 
 
 @dataclass

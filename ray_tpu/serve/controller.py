@@ -9,7 +9,6 @@ so control-plane progress never depends on incoming calls.
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -31,13 +30,8 @@ def _env_float(name: str, default: float) -> float:
     """Env knob with a per-deployment-config fallback: the serve FT
     knobs (RAY_TPU_SERVE_HEALTH_PERIOD_S/_TIMEOUT_S/_THRESHOLD) apply
     cluster-wide when set; otherwise each deployment's config wins."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
+    from ..util import knobs
+    return knobs.get_float(name, default=default)
 
 
 def _emit_serve_event(etype: str, message: str = "", **attrs) -> None:
@@ -757,7 +751,32 @@ class ServeController:
         wait(timeout=0) and a new probe is dispatched once the previous
         answered and the sampling period elapsed. Runs for EVERY
         deployment (least-busy scale-down victim selection wants a load
-        sample) — only autoscaling ones keep the windowed history."""
+        sample) — only autoscaling ones keep the windowed history.
+
+        Settling the probe refs happens OUTSIDE the controller lock:
+        wait/get are worker->driver socket round trips even for a
+        ready ref, and holding the lock across them stalls every
+        handle's routing-table RPC whenever the dispatcher is busy —
+        the PR 7 stall class this controller's _autoscale_step already
+        phase-locks against (raylint RT001). Only the control loop
+        settles probe refs, so the unlocked window cannot race another
+        settler."""
+        with self._lock:
+            st = self._deployments.get(key)
+            if st is None:
+                return
+            pending = [(r, r.metrics_ref) for r in st.replicas
+                       if r.state == "RUNNING"
+                       and r.metrics_ref is not None]
+        settled: Dict[int, Optional[dict]] = {}
+        for r, ref in pending:
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if not ready:
+                continue
+            try:
+                settled[id(r)] = ray_tpu.get(ref)
+            except Exception:  # noqa: BLE001  dying replica
+                settled[id(r)] = None
         with self._lock:
             st = self._deployments.get(key)
             if st is None:
@@ -772,14 +791,11 @@ class ServeController:
             for r in st.replicas:
                 if r.state != "RUNNING":
                     continue
-                if r.metrics_ref is not None:
-                    ready, _ = ray_tpu.wait([r.metrics_ref], timeout=0)
-                    if ready:
-                        ref, r.metrics_ref = r.metrics_ref, None
-                        try:
-                            r.last_metrics = ray_tpu.get(ref)
-                        except Exception:  # noqa: BLE001  dying replica
-                            pass
+                if r.metrics_ref is not None and id(r) in settled:
+                    r.metrics_ref = None
+                    m = settled[id(r)]
+                    if m is not None:
+                        r.last_metrics = m
                 if (r.metrics_ref is None
                         and now - r.metrics_dispatch_ts >= period):
                     r.metrics_dispatch_ts = now

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import collections
 import itertools
-import os
 import queue as queue_mod
 import threading
 import time
@@ -38,6 +37,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ...util import knobs
 
 
 @dataclass
@@ -441,11 +442,8 @@ class LLMEngine:
         if cfg.watchdog_s is not None:
             self._watchdog_s = float(cfg.watchdog_s)
         else:
-            try:
-                self._watchdog_s = float(os.environ.get(
-                    "RAY_TPU_ENGINE_WATCHDOG_S", "30"))
-            except ValueError:
-                self._watchdog_s = 30.0
+            self._watchdog_s = knobs.get_float(
+                "RAY_TPU_ENGINE_WATCHDOG_S")
         self._progress_ts = time.time()
         self._wedged_since: Optional[float] = None
         # True while the loop thread is inside the admit/dispatch/drain
@@ -1181,6 +1179,11 @@ class LLMEngine:
         if req is None:
             raise KeyError(request_id)
         while True:
+            # raylint: disable=RT003 the engine loop cannot exit with this
+            # request registered: its catch-all errors every active
+            # request's queue, failed admits error theirs, and the wedge
+            # watchdog aborts stalled requests — while a timeout here
+            # would kill legitimate multi-minute first-jit prefills
             kind, payload = req.out_queue.get()
             if kind == "token":
                 yield payload

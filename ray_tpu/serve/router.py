@@ -35,10 +35,11 @@ import bisect
 import collections
 import hashlib
 import math
-import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..util import knobs
 
 # virtual points per replica on the hash ring: enough to spread keys
 # evenly across small replica sets without making ring builds costly
@@ -46,13 +47,11 @@ _VNODES = 64
 # bounded-load factor c: a preferred replica is skipped when its load
 # exceeds c * (average load + 1). c=2 tolerates bursty sessions while
 # still shedding a pathological hot key onto the rest of the fleet.
-_BOUND_FACTOR = float(os.environ.get("RAY_TPU_SERVE_AFFINITY_BOUND",
-                                     "2.0"))
+_BOUND_FACTOR = knobs.get_float("RAY_TPU_SERVE_AFFINITY_BOUND")
 # bindings kept per handle (LRU); beyond this the oldest sessions
 # silently fall back to ring ownership (which is where they were bound
 # anyway unless they were diverted)
-_SESSION_CAP = int(os.environ.get("RAY_TPU_SERVE_AFFINITY_SESSIONS",
-                                  "4096"))
+_SESSION_CAP = knobs.get_int("RAY_TPU_SERVE_AFFINITY_SESSIONS")
 
 
 def _hash64(s: str) -> int:

@@ -15,12 +15,12 @@ ICI/DCN — this backend only forms the mesh, it never moves tensors.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional
 
 import jax
 
 from ..parallel.mesh import MeshSpec, build_mesh
+from ..util import knobs
 
 
 @dataclasses.dataclass
@@ -71,8 +71,8 @@ def worker_env(rank: int, world_size: int,
 
 
 def detect_rank() -> int:
-    return int(os.environ.get("RAY_TPU_TRAIN_RANK", "0"))
+    return knobs.get_int("RAY_TPU_TRAIN_RANK")
 
 
 def detect_world_size() -> int:
-    return int(os.environ.get("RAY_TPU_TRAIN_WORLD", "1"))
+    return knobs.get_int("RAY_TPU_TRAIN_WORLD")

@@ -22,10 +22,11 @@ supervision, read through the GCS rather than a side channel).
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..util import knobs
 
 #: seconds between supervisor scans of the rank actors' GCS state
 ENV_PROBE_S = "RAY_TPU_GANG_PROBE_S"
@@ -37,15 +38,15 @@ ENV_REPLACE_WAIT_S = "RAY_TPU_GANG_REPLACE_WAIT_S"
 
 
 def _probe_s() -> float:
-    return float(os.environ.get(ENV_PROBE_S, "0.25"))
+    return knobs.get_float(ENV_PROBE_S)
 
 
 def reform_timeout_s() -> float:
-    return float(os.environ.get(ENV_REFORM_TIMEOUT_S, "120"))
+    return knobs.get_float(ENV_REFORM_TIMEOUT_S)
 
 
 def replace_wait_s() -> float:
-    return float(os.environ.get(ENV_REPLACE_WAIT_S, "5"))
+    return knobs.get_float(ENV_REPLACE_WAIT_S)
 
 
 @dataclasses.dataclass

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..parallel.mesh import MeshSpec, build_mesh
+from ..util import knobs
 from .checkpoint import CheckpointManager, restore_pytree
 from .config import RunConfig
 from .optim import make_optimizer, warmup_cosine
@@ -258,7 +259,7 @@ def _elastic_rank_fn(rank: int, world: int, payload: Dict[str, Any]):
     cfg: Dict[str, Any] = payload
     generation = cfg["generation"]
 
-    trace_path = os.environ.get("RAY_TPU_ELASTIC_TRACE")
+    trace_path = knobs.get_raw("RAY_TPU_ELASTIC_TRACE")
 
     def _trace(msg: str) -> None:
         if trace_path:
@@ -419,8 +420,8 @@ class ElasticSpmdTrainer:
         self.run_config = run_config or RunConfig(name="elastic_spmd")
         if max_failures is None:
             mf = self.run_config.failure_config.max_failures
-            max_failures = mf if mf > 0 else int(
-                os.environ.get("RAY_TPU_TRAIN_MAX_FAILURES", "8"))
+            max_failures = mf if mf > 0 \
+                else knobs.get_int("RAY_TPU_TRAIN_MAX_FAILURES")
         self.max_failures = max_failures
         self.collective_groups = tuple(collective_groups)
 

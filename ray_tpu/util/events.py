@@ -26,17 +26,17 @@ task-throughput delta).
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import events_catalog
+from . import knobs
 
 # Fields promoted to top-level columns (everything else lands in attrs).
 ID_KEYS = ("task_id", "actor_id", "object_id", "node_id", "worker_id")
 
-_enabled = os.environ.get("RAY_TPU_EVENTS", "1") not in ("0", "false")
+_enabled = knobs.get_bool("RAY_TPU_EVENTS")
 
 
 def set_enabled(on: bool) -> None:
@@ -55,8 +55,7 @@ class EventBuffer:
     them so a saturated buffer is visible, never silent."""
 
     def __init__(self, maxlen: Optional[int] = None):
-        self.maxlen = maxlen or int(
-            os.environ.get("RAY_TPU_EVENT_BUFFER", "4096"))
+        self.maxlen = maxlen or knobs.get_int("RAY_TPU_EVENT_BUFFER")
         self._events: collections.deque = collections.deque(
             maxlen=self.maxlen)
         self._lock = threading.Lock()
@@ -164,8 +163,7 @@ class ClusterEventStore:
     _ID_KEY_CAP = 8192
 
     def __init__(self, maxlen: Optional[int] = None):
-        self.maxlen = maxlen or int(
-            os.environ.get("RAY_TPU_EVENT_STORE", "16384"))
+        self.maxlen = maxlen or knobs.get_int("RAY_TPU_EVENT_STORE")
         self._events: collections.deque = collections.deque(
             maxlen=self.maxlen)
         # id value -> deque of event dicts referencing it (insertion

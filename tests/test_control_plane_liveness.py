@@ -1,0 +1,283 @@
+"""Regression tests for the genuine bugs raylint RT001/RT003 surfaced
+(docs/STATIC_ANALYSIS.md records both).
+
+1. RT001 — the serve controller held its reconcile lock across the
+   autoscale-metric `wait`/`get` round trips. Every `handle` routing
+   RPC shares that lock, so a busy dispatcher stalled the whole serve
+   control plane during the exact load spike that made the metrics
+   interesting. `_collect_autoscale_metrics` now settles probe refs
+   UNLOCKED (the `_autoscale_step` three-phase pattern).
+
+2. RT003 — the node agent's command loop parked in `conn.recv()` with
+   no liveness bound. A driver HOST that dies without FIN/RST
+   (preemption, partition) left the agent blocked for the ~15min TCP
+   retransmit timeout — its capacity lost long after the driver
+   restarted. The agent now acks-or-dies: the driver acks heartbeats,
+   and total silence past RAY_TPU_DRIVER_SILENCE_S closes the conn and
+   enters the normal rejoin loop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# 1. controller autoscale collection must not hold the lock across I/O
+
+
+class _TrackedRLock:
+    """RLock that exposes this thread's hold depth, so a stub can
+    assert a call ran OUTSIDE the lock."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.depth = 0
+
+    def __enter__(self):
+        self._lock.acquire()
+        self.depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.depth -= 1
+        self._lock.release()
+
+
+class _StubHandle:
+    class _Method:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def remote(self):
+            self.outer.dispatched += 1
+            return f"probe-{self.outer.dispatched}"
+
+    def __init__(self):
+        self.dispatched = 0
+        self.get_autoscale_metrics = self._Method(self)
+
+
+class _StubRay:
+    """Stands in for the ray_tpu module inside the controller: records
+    the lock depth at every wait()/get() so the test fails if either
+    round trip ever moves back under the reconcile lock."""
+
+    def __init__(self, lock, results):
+        self.lock = lock
+        self.results = results
+        self.wait_depths = []
+        self.get_depths = []
+
+    def wait(self, refs, timeout=None):
+        self.wait_depths.append(self.lock.depth)
+        ready = [r for r in refs if r in self.results]
+        return ready, [r for r in refs if r not in self.results]
+
+    def get(self, ref):
+        self.get_depths.append(self.lock.depth)
+        out = self.results[ref]
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+
+def _bare_controller():
+    from ray_tpu.serve.controller import ServeController
+    c = ServeController.__new__(ServeController)   # no control loop
+    c._deployments = {}
+    c._lock = _TrackedRLock()
+    return c
+
+
+def _deployment(autoscaling=True):
+    from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+    from ray_tpu.serve.controller import _DeploymentState
+    cfg = DeploymentConfig(
+        autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=3, metrics_interval_s=0.01)
+        if autoscaling else None)
+    return _DeploymentState("app", "d", b"", (), {}, cfg, "v1", None,
+                            False)
+
+
+def _replica(st, rid, metrics_ref=None):
+    from ray_tpu.serve.config import ReplicaInfo
+    r = ReplicaInfo(replica_id=rid, deployment_name="d",
+                    app_name="app", version="v1", state="RUNNING",
+                    actor_handle=_StubHandle())
+    r.metrics_ref = metrics_ref
+    st.replicas.append(r)
+    return r
+
+
+def test_autoscale_metric_settle_runs_outside_controller_lock():
+    c = _bare_controller()
+    st = _deployment()
+    c._deployments["app/d"] = st
+    r1 = _replica(st, "r1", metrics_ref="ref-1")
+    r2 = _replica(st, "r2", metrics_ref="ref-2")
+    stub = _StubRay(c._lock, {
+        "ref-1": {"ongoing": 2, "streams": 1,
+                  "engine": {"queue_depth": 3, "kv_util": 0.5}},
+        # ref-2 not ready this pass
+    })
+
+    c._collect_autoscale_metrics(stub, "app/d")
+
+    # the settle round trips ran, and every one ran UNLOCKED — holding
+    # the reconcile lock across them is the PR 7 stall class (RT001)
+    assert stub.wait_depths and stub.get_depths
+    assert all(d == 0 for d in stub.wait_depths), stub.wait_depths
+    assert all(d == 0 for d in stub.get_depths), stub.get_depths
+
+    # functional: the ready probe landed, the pending one stayed out
+    assert r1.last_metrics["ongoing"] == 2
+    assert r2.last_metrics is None
+    assert r2.metrics_ref == "ref-2"
+    # a fresh probe was re-dispatched for the settled replica
+    assert r1.metrics_ref == "probe-1"
+    # the aggregate window advanced (2 + 1 stream + 3 queued = 6)
+    assert st._ongoing_history and st._ongoing_history[-1][1] == 6.0
+    assert st._last_metrics["queue_depth"] == 3.0
+
+
+def test_autoscale_metric_settle_survives_dying_replica():
+    c = _bare_controller()
+    st = _deployment()
+    c._deployments["app/d"] = st
+    r1 = _replica(st, "r1", metrics_ref="ref-1")
+    stub = _StubRay(c._lock, {"ref-1": RuntimeError("replica died")})
+
+    c._collect_autoscale_metrics(stub, "app/d")
+
+    assert r1.last_metrics is None          # failed settle dropped
+    assert r1.metrics_ref == "probe-1"      # but a fresh probe went out
+
+
+def test_autoscale_metric_settle_tolerates_deleted_deployment():
+    c = _bare_controller()
+    st = _deployment()
+    c._deployments["app/d"] = st
+    _replica(st, "r1", metrics_ref="ref-1")
+
+    class _DeletingRay(_StubRay):
+        def wait(self, refs, timeout=None):
+            # the deployment vanishes between the two lock phases
+            c._deployments.clear()
+            return super().wait(refs, timeout=timeout)
+
+    stub = _DeletingRay(c._lock, {"ref-1": {"ongoing": 1}})
+    c._collect_autoscale_metrics(stub, "app/d")   # must not raise
+    assert not st._ongoing_history
+
+
+# ---------------------------------------------------------------------------
+# 2. node agent must rejoin when the driver goes silent (half-open TCP)
+
+
+class _SilentDriver:
+    """Accepts agent connections and reads every frame — registrations,
+    heartbeats — but never sends a byte back. From the agent's side
+    this is exactly a preempted driver host: the socket looks alive,
+    sends "succeed" into the void, and recv() would park forever.
+
+    With `torn_frame=True` it instead dies MID-FRAME: on each
+    registration it writes a frame header promising 100 bytes, ships
+    10, and goes silent — the select() gate sees readable bytes, the
+    agent parks inside read_exact, and only the heartbeat-thread
+    silence watchdog can unblock it."""
+
+    def __init__(self, torn_frame=False):
+        from ray_tpu.core import protocol
+        self._protocol = protocol
+        self.torn_frame = torn_frame
+        self.listener = protocol.tcp_listener("127.0.0.1", 0)
+        self.port = self.listener.getsockname()[1]
+        self.registrations = []
+        self.heartbeats = 0
+        self._conns = []
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _ = self.listener.accept()
+            except OSError:
+                return
+            conn = self._protocol.Connection(sock)
+            self._conns.append(conn)
+            threading.Thread(target=self._drain, args=(conn,),
+                             daemon=True).start()
+
+    def _drain(self, conn):
+        while True:
+            try:
+                m = conn.recv()
+            except self._protocol.ConnectionClosed:
+                return
+            if m[0] == "register_node":
+                self.registrations.append(dict(m[1]))
+                if self.torn_frame:
+                    import struct
+                    try:   # 100-byte frame promised, 10 shipped
+                        conn.sock.sendall(
+                            struct.pack("<I", 100) + b"x" * 10)
+                    except OSError:
+                        pass
+            elif m[0] == "heartbeat":
+                self.heartbeats += 1
+
+    def close(self):
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+@pytest.mark.parametrize("torn_frame", [False, True],
+                         ids=["between-frames", "mid-frame"])
+def test_agent_rejoins_after_silent_driver(monkeypatch, tmp_path,
+                                           torn_frame):
+    driver = _SilentDriver(torn_frame=torn_frame)
+    # placeholders so monkeypatch restores what NodeAgent.__init__
+    # writes into the process env
+    monkeypatch.setenv("RAY_TPU_NODE_ID", "restore-me")
+    monkeypatch.setenv("RAY_TPU_SPILL_DIR", str(tmp_path / "spill"))
+    monkeypatch.delenv("RAY_TPU_ARENA_NAME", raising=False)
+    monkeypatch.setenv("RAY_TPU_STORE_BYTES", str(64 << 20))
+    monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0")
+    monkeypatch.setenv("RAY_TPU_NODE_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_DRIVER_SILENCE_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_NODE_REJOIN_S", "5")
+
+    from ray_tpu.core.node import NodeAgent
+    agent = NodeAgent(f"tcp://127.0.0.1:{driver.port}")
+    runner = threading.Thread(target=agent.run, daemon=True)
+    runner.start()
+    try:
+        # without the RAY_TPU_DRIVER_SILENCE_S watchdog the agent sits
+        # in recv() forever (TCP never errors a half-open read) and no
+        # second registration can ever arrive
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline \
+                and len(driver.registrations) < 2:
+            time.sleep(0.05)
+        assert len(driver.registrations) >= 2, (
+            "agent never re-registered after driver silence "
+            f"(heartbeats sent into the void: {driver.heartbeats})")
+        assert driver.registrations[0]["incarnation"] == 0
+        assert driver.registrations[1]["incarnation"] == 1
+        # the agent really was heartbeating the whole time — silence
+        # detection fired despite healthy OUTBOUND traffic
+        assert driver.heartbeats >= 2
+    finally:
+        driver.close()
+        runner.join(timeout=15)   # rejoin window expires -> cleanup

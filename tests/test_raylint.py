@@ -1,0 +1,675 @@
+"""tools/raylint test suite.
+
+Three layers:
+  * fixture snippets per check — a known-violation and a known-clean
+    body for each of RT001-RT005, proving every check FIRES (running
+    the gate with a check disabled would fail these);
+  * the suppression mechanisms — trailing, line-above (with wrapped
+    reasons), file-wide, and the RT000 teeth (missing reason, unknown
+    code, unused disable);
+  * the zero-unsuppressed-findings GATE over the real `ray_tpu/` tree,
+    bounded < 30s, plus the shrink-only-baseline-at-zero policy and
+    the docs/CONFIG.md <-> knobs-registry sync check.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.raylint import (ALL_CHECKS, BASELINE_DEFAULT, Project,
+                           check_by_code, load_baseline, run_paths,
+                           run_source)
+from tools.raylint.engine import FileUnit, run_units, save_baseline
+
+REPO = Path(__file__).resolve().parent.parent
+
+_PROJECT = Project(
+    event_names={"task.submit", "task.finish"},
+    metric_names={"ray_tpu_ok_total"},
+    knob_names={"RAY_TPU_DECLARED"})
+
+
+def _run(src: str, codes, rel: str = "ray_tpu/core/fixture.py"):
+    checks = [check_by_code(c) for c in codes]
+    return run_source(textwrap.dedent(src), rel, checks,
+                      project=_PROJECT)
+
+
+def _active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def _codes(findings):
+    return sorted({f.code for f in _active(findings)})
+
+
+# ---------------------------------------------------------------------------
+# RT001 blocking-call-under-lock
+
+
+RT001_VIOLATION = """
+    import threading
+    import time
+
+    class Controller:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad_sleep(self):
+            with self._lock:
+                time.sleep(1.0)
+
+        def bad_round_trip(self, ray_tpu, ref):
+            with self._lock:
+                return ray_tpu.get(ref)
+
+        def bad_wire_write(self):
+            with self._lock:
+                self.conn.send(("msg",))
+
+        def bad_socket(self, sock):
+            with self._lock:
+                return sock.recv(4)
+
+        def bad_queue(self):
+            with self._lock:
+                self.inbox.get()
+"""
+
+
+def test_rt001_fires_on_blocking_under_lock():
+    findings = _run(RT001_VIOLATION, ["RT001"])
+    assert len(_active(findings)) == 5
+    assert _codes(findings) == ["RT001"]
+    lines = {f.context for f in findings}
+    assert lines == {"Controller.bad_sleep", "Controller.bad_round_trip",
+                     "Controller.bad_wire_write", "Controller.bad_socket",
+                     "Controller.bad_queue"}
+
+
+def test_rt001_clean_patterns_pass():
+    findings = _run("""
+        import threading
+        import time
+
+        class Controller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def fine_outside(self, ray_tpu, ref):
+                with self._lock:
+                    snapshot = list(self.items)
+                return ray_tpu.get(ref)       # after release
+
+            def fine_poll(self, ray_tpu, refs):
+                with self._lock:
+                    ready, _ = ray_tpu.wait(refs, timeout=0)
+                    return ready
+
+            def fine_cv_wait(self):
+                with self._cv:
+                    self._cv.wait(timeout=1)  # releases its own lock
+
+            def fine_bounded_queue(self):
+                with self._lock:
+                    self.inbox.put("x", timeout=1)
+
+            def later(self):
+                time.sleep(1)                 # no lock held
+    """, ["RT001"])
+    assert _active(findings) == []
+
+
+def test_rt001_scoped_to_control_plane():
+    findings = _run(RT001_VIOLATION, ["RT001"],
+                    rel="ray_tpu/ops/fixture.py")
+    assert _active(findings) == []
+
+
+def test_rt001_nested_def_resets_lock_context():
+    findings = _run("""
+        import threading
+        _lock = threading.Lock()
+
+        def outer():
+            with _lock:
+                def callback():
+                    import time
+                    time.sleep(1)   # runs later, not under the lock
+                return callback
+    """, ["RT001"])
+    assert _active(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RT002 lock-order-inversion
+
+
+RT002_INVERSION = """
+    import threading
+
+    class TwoLocks:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_rt002_fires_on_inversion():
+    findings = _run(RT002_INVERSION, ["RT002"])
+    assert len(_active(findings)) == 1
+    assert "inversion" in findings[0].message
+
+
+def test_rt002_fires_on_self_reacquire():
+    findings = _run("""
+        import threading
+
+        class Reentrant:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, ["RT002"])
+    assert len(_active(findings)) == 1
+    assert "not reentrant" in findings[0].message
+
+
+def test_rt002_fires_on_interprocedural_reentry():
+    # the PR 8 batcher-flush shape: flush() holds the send lock and a
+    # helper it calls re-enters flush() -> same-lock self-deadlock
+    findings = _run("""
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._send_lock = threading.Lock()
+
+            def flush(self):
+                with self._send_lock:
+                    self._publish()
+
+            def _publish(self):
+                self.flush()
+    """, ["RT002"])
+    assert len(_active(findings)) == 1
+    assert "re-enters" in findings[0].message
+
+
+def test_rt002_clean_patterns_pass():
+    findings = _run("""
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._r = threading.RLock()
+
+            def ab1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ab2(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def reentrant_ok(self):
+                with self._r:
+                    with self._r:
+                        pass
+    """, ["RT002"])
+    assert _active(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RT003 unbounded-blocking-primitive
+
+
+RT003_VIOLATION = """
+    class Loop:
+        def run(self):
+            while True:
+                self._ev.wait()
+
+        def pump(self):
+            while True:
+                item = self.inbox.get()
+
+        def read(self, sock):
+            while True:
+                data = sock.recv(4096)
+"""
+
+
+def test_rt003_fires_on_unbounded_primitives():
+    findings = _run(RT003_VIOLATION, ["RT003"])
+    assert len(_active(findings)) == 3
+    assert _codes(findings) == ["RT003"]
+
+
+def test_rt003_clean_patterns_pass():
+    findings = _run("""
+        class Loop:
+            def run(self):
+                while True:
+                    if self._ev.wait(timeout=1.0):
+                        return
+
+            def pump(self):
+                while True:
+                    item = self.inbox.get(timeout=0.5)
+
+            def read(self, sock):
+                sock.settimeout(5.0)
+                while True:
+                    data = sock.recv(4096)
+
+            def once(self):
+                self._ev.wait()     # not in a loop: out of scope
+    """, ["RT003"])
+    assert _active(findings) == []
+
+
+def test_rt003_async_functions_exempt():
+    findings = _run("""
+        class AsyncLoop:
+            async def run(self):
+                while True:
+                    item = await self._queue.get()
+    """, ["RT003"])
+    assert _active(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# RT004 uncataloged-telemetry
+
+
+def test_rt004_fires_on_unknown_event_and_metric():
+    findings = _run("""
+        from ..util import events as events_mod
+        from ..util import metrics_catalog as mcat
+
+        def report():
+            events_mod.emit("task.submitt", "typo'd event")
+            mcat.get("ray_tpu_oops_total").inc()
+    """, ["RT004"])
+    assert len(_active(findings)) == 2
+    assert "task.submitt" in findings[0].message
+    assert "ray_tpu_oops_total" in findings[1].message
+
+
+def test_rt004_cataloged_and_dynamic_names_pass():
+    findings = _run("""
+        from ..util import events as events_mod
+        from ..util import metrics_catalog as mcat
+
+        def report(etype):
+            events_mod.emit("task.submit", "fine")
+            events_mod.emit_safe("task.finish", "fine")
+            events_mod.emit(etype, "wrapper forwarding a variable")
+            mcat.get("ray_tpu_ok_total").inc()
+            emit(payload)          # SSE writer etc: not an event call
+    """, ["RT004"])
+    assert _active(findings) == []
+
+
+def test_rt004_flags_builtin_metric_constructed_outside_catalog():
+    findings = _run("""
+        from ..util import metrics as metrics_mod
+
+        def make():
+            return metrics_mod.Counter("ray_tpu_rogue_total", "h")
+    """, ["RT004"])
+    assert len(_active(findings)) == 1
+    assert "outside the catalog" in findings[0].message
+
+
+def test_rt004_resolves_real_catalogs_by_parsing():
+    project = Project.discover([REPO / "ray_tpu"])
+    assert project.event_names and "task.submit" in project.event_names
+    assert project.metric_names \
+        and "ray_tpu_tasks_submitted_total" in project.metric_names
+    assert project.knob_names \
+        and "RAY_TPU_LEASE_SLOTS" in project.knob_names
+
+
+# ---------------------------------------------------------------------------
+# RT005 undeclared-env-knob
+
+
+def test_rt005_fires_on_bare_env_reads():
+    findings = _run("""
+        import os
+
+        ENV_NAME = "RAY_TPU_VIA_CONSTANT"
+
+        def read():
+            a = os.environ.get("RAY_TPU_SOMETHING", "1")
+            b = os.environ["RAY_TPU_OTHER"]
+            c = os.getenv("RAY_TPU_THIRD")
+            d = os.environ.get(ENV_NAME, "0")
+            return a, b, c, d
+    """, ["RT005"])
+    assert len(_active(findings)) == 4
+    assert any("RAY_TPU_VIA_CONSTANT" in f.message for f in findings)
+
+
+def test_rt005_fires_on_undeclared_knob_getter():
+    findings = _run("""
+        from ..util import knobs
+
+        def read():
+            return knobs.get_float("RAY_TPU_NOT_DECLARED")
+    """, ["RT005"])
+    assert len(_active(findings)) == 1
+    assert "not declared" in findings[0].message
+
+
+def test_rt005_clean_patterns_pass():
+    findings = _run("""
+        import os
+        from ..util import knobs
+
+        def read():
+            ok = knobs.get_int("RAY_TPU_DECLARED")
+            other = os.environ.get("XLA_FLAGS", "")   # not ours
+            return ok, other
+
+        def wire(env):
+            env["RAY_TPU_DECLARED"] = "1"             # write, not read
+            os.environ.pop("RAY_TPU_DECLARED", None)  # cleanup
+    """, ["RT005"])
+    assert _active(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanisms
+
+
+def test_trailing_suppression_with_reason():
+    findings = _run("""
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)  # raylint: disable=RT001 fixture reason
+    """, ["RT001"])
+    assert _active(findings) == []
+    assert len(findings) == 1 and findings[0].suppressed
+    assert findings[0].suppress_reason == "fixture reason"
+
+
+def test_line_above_suppression_with_wrapped_reason():
+    findings = _run("""
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                # raylint: disable=RT001 a long reason that needs to
+                # wrap across plain comment lines before the code
+                time.sleep(1)
+    """, ["RT001"])
+    assert _active(findings) == []
+    assert findings[0].suppressed
+
+
+def test_file_wide_suppression():
+    findings = _run("""
+        # raylint: disable-file=RT001 whole fixture is exempt
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)
+
+        def g():
+            with _lock:
+                time.sleep(2)
+    """, ["RT001"])
+    assert _active(findings) == []
+    assert len([f for f in findings if f.suppressed]) == 2
+
+
+def test_suppression_without_reason_is_rt000():
+    findings = _run("""
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)  # raylint: disable=RT001
+    """, ["RT001"])
+    active = _active(findings)
+    # the disable is malformed: the RT001 stays AND RT000 reports it
+    assert {f.code for f in active} == {"RT000", "RT001"}
+    assert any("no reason" in f.message for f in active)
+
+
+def test_suppression_of_bad_code_is_rt000():
+    findings = _run("""
+        x = 1  # raylint: disable=RTX bogus code
+    """, ["RT001"])
+    assert [f.code for f in _active(findings)] == ["RT000"]
+
+
+def test_unused_suppression_is_rt000():
+    findings = _run("""
+        x = 1  # raylint: disable=RT001 nothing here to silence
+    """, ["RT001"])
+    assert [f.code for f in _active(findings)] == ["RT000"]
+    assert "unused" in findings[0].message
+
+
+def test_suppression_only_covers_named_checks():
+    findings = _run("""
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            while True:
+                with _lock:
+                    # raylint: disable=RT003 wrong code for this site
+                    time.sleep(1)
+    """, ["RT001"])
+    # RT001 not named -> stays active; the RT003 disable is unused
+    assert {f.code for f in _active(findings)} == {"RT000", "RT001"}
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+
+
+def test_baseline_grandfathers_then_shrinks(tmp_path):
+    src = textwrap.dedent("""
+        import threading
+        import time
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)
+    """)
+    unit = FileUnit("ray_tpu/core/fixture.py", src)
+    check = check_by_code("RT001")
+    report = run_units([unit], [check], _PROJECT)
+    assert len(report.active) == 1
+
+    path = tmp_path / "baseline.json"
+    save_baseline(path, report.active)
+    baseline = load_baseline(path)
+    assert len(baseline) == 1
+
+    unit2 = FileUnit("ray_tpu/core/fixture.py", src)
+    report2 = run_units([unit2], [check], _PROJECT, baseline=baseline)
+    assert report2.active == [] and len(report2.baselined) == 1
+
+    # fixing the site makes the entry STALE — reported, never silent
+    unit3 = FileUnit("ray_tpu/core/fixture.py",
+                     src.replace("time.sleep(1)", "pass"))
+    report3 = run_units([unit3], [check], _PROJECT, baseline=baseline)
+    assert report3.active == [] and report3.stale_baseline
+
+
+def test_checked_in_baseline_is_at_zero():
+    """The shrink-only baseline landed at zero and must stay there:
+    new findings are fixed or inline-suppressed with a reason, never
+    grandfathered."""
+    assert load_baseline(BASELINE_DEFAULT) == {}
+
+
+# ---------------------------------------------------------------------------
+# the gate: zero unsuppressed findings over the real package, < 30s
+
+
+def test_gate_zero_unsuppressed_findings_under_30s():
+    report = run_paths([REPO / "ray_tpu"], ALL_CHECKS,
+                       baseline_path=BASELINE_DEFAULT)
+    assert report.files_scanned > 100
+    assert report.parse_errors == []
+    assert report.stale_baseline == []
+    assert report.active == [], "\n" + "\n".join(
+        f.render() for f in report.active)
+    # the suppressions that exist are all reasoned (engine enforces,
+    # but assert the invariant end-to-end)
+    assert all(f.suppress_reason for f in report.suppressed)
+    assert report.duration_s < 30, report.duration_s
+
+
+def test_gate_would_fail_if_a_check_were_disabled():
+    """Every check contributes live coverage: each one fires on its
+    violation fixture (so deleting/disabling a check breaks this
+    suite, not just the fixture tests above)."""
+    fixtures = {
+        "RT001": RT001_VIOLATION,
+        "RT002": RT002_INVERSION,
+        "RT003": RT003_VIOLATION,
+        "RT004": 'events_mod.emit("no.such_event", "x")\n',
+        "RT005": 'import os\nv = os.environ.get("RAY_TPU_X")\n',
+    }
+    for code, src in fixtures.items():
+        findings = _run(src, [code])
+        assert _active(findings), f"{code} did not fire on its fixture"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.raylint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_json_report_on_violation(tmp_path):
+    # shape the tmp dir like the package so path scoping engages
+    pkg = tmp_path / "ray_tpu" / "core"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ray_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    bad = pkg / "fixture.py"
+    bad.write_text("import os\nv = os.environ.get('RAY_TPU_X')\n")
+    proc = _cli(str(bad), "-o", "json", "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["active"] == 1
+    f = payload["findings"][0]
+    assert f["code"] == "RT005" and f["line"] == 2
+    assert f["fingerprint"]
+
+
+def test_cli_clean_exit_zero(tmp_path):
+    good = tmp_path / "fixture.py"
+    good.write_text("x = 1\n")
+    proc = _cli(str(good), "-o", "json", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_checks_names_all_five():
+    proc = _cli("--list-checks")
+    assert proc.returncode == 0
+    for code in ("RT001", "RT002", "RT003", "RT004", "RT005"):
+        assert code in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# knobs registry + docs/CONFIG.md sync
+
+
+def test_knobs_typed_getters(monkeypatch):
+    from ray_tpu.util import knobs
+    monkeypatch.delenv("RAY_TPU_LEASE_SLOTS", raising=False)
+    assert knobs.get_int("RAY_TPU_LEASE_SLOTS") == 32
+    monkeypatch.setenv("RAY_TPU_LEASE_SLOTS", "64")
+    assert knobs.get_int("RAY_TPU_LEASE_SLOTS") == 64   # call-time read
+    monkeypatch.setenv("RAY_TPU_LEASE_SLOTS", "garbage")
+    assert knobs.get_int("RAY_TPU_LEASE_SLOTS") == 32   # malformed
+    monkeypatch.setenv("RAY_TPU_LEASE_SLOTS", "")
+    assert knobs.get_int("RAY_TPU_LEASE_SLOTS") == 32   # empty = unset
+
+    monkeypatch.setenv("RAY_TPU_BATCH", "0")
+    assert knobs.get_bool("RAY_TPU_BATCH") is False
+    monkeypatch.setenv("RAY_TPU_BATCH", "False")
+    assert knobs.get_bool("RAY_TPU_BATCH") is False
+    monkeypatch.setenv("RAY_TPU_BATCH", "1")
+    assert knobs.get_bool("RAY_TPU_BATCH") is True
+
+    # site override for dynamic defaults
+    monkeypatch.delenv("RAY_TPU_STORE_BYTES", raising=False)
+    assert knobs.get_int("RAY_TPU_STORE_BYTES",
+                         default=2 << 30) == 2 << 30
+
+    with pytest.raises(KeyError):
+        knobs.get_int("RAY_TPU_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        knobs.get_raw("RAY_TPU_NOT_A_KNOB")
+
+
+def test_every_knob_has_type_default_and_doc():
+    from ray_tpu.util import knobs
+    assert len(knobs.REGISTRY) >= 70
+    for name, k in knobs.REGISTRY.items():
+        assert name.startswith("RAY_TPU_")
+        assert k.type in ("int", "float", "bool", "str")
+        assert k.doc and len(k.doc) > 10, name
+        assert k.subsystem, name
+
+
+def test_config_md_in_sync_with_registry():
+    """docs/CONFIG.md is generated — regenerate and compare, so a knob
+    added without `python -m ray_tpu.util.knobs > docs/CONFIG.md`
+    fails tier-1."""
+    from ray_tpu.util import knobs
+    on_disk = (REPO / "docs" / "CONFIG.md").read_text()
+    assert on_disk == knobs.render_markdown()

@@ -1,0 +1,48 @@
+"""raylint — ray_tpu's concurrency- and invariant-aware static analysis.
+
+The control plane is a dense web of locks, threads, sockets, and actor
+round trips, and the costliest bugs of PRs 7-11 were all instances of a
+few *statically detectable* classes: a blocking driver round trip held
+under the controller lock, a batcher flush that re-entered its own
+non-reentrant send lock, timeout-less collective polls that starved a
+gang. raylint encodes those learned invariants as named checks over the
+stdlib `ast` (no third-party deps, no imports of the analyzed code) and
+runs as a tier-1 test plus a CLI:
+
+    python -m tools.raylint ray_tpu            # gate: exit 1 on findings
+    python -m tools.raylint ray_tpu -o json    # machine-readable report
+    ray_tpu lint                               # same, via the package CLI
+
+Checks (docs/STATIC_ANALYSIS.md has the motivating bug for each):
+
+    RT001  blocking-call-under-lock       core/serve/train control plane
+    RT002  lock-order-inversion           whole package
+    RT003  unbounded-blocking-primitive   loops in the control plane
+    RT004  uncataloged-telemetry          whole package
+    RT005  undeclared-env-knob            whole package
+
+Findings are suppressed inline with a mandatory reason —
+
+    do_thing()  # raylint: disable=RT001 <why this site is safe>
+
+or, for a finding whose line has no room, on the line directly above:
+
+    # raylint: disable=RT001 <why this site is safe>
+    do_thing()
+
+plus `# raylint: disable-file=RT001 <reason>` for a whole file. A
+shrink-only baseline (tools/raylint/baseline.json) exists for
+grandfathered sites; it is kept at zero entries.
+"""
+from __future__ import annotations
+
+from .engine import (BASELINE_DEFAULT, Finding, Project, load_baseline,
+                     run_paths, run_source)
+from .checks import ALL_CHECKS, check_by_code
+
+VERSION = "1.0"
+
+__all__ = [
+    "ALL_CHECKS", "BASELINE_DEFAULT", "Finding", "Project", "VERSION",
+    "check_by_code", "load_baseline", "run_paths", "run_source",
+]

@@ -1,0 +1,120 @@
+"""raylint CLI.
+
+    python -m tools.raylint [paths ...]          # default: ray_tpu
+    python -m tools.raylint ray_tpu -o json      # machine-readable
+    python -m tools.raylint --list-checks
+    python -m tools.raylint --write-baseline     # (shrink-only; avoid)
+
+Exit status: 0 clean, 1 active findings (or stale baseline entries),
+2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from . import VERSION
+from .checks import ALL_CHECKS, select_checks
+from .engine import BASELINE_DEFAULT, run_paths, save_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raylint",
+        description="ray_tpu concurrency/invariant static analysis")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: ray_tpu)")
+    ap.add_argument("-o", "--output", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma list of check codes to run")
+    ap.add_argument("--disable", default=None,
+                    help="comma list of check codes to skip")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default {BASELINE_DEFAULT})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to the current "
+                         "unsuppressed findings (shrink-only policy: "
+                         "only do this to REMOVE entries)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed/baselined findings")
+    ap.add_argument("--statistics", action="store_true",
+                    help="print per-check counts")
+    ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--version", action="version",
+                    version=f"raylint {VERSION}")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKS:
+            print(f"{c.code}  {c.name}\n    {c.summary}")
+        return 0
+
+    try:
+        checks = select_checks(
+            args.select.split(",") if args.select else None,
+            args.disable.split(",") if args.disable else None)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or ["ray_tpu"]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"error: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = Path(args.baseline) if args.baseline \
+            else BASELINE_DEFAULT
+
+    report = run_paths(paths, checks, baseline_path=baseline_path)
+
+    if args.write_baseline:
+        target = baseline_path or BASELINE_DEFAULT
+        save_baseline(target, [f for f in report.findings
+                               if not f.suppressed])
+        print(f"baseline written: {target} "
+              f"({len([f for f in report.findings if not f.suppressed])}"
+              " entries)")
+        return 0
+
+    if args.output == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+        return 1 if (report.active or report.stale_baseline
+                     or report.parse_errors) else 0
+
+    for f in report.active:
+        print(f.render())
+    if args.show_suppressed:
+        for f in report.suppressed:
+            print(f"[suppressed: {f.suppress_reason}] {f.render()}")
+        for f in report.baselined:
+            print(f"[baselined] {f.render()}")
+    for err in report.parse_errors:
+        print(f"parse error: {err}")
+    for fp in report.stale_baseline:
+        print(f"stale baseline entry {fp}: the finding it grandfathered "
+              "is gone — remove it (shrink-only baseline)")
+    if args.statistics:
+        counts = Counter(f.code for f in report.active)
+        for code in sorted(counts):
+            print(f"{code}: {counts[code]}")
+    n = len(report.active)
+    print(f"raylint: {report.files_scanned} files, {n} finding"
+          f"{'s' if n != 1 else ''} "
+          f"({len(report.suppressed)} suppressed, "
+          f"{len(report.baselined)} baselined) "
+          f"in {report.duration_s:.2f}s")
+    return 1 if (report.active or report.stale_baseline
+                 or report.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

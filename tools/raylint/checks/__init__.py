@@ -1,0 +1,47 @@
+"""Check registry. Adding a check: implement the Check protocol
+(`code`, `name`, `summary`, `applies(rel)`, `run(unit, project)`) in a
+module here, append an instance to ALL_CHECKS, document it in
+docs/STATIC_ANALYSIS.md, and add violation + clean fixtures to
+tests/test_raylint.py."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .blocking import RT003UnboundedBlocking
+from .knobs import RT005UndeclaredEnvKnob
+from .locks import RT001BlockingUnderLock, RT002LockOrderInversion
+from .telemetry import RT004UncatalogedTelemetry
+
+ALL_CHECKS = [
+    RT001BlockingUnderLock(),
+    RT002LockOrderInversion(),
+    RT003UnboundedBlocking(),
+    RT004UncatalogedTelemetry(),
+    RT005UndeclaredEnvKnob(),
+]
+
+
+def check_by_code(code: str):
+    for c in ALL_CHECKS:
+        if c.code == code.upper():
+            return c
+    raise KeyError(f"unknown check {code!r}; known: "
+                   + ", ".join(c.code for c in ALL_CHECKS))
+
+
+def select_checks(select: Optional[Sequence[str]] = None,
+                  disable: Optional[Sequence[str]] = None) -> List:
+    checks = list(ALL_CHECKS)
+    if select:
+        wanted = {c.upper() for c in select}
+        unknown = wanted - {c.code for c in ALL_CHECKS}
+        if unknown:
+            raise KeyError(f"unknown check(s): {sorted(unknown)}")
+        checks = [c for c in checks if c.code in wanted]
+    if disable:
+        off = {c.upper() for c in disable}
+        unknown = off - {c.code for c in ALL_CHECKS}
+        if unknown:
+            raise KeyError(f"unknown check(s): {sorted(unknown)}")
+        checks = [c for c in checks if c.code not in off]
+    return checks

@@ -1,0 +1,163 @@
+"""RT003 unbounded-blocking-primitive.
+
+The PR 11 gang-starvation class: a dispatcher/worker/supervisor loop
+parked on a primitive with no timeout can never notice that the peer it
+waits for is dead — the thread survives every death determination,
+holds its resources, and the failure surfaces minutes later (or never)
+as a wedged loop instead of a typed error. Inside `while` loops in
+control-plane modules raylint flags:
+
+  * `ev.wait()` with no timeout — a dead setter parks the loop forever;
+  * `q.get()` with no timeout on a queue-ish receiver — a dead
+    producer parks the loop forever (`put` is not flagged here: the
+    control-plane inboxes are unbounded, so puts cannot park; a put
+    under a LOCK is RT001's business);
+  * `sock.recv(...)` / `read_frame(sock)` in a function that never
+    arms `settimeout` — a half-open TCP peer (the classic silent
+    preemption) blocks the read loop indefinitely.
+
+`async def` bodies are exempt: awaited queue gets park a task, not a
+thread, and asyncio primitives take no timeout kwarg (`wait_for` is
+the bounding idiom there). Shutdown-path waits (a joining thread known
+to exit) are the common legitimate exception — suppress those inline
+with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import FileUnit, Finding, Project
+from .common import call_attr, dotted, has_kwarg, receiver, terminal_name
+
+_QUEUE_HINT = ("queue", "inbox", "outbox", "mailbox")
+_SOCK_HINT = ("sock", "conn")
+_RECV_FUNCS = {"read_exact", "read_frame", "read_obj"}
+
+
+def _is_queueish(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    t = terminal_name(node).lower()
+    return (t == "q" or t.endswith("_q")
+            or any(h in t for h in _QUEUE_HINT))
+
+
+def _is_sockish(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    t = terminal_name(node).lower()
+    return any(h in t for h in _SOCK_HINT)
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    if has_kwarg(call, "timeout"):
+        return True
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    for a in call.args:
+        if isinstance(a, ast.Constant) and a.value is False:
+            return True
+    return False
+
+
+class RT003UnboundedBlocking:
+    code = "RT003"
+    name = "unbounded-blocking-primitive"
+    summary = ("Event.wait(), queue get/put, and socket reads inside "
+               "control-plane `while` loops must carry a timeout")
+    prefixes = ("ray_tpu/core/", "ray_tpu/serve/", "ray_tpu/train/",
+                "ray_tpu/util/", "ray_tpu/data/",
+                "ray_tpu/observability/")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.prefixes)
+
+    def run(self, unit: FileUnit, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+
+        # functions that arm a socket timeout anywhere are exempt from
+        # the recv rule — their reads are already bounded
+        def has_settimeout(fn: ast.AST) -> bool:
+            return any(isinstance(n, ast.Call)
+                       and call_attr(n) == "settimeout"
+                       for n in ast.walk(fn))
+
+        def scan_fn(fn, ctx: str):
+            bounded_reads = has_settimeout(fn)
+            seen = set()
+            # own-body While loops only; nested defs scan on their own
+            stack = list(fn.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(node, ast.While):
+                    for call in self._loop_calls(node):
+                        if id(call) in seen:
+                            continue
+                        seen.add(id(call))
+                        f = self._flag(call, bounded_reads)
+                        if f:
+                            out.append(Finding(
+                                code=self.code, message=f,
+                                path=unit.rel, line=call.lineno,
+                                col=call.col_offset, context=ctx,
+                                snippet=unit.line_text(call.lineno)))
+                stack.extend(ast.iter_child_nodes(node))
+
+        def walk(body, cls_name):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    walk(node.body, node.name)
+                elif isinstance(node, ast.AsyncFunctionDef):
+                    walk(node.body, cls_name)   # exempt (see moduledoc)
+                elif isinstance(node, ast.FunctionDef):
+                    ctx = (f"{cls_name}.{node.name}" if cls_name
+                           else node.name)
+                    scan_fn(node, ctx)
+                    walk(node.body, cls_name)
+
+        walk(unit.tree.body, None)
+        return out
+
+    @staticmethod
+    def _loop_calls(loop: ast.While):
+        """Calls inside the loop body, not descending into nested
+        function definitions (they run elsewhere)."""
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _flag(call: ast.Call, bounded_reads: bool) -> Optional[str]:
+        attr = call_attr(call)
+        recv = receiver(call)
+        if attr == "wait" and not call.args \
+                and not has_kwarg(call, "timeout") and recv is not None:
+            return (f"{dotted(call.func)}() with no timeout in a loop "
+                    "— a dead setter parks this thread forever")
+        if attr == "get" and _is_queueish(recv) \
+                and not _nonblocking(call):
+            return (f"timeout-less {dotted(call.func)}() in a loop — "
+                    "a dead producer parks this thread forever")
+        if not bounded_reads:
+            if attr in ("recv", "recv_into") and _is_sockish(recv):
+                return (f"{dotted(call.func)}() in a loop with no "
+                        "settimeout anywhere in this function — a "
+                        "half-open peer blocks the read forever")
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in _RECV_FUNCS:
+                return (f"{call.func.id}() in a loop with no "
+                        "settimeout anywhere in this function — a "
+                        "half-open peer blocks the read forever")
+        return None

@@ -1,0 +1,185 @@
+"""Shared AST helpers: name resolution and the per-module lock model.
+
+Lock identity is textual, not aliasing-aware — `self._lock` in class C
+is the lock "C._lock" wherever it appears in the module. That is
+exactly the right granularity for the bug classes raylint encodes
+(every historical deadlock was a same-class or same-module lock pair),
+and it keeps the analysis a single parse with no imports.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# threading factories whose instances guard `with` bodies
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# names that read as locks even without a visible declaration (locks
+# received as arguments, aliased, or declared in another module)
+LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|mu|cv|cond)s?$", re.I)
+
+
+def terminal_name(node: ast.AST) -> str:
+    """Last dotted component: `self._runtime._lock` -> "_lock"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return terminal_name(node.value)
+    if isinstance(node, ast.Call):
+        return terminal_name(node.func)
+    return ""
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort source-ish spelling of an expression."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Subscript):
+        return f"{dotted(node.value)}[·]"
+    if isinstance(node, ast.Call):
+        return f"{dotted(node.func)}()"
+    return type(node).__name__
+
+
+def call_attr(call: ast.Call) -> str:
+    """Method name of an attribute call, "" otherwise."""
+    return call.func.attr if isinstance(call.func, ast.Attribute) else ""
+
+
+def receiver(call: ast.Call) -> Optional[ast.AST]:
+    return call.func.value if isinstance(call.func, ast.Attribute) else None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def _is_lock_factory(value: ast.AST) -> Optional[str]:
+    """ "Lock"/"RLock"/"Condition" when `value` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name if name in LOCK_FACTORIES else None
+
+
+@dataclass
+class LockModel:
+    """Declared locks of one module, keyed by canonical id."""
+    # "Class.attr" / "<module>.name" -> "Lock" | "RLock" | "Condition"
+    declared: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, tree: ast.AST) -> "LockModel":
+        model = cls()
+
+        def visit(node: ast.AST, cls_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    kind = _is_lock_factory(child.value) \
+                        if child.value else None
+                    if kind:
+                        targets = child.targets if isinstance(
+                            child, ast.Assign) else [child.target]
+                        for t in targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self" and cls_name:
+                                model.declared[
+                                    f"{cls_name}.{t.attr}"] = kind
+                            elif isinstance(t, ast.Name):
+                                scope = cls_name or "<module>"
+                                model.declared[f"{scope}.{t.id}"] = kind
+                visit(child, cls_name)
+
+        visit(tree, None)
+        return model
+
+    def lock_id(self, expr: ast.AST, cls_name: Optional[str]) -> str:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls_name:
+            return f"{cls_name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            # method-local references resolve to the class declaration
+            # first (with cv := self._cv patterns), else module scope
+            if cls_name and f"{cls_name}.{expr.id}" in self.declared:
+                return f"{cls_name}.{expr.id}"
+            return f"<module>.{expr.id}"
+        return dotted(expr)
+
+    def kind_of(self, lock_id: str) -> Optional[str]:
+        return self.declared.get(lock_id)
+
+    def is_lock_expr(self, expr: ast.AST,
+                     cls_name: Optional[str]) -> bool:
+        if self.lock_id(expr, cls_name) in self.declared:
+            return True
+        return bool(LOCK_NAME_RE.search(terminal_name(expr)))
+
+
+@dataclass
+class HeldLock:
+    lock_id: str
+    node: ast.AST
+
+
+class LockWalker:
+    """Walks a module tracking the stack of held locks.
+
+    Yields (call, held, cls_name, func_name) for every Call site.
+    Nested function/class definitions reset the held stack — their
+    bodies execute later, not under the enclosing `with`.
+    """
+
+    def __init__(self, tree: ast.AST, model: LockModel):
+        self.tree = tree
+        self.model = model
+
+    def walk(self) -> Iterator[Tuple[ast.Call, List[HeldLock],
+                                     Optional[str], str]]:
+        yield from self._walk_body(self.tree.body, [], None, "<module>")
+
+    def _walk_body(self, body, held, cls_name, func_name):
+        for node in body:
+            yield from self._walk_node(node, held, cls_name, func_name)
+
+    def _walk_node(self, node, held, cls_name, func_name):
+        if isinstance(node, ast.ClassDef):
+            yield from self._walk_body(node.body, [], node.name,
+                                       func_name)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from self._walk_body(node.body, [], cls_name,
+                                       node.name)
+            return
+        if isinstance(node, ast.Lambda):
+            yield from self._walk_node(node.body, [], cls_name,
+                                       func_name)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[HeldLock] = []
+            for item in node.items:
+                yield from self._walk_node(item.context_expr, held,
+                                           cls_name, func_name)
+                expr = item.context_expr
+                if self.model.is_lock_expr(expr, cls_name):
+                    acquired.append(HeldLock(
+                        self.model.lock_id(expr, cls_name), node))
+            yield from self._walk_body(node.body, held + acquired,
+                                       cls_name, func_name)
+            return
+        if isinstance(node, ast.Call):
+            yield node, list(held), cls_name, func_name
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk_node(child, held, cls_name, func_name)

@@ -1,0 +1,132 @@
+"""RT005 undeclared-env-knob.
+
+71 `RAY_TPU_*` environment knobs existed before this check with no
+single place declaring their default, type, or meaning — a knob could
+be misspelled at a read site (silently inert), read with different
+defaults in different files (RAY_TPU_STORE_BYTES was), or shipped
+undocumented. Every `RAY_TPU_*` environment read in the package must
+now go through `ray_tpu/util/knobs.py`: the registry declares default,
+type and doc string once, `docs/CONFIG.md` renders from it, and this
+check makes a bare `os.environ` read of a `RAY_TPU_*` key (or a
+`knobs.get_*` of an undeclared name) a finding.
+
+Writes (`os.environ[k] = v` wiring child processes) and pops are not
+reads and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..engine import FileUnit, Finding, Project
+from .common import dotted, terminal_name
+
+_PREFIX = "RAY_TPU_"
+_KNOB_GETTERS = {"get_raw", "get_str", "get_int", "get_float",
+                 "get_bool", "declared", "doc", "spec"}
+
+
+def _module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Top-level NAME = "RAY_TPU_..." bindings, so reads through a
+    module constant (train/elastic.py's ENV_PROBE_S style) resolve."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith(_PREFIX):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """`os.environ` or bare `environ`."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+class RT005UndeclaredEnvKnob:
+    code = "RT005"
+    name = "undeclared-env-knob"
+    summary = ("every RAY_TPU_* environment read goes through the "
+               "util/knobs.py registry (declared default, type, doc)")
+    prefixes = ("ray_tpu/",)
+    _EXEMPT = ("ray_tpu/util/knobs.py",)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.prefixes) and rel not in self._EXEMPT
+
+    def run(self, unit: FileUnit, project: Project) -> List[Finding]:
+        consts = _module_str_constants(unit.tree)
+        knob_names = project.knob_names
+        out: List[Finding] = []
+
+        def key_of(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value.startswith(_PREFIX):
+                return node.value
+            if isinstance(node, ast.Name) and node.id in consts:
+                return consts[node.id]
+            return None
+
+        for node in ast.walk(unit.tree):
+            # os.environ["RAY_TPU_X"] in Load context
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _is_environ(node.value):
+                key = key_of(node.slice)
+                if key:
+                    out.append(self._bare_read(unit, node, key))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else ""
+            # os.environ.get / os.environ.setdefault / os.getenv
+            is_env_get = (attr in ("get", "setdefault")
+                          and isinstance(fn, ast.Attribute)
+                          and _is_environ(fn.value))
+            is_getenv = ((attr == "getenv"
+                          and isinstance(fn, ast.Attribute)
+                          and isinstance(fn.value, ast.Name)
+                          and fn.value.id == "os")
+                         or (isinstance(fn, ast.Name)
+                             and fn.id == "getenv"))
+            if (is_env_get or is_getenv) and node.args:
+                key = key_of(node.args[0])
+                if key:
+                    out.append(self._bare_read(unit, node, key))
+                continue
+            # knobs.get_*("RAY_TPU_X") of an undeclared knob
+            if attr in _KNOB_GETTERS and isinstance(fn, ast.Attribute) \
+                    and terminal_name(fn.value) in ("knobs", "_knobs") \
+                    and node.args and knob_names is not None:
+                key = key_of(node.args[0])
+                if key and key not in knob_names:
+                    out.append(Finding(
+                        code=self.code,
+                        message=(f"knob {key!r} is not declared in "
+                                 "util/knobs.py — declare it (default, "
+                                 "type, doc) before reading it"),
+                        path=unit.rel, line=node.lineno,
+                        col=node.col_offset, context=dotted(fn),
+                        snippet=unit.line_text(node.lineno)))
+        return out
+
+    def _bare_read(self, unit: FileUnit, node: ast.AST,
+                   key: str) -> Finding:
+        return Finding(
+            code=self.code,
+            message=(f"bare environment read of {key!r} — go through "
+                     "util/knobs.py (knobs.get_int/get_float/get_bool/"
+                     "get_str) so the default, type and doc are "
+                     "declared once and docs/CONFIG.md stays true"),
+            path=unit.rel, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            snippet=unit.line_text(node.lineno))
+    # NOTE: membership tests (`"RAY_TPU_X" in os.environ`) are rare and
+    # read-only; they are intentionally not flagged.

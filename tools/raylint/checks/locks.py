@@ -1,0 +1,323 @@
+"""RT001 blocking-call-under-lock and RT002 lock-order-inversion.
+
+RT001 — the PR 7/8 deadlock class. A `with <lock>:` body in a
+control-plane module must not perform a blocking operation: a socket
+send/recv, a driver/actor round trip (`get`/`wait`), `time.sleep`, a
+timeout-less `queue.put/get`, or an `Event`/`Condition` wait on some
+OTHER primitive. Every one of these parks the thread while excluding
+everyone else from the lock — and when the blocked operation itself
+needs the lock to make progress (a completion handler, a batcher
+flush, a reconcile tick), the process wedges, which is exactly how the
+serve controller's autoscale round trip and the worker batcher's
+re-entrant flush died in PRs 7 and 8.
+
+RT002 — per-class/module lock-acquisition-order graph. Acquiring B
+while holding A adds the edge A->B; a cycle means two threads can each
+hold one lock of the pair and wait forever on the other. Includes one
+level of interprocedural propagation (a method called under lock A
+contributes the locks IT acquires), which is what catches the PR 8
+class: `flush()` under the send lock calling a helper that re-enters
+`flush()`. Re-acquiring a declared non-reentrant `threading.Lock`
+while already holding it is reported as a self-deadlock.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import FileUnit, Finding, Project
+from .common import (HeldLock, LockModel, LockWalker, call_attr, dotted,
+                     has_kwarg, receiver, terminal_name)
+
+# socket primitives that block regardless of receiver spelling
+_SOCKET_ATTRS = {"recv", "recv_into", "recvfrom", "accept",
+                 "sendall", "connect", "create_connection"}
+
+# receiver spellings that mark a .send()/.request() as a wire write
+_CONN_HINT = ("conn", "sock", "chan", "peer", "client")
+
+# receiver spellings that mark .get()/.wait() as a driver round trip
+_RUNTIME_NAMES = {"rt", "runtime", "ray", "ray_tpu"}
+
+_QUEUE_HINT = ("queue", "inbox", "outbox", "mailbox")
+
+
+def _is_queueish(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    t = terminal_name(node).lower()
+    return (t == "q" or t.endswith("_q")
+            or any(h in t for h in _QUEUE_HINT))
+
+
+def _is_connish(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    t = terminal_name(node).lower()
+    return any(h in t for h in _CONN_HINT)
+
+
+def _is_runtimeish(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    t = terminal_name(node)
+    return (t in _RUNTIME_NAMES or t.endswith("_runtime")
+            or t in ("get_runtime",))
+
+
+def _queue_nonblocking(call: ast.Call) -> bool:
+    """q.get(timeout=...), q.put(x, timeout=...), block=False, or a
+    positional False block flag never park forever."""
+    if has_kwarg(call, "timeout"):
+        return True
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    for a in call.args:
+        if isinstance(a, ast.Constant) and a.value is False:
+            return True
+    return False
+
+
+def _is_zero_timeout(call: ast.Call) -> bool:
+    """wait(refs, timeout=0) is a non-blocking poll, not a park."""
+    for kw in call.keywords:
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value in (0, 0.0):
+            return True
+    return False
+
+
+def blocking_reason(call: ast.Call, held: List[HeldLock],
+                    model: LockModel,
+                    cls_name: Optional[str]) -> Optional[str]:
+    """Why `call` blocks, or None. `held` is non-empty."""
+    attr = call_attr(call)
+    recv = receiver(call)
+    if attr == "sleep" and isinstance(recv, ast.Name) \
+            and recv.id == "time":
+        return "time.sleep() under lock"
+    if attr in _SOCKET_ATTRS:
+        return f"socket .{attr}() under lock"
+    if attr in ("send", "send_msg", "request") and _is_connish(recv):
+        return f"wire write {dotted(call.func)}() under lock"
+    if attr in ("get", "wait") and _is_runtimeish(recv) \
+            and not _is_zero_timeout(call):
+        return (f"driver round trip {dotted(call.func)}() under lock "
+                "(a completion that needs this lock can never land)")
+    if attr == "result":
+        return (f"blocking {dotted(call.func)}() under lock")
+    if attr in ("get", "put") and _is_queueish(recv) \
+            and not _queue_nonblocking(call):
+        return (f"timeout-less queue .{attr}() under lock")
+    if attr == "wait" and recv is not None \
+            and not _is_zero_timeout(call):
+        # cond.wait() under `with cond:` releases that condition — only
+        # flag when some OTHER lock stays held across the park
+        rid = model.lock_id(recv, cls_name)
+        others = [h.lock_id for h in held if h.lock_id != rid]
+        if others:
+            return (f"{dotted(call.func)}() parks while still holding "
+                    f"{others[-1]}")
+    return None
+
+
+class RT001BlockingUnderLock:
+    code = "RT001"
+    name = "blocking-call-under-lock"
+    summary = ("no socket send/recv, driver/actor round trip, "
+               "time.sleep, or timeout-less queue op inside a "
+               "`with <lock>:` body in control-plane modules")
+    prefixes = ("ray_tpu/core/", "ray_tpu/serve/", "ray_tpu/train/",
+                "ray_tpu/util/collective.py", "ray_tpu/util/events.py",
+                "ray_tpu/util/metrics.py", "ray_tpu/util/queue.py")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.prefixes)
+
+    def run(self, unit: FileUnit, project: Project) -> List[Finding]:
+        model = LockModel.build(unit.tree)
+        out: List[Finding] = []
+        for call, held, cls_name, func_name in LockWalker(
+                unit.tree, model).walk():
+            if not held:
+                continue
+            reason = blocking_reason(call, held, model, cls_name)
+            if reason is None:
+                continue
+            ctx = f"{cls_name}.{func_name}" if cls_name else func_name
+            out.append(Finding(
+                code=self.code,
+                message=f"{reason} (holding {held[-1].lock_id})",
+                path=unit.rel, line=call.lineno, col=call.col_offset,
+                context=ctx, snippet=unit.line_text(call.lineno)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RT002
+
+
+class RT002LockOrderInversion:
+    code = "RT002"
+    name = "lock-order-inversion"
+    summary = ("per-module lock acquisition graph must be acyclic; "
+               "re-acquiring a non-reentrant Lock is a self-deadlock")
+    prefixes = ("ray_tpu/",)
+
+    _DEPTH_CAP = 4
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.prefixes)
+
+    def run(self, unit: FileUnit, project: Project) -> List[Finding]:
+        model = LockModel.build(unit.tree)
+        # direct edges: with A: ... with B:   -> A->B at site
+        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        findings: List[Finding] = []
+
+        # method name -> set of lock ids it acquires anywhere (per class)
+        acquires: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        # every self-call per method (for the transitive closure) ...
+        self_calls: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        # ... and the subset made while holding locks (edge sources):
+        # (cls, caller) -> [(held_ids, callee_name, lineno, ctx)]
+        calls_under: Dict[Tuple[Optional[str], str], List] = {}
+
+        for call, held, cls_name, func_name in LockWalker(
+                unit.tree, model).walk():
+            key = (cls_name, func_name)
+            if isinstance(call.func, ast.Attribute) \
+                    and isinstance(call.func.value, ast.Name) \
+                    and call.func.value.id == "self":
+                self_calls.setdefault(key, set()).add(call.func.attr)
+                if held:
+                    calls_under.setdefault(key, []).append(
+                        ([h.lock_id for h in held], call.func.attr,
+                         call.lineno,
+                         f"{cls_name}.{func_name}" if cls_name
+                         else func_name))
+
+        # one pass over with-statements for direct edges + acquire sets
+        def scan(node, held_ids, cls_name, func_name):
+            if isinstance(node, ast.ClassDef):
+                for c in node.body:
+                    scan(c, [], node.name, func_name)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for c in node.body:
+                    scan(c, [], cls_name, node.name)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_ids = []
+                for item in node.items:
+                    expr = item.context_expr
+                    if model.is_lock_expr(expr, cls_name):
+                        lid = model.lock_id(expr, cls_name)
+                        ctx = (f"{cls_name}.{func_name}" if cls_name
+                               else func_name)
+                        acquires.setdefault(
+                            (cls_name, func_name), set()).add(lid)
+                        for h in held_ids:
+                            if h == lid and model.kind_of(lid) == "Lock":
+                                findings.append(Finding(
+                                    code=self.code,
+                                    message=(f"re-acquiring {lid} while "
+                                             "already holding it — "
+                                             "threading.Lock is not "
+                                             "reentrant; this thread "
+                                             "deadlocks itself"),
+                                    path=unit.rel, line=node.lineno,
+                                    context=ctx,
+                                    snippet=unit.line_text(node.lineno)))
+                            elif h != lid:
+                                edges.setdefault(
+                                    (h, lid),
+                                    (node.lineno, ctx))
+                        new_ids.append(lid)
+                for c in node.body:
+                    scan(c, held_ids + new_ids, cls_name, func_name)
+                return
+            for c in ast.iter_child_nodes(node):
+                scan(c, held_ids, cls_name, func_name)
+
+        for top in unit.tree.body:
+            scan(top, [], None, "<module>")
+
+        # interprocedural: a self-method call under lock contributes the
+        # callee's (transitive, depth-capped) acquisitions as edges
+        def effective(cls_name, meth, depth, seen) -> Set[str]:
+            key = (cls_name, meth)
+            if depth > self._DEPTH_CAP or key in seen:
+                return set()
+            seen = seen | {key}
+            acc = set(acquires.get(key, ()))
+            for callee in self_calls.get(key, ()):
+                acc |= effective(cls_name, callee, depth + 1, seen)
+            return acc
+
+        for (cls_name, caller), sites in calls_under.items():
+            for held_ids, callee, line, ctx in sites:
+                if (cls_name, callee) not in acquires \
+                        and (cls_name, callee) not in self_calls:
+                    continue
+                for lid in effective(cls_name, callee, 1, frozenset()):
+                    for h in held_ids:
+                        if h == lid and model.kind_of(lid) == "Lock":
+                            findings.append(Finding(
+                                code=self.code,
+                                message=(f"call to self.{callee}() "
+                                         f"re-enters {lid} already held "
+                                         "here — threading.Lock is not "
+                                         "reentrant; this thread "
+                                         "deadlocks itself"),
+                                path=unit.rel, line=line, context=ctx,
+                                snippet=unit.line_text(line)))
+                        elif h != lid:
+                            edges.setdefault((h, lid), (line, ctx))
+
+        findings.extend(self._cycles(unit, edges))
+        return findings
+
+    def _cycles(self, unit: FileUnit,
+                edges: Dict[Tuple[str, str], Tuple[int, str]]):
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[Tuple[str, ...]] = set()
+        out: List[Finding] = []
+        for (a, b), (line, ctx) in sorted(edges.items(),
+                                          key=lambda kv: kv[1][0]):
+            # inversion = the reverse path b ->* a also exists
+            if not self._reaches(graph, b, a):
+                continue
+            key = tuple(sorted((a, b)))
+            if key in reported:
+                continue
+            reported.add(key)
+            rline, rctx = edges.get((b, a), (None, None))
+            other = (f" (reverse order at line {rline} in {rctx})"
+                     if rline else " (via a longer reverse path)")
+            out.append(Finding(
+                code=self.code,
+                message=(f"lock-order inversion: {a} -> {b} here, but "
+                         f"the reverse order also exists{other}; two "
+                         "threads can deadlock holding one lock each"),
+                path=unit.rel, line=line, context=ctx,
+                snippet=unit.line_text(line)))
+        return out
+
+    @staticmethod
+    def _reaches(graph: Dict[str, Set[str]], src: str,
+                 dst: str) -> bool:
+        stack, seen = [src], set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False

@@ -1,0 +1,120 @@
+"""RT004 uncataloged-telemetry.
+
+Every event type and metric name the package emits must resolve to its
+catalog (`util/events_catalog.py` / `util/metrics_catalog.py`). The
+runtime already enforces this — but only for code paths the test run
+happens to execute; a typo'd event name on a rare failure path ships
+silently and the post-mortem that needed it comes up empty. This check
+closes the gap statically: any string-literal event type passed to an
+emit-style callee, and any string-literal metric name resolved through
+the catalog `get()`, must exist in the parsed catalog.
+
+Resolution is per-call-site and purely syntactic: calls whose first
+argument is not a literal are skipped (wrappers forward variables; the
+wrapper's own call sites are the literals that get checked).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..engine import FileUnit, Finding, Project
+from .common import dotted, receiver, terminal_name
+
+# callee terminal names that take an event type as first argument
+_EMIT_NAMES = {"emit", "emit_safe", "_emit", "emit_event", "_ev_emit"}
+
+# event types look like "<subsystem>.<event>[.<event>]"
+_EVENT_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,2}$")
+
+# receivers that resolve metric names through the catalog
+_MCAT_NAMES = {"mcat", "_mcat", "metrics_catalog"}
+
+# files that define the catalogs / event plane themselves
+_EXEMPT = ("ray_tpu/util/events_catalog.py",
+           "ray_tpu/util/metrics_catalog.py",
+           "ray_tpu/util/events.py")
+
+
+def _callee_terminal(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _first_literal(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+class RT004UncatalogedTelemetry:
+    code = "RT004"
+    name = "uncataloged-telemetry"
+    summary = ("every emitted event type and catalog-resolved metric "
+               "name must exist in events_catalog.py / "
+               "metrics_catalog.py")
+    prefixes = ("ray_tpu/",)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.prefixes) \
+            and rel not in _EXEMPT
+
+    def run(self, unit: FileUnit, project: Project) -> List[Finding]:
+        events = project.event_names
+        metrics = project.metric_names
+        if events is None and metrics is None:
+            return []   # no catalogs found (bare fixture run)
+        out: List[Finding] = []
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            lit = _first_literal(node)
+            if lit is None:
+                continue
+            name = _callee_terminal(node)
+            if events is not None and name in _EMIT_NAMES \
+                    and _EVENT_RE.match(lit) and "." in lit:
+                if lit not in events:
+                    out.append(self._finding(
+                        unit, node,
+                        f"event type {lit!r} is not in "
+                        "util/events_catalog.py — add it to BUILTIN "
+                        "(with severity + help) or fix the typo"))
+            elif metrics is not None and name == "get" \
+                    and self._is_mcat(node):
+                if lit not in metrics:
+                    out.append(self._finding(
+                        unit, node,
+                        f"metric {lit!r} is not in "
+                        "util/metrics_catalog.py — add it to BUILTIN "
+                        "or fix the typo"))
+            elif metrics is not None and lit.startswith("ray_tpu_") \
+                    and name in ("Counter", "Gauge", "Histogram"):
+                if lit not in metrics:
+                    out.append(self._finding(
+                        unit, node,
+                        f"built-in-prefixed metric {lit!r} constructed "
+                        "outside the catalog — declare it in "
+                        "util/metrics_catalog.py and resolve it via "
+                        "get()"))
+        return out
+
+    @staticmethod
+    def _is_mcat(call: ast.Call) -> bool:
+        recv = receiver(call)
+        if recv is None:
+            return False
+        return terminal_name(recv) in _MCAT_NAMES
+
+    def _finding(self, unit: FileUnit, node: ast.Call,
+                 message: str) -> Finding:
+        return Finding(
+            code=self.code, message=message, path=unit.rel,
+            line=node.lineno, col=node.col_offset,
+            context=dotted(node.func),
+            snippet=unit.line_text(node.lineno))

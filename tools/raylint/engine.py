@@ -1,0 +1,426 @@
+"""raylint engine: file loading, suppressions, baseline, check runner.
+
+Pure stdlib-`ast` analysis — the analyzed package is never imported, so
+the gate is safe to run on broken checkouts and costs parse time only
+(the whole `ray_tpu/` tree lints in a couple of seconds, well inside
+the tier-1 < 30s bound).
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import re
+import time
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Default shrink-only baseline for grandfathered findings. Kept at ZERO
+# entries: every real finding is fixed or carries an inline suppression
+# naming why it is safe (tests/test_raylint.py enforces both).
+BASELINE_DEFAULT = Path(__file__).resolve().parent / "baseline.json"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*(disable(?:-file)?)\s*=\s*"
+    r"(?P<codes>[A-Za-z0-9_,\s]*?)(?:\s+(?P<reason>\S.*?))?\s*$")
+_CODE_RE = re.compile(r"^RT\d{3}$")
+
+# RT000 is the engine's own check: a malformed suppression (bad code
+# list, or no reason) silences nothing and is itself a finding, so a
+# typo'd disable comment can never quietly rot into a real bug's cover.
+ENGINE_CODE = "RT000"
+
+
+@dataclass
+class Finding:
+    code: str
+    message: str
+    path: str                 # repo-relative, e.g. "ray_tpu/core/runtime.py"
+    line: int
+    col: int = 0
+    context: str = ""         # enclosing "Class.method" (stable across drift)
+    snippet: str = ""         # stripped source line
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity so the baseline survives unrelated
+        edits above a grandfathered site."""
+        norm = " ".join(self.snippet.split())
+        raw = f"{self.code}|{self.path}|{self.context}|{norm}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code, "message": self.message, "path": self.path,
+            "line": self.line, "col": self.col, "context": self.context,
+            "snippet": self.snippet, "fingerprint": self.fingerprint(),
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+            "baselined": self.baselined,
+        }
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{loc}: {self.code} {self.message}{ctx}\n    {self.snippet}"
+
+
+@dataclass
+class _Suppression:
+    codes: Tuple[str, ...]
+    reason: str
+    line: int
+    used: bool = False
+
+
+class FileUnit:
+    """One parsed source file plus its suppression comments."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # line -> suppressions covering findings reported on that line
+        self.line_suppressions: Dict[int, List[_Suppression]] = {}
+        self.file_suppressions: List[_Suppression] = []
+        self.malformed: List[Finding] = []
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):  # torn file
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT \
+                    or "raylint" not in tok.string:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            line = tok.start[0]
+            if not m:
+                self.malformed.append(self._bad(
+                    line, f"unparsable raylint comment: {tok.string!r} "
+                    "(expected '# raylint: disable=RT00X <reason>')"))
+                continue
+            codes = tuple(c.strip().upper()
+                          for c in m.group("codes").split(",") if c.strip())
+            reason = (m.group("reason") or "").strip()
+            bad = [c for c in codes if not _CODE_RE.match(c)]
+            if not codes or bad:
+                self.malformed.append(self._bad(
+                    line, "suppression must name RTnnn check codes, got "
+                    f"{bad or '(none)'}"))
+                continue
+            if not reason:
+                self.malformed.append(self._bad(
+                    line, f"suppression of {','.join(codes)} has no "
+                    "reason; every disable must say why the site is safe"))
+                continue
+            sup = _Suppression(codes=codes, reason=reason, line=line)
+            if m.group(1) == "disable-file":
+                self.file_suppressions.append(sup)
+            elif self._standalone(tok):
+                # own-line comment covers the next NON-comment source
+                # line, so a long reason can wrap into plain comment
+                # lines between the disable and the code it covers
+                self.line_suppressions.setdefault(
+                    self._next_code_line(line), []).append(sup)
+            else:
+                self.line_suppressions.setdefault(line, []).append(sup)
+
+    def _standalone(self, tok) -> bool:
+        prefix = self.lines[tok.start[0] - 1][:tok.start[1]]
+        return not prefix.strip()
+
+    def _next_code_line(self, line: int) -> int:
+        m = line + 1
+        while m <= len(self.lines):
+            text = self.lines[m - 1].strip()
+            if text and not text.startswith("#"):
+                return m
+            m += 1
+        return line + 1
+
+    def _bad(self, line: int, message: str) -> Finding:
+        return Finding(
+            code=ENGINE_CODE, message=message, path=self.rel, line=line,
+            context="", snippet=self.line_text(line))
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def apply_suppressions(self, findings: List[Finding]) -> None:
+        for f in findings:
+            for sup in self.file_suppressions:
+                if f.code in sup.codes:
+                    f.suppressed, f.suppress_reason = True, sup.reason
+                    sup.used = True
+            for sup in self.line_suppressions.get(f.line, []):
+                if f.code in sup.codes:
+                    f.suppressed, f.suppress_reason = True, sup.reason
+                    sup.used = True
+
+    def unused_suppressions(self) -> List[Finding]:
+        """A disable that silences nothing is stale — either the bug was
+        fixed (delete the comment) or the code moved (re-anchor it)."""
+        out = []
+        all_sups = self.file_suppressions + [
+            s for sups in self.line_suppressions.values() for s in sups]
+        for sup in all_sups:
+            if not sup.used:
+                out.append(Finding(
+                    code=ENGINE_CODE,
+                    message="unused suppression of "
+                            f"{','.join(sup.codes)} (nothing to silence "
+                            "here; delete or re-anchor the comment)",
+                    path=self.rel, line=sup.line,
+                    snippet=self.line_text(sup.line)))
+        return out
+
+
+class Project:
+    """Cross-file facts the checks resolve against: the event/metric
+    catalogs and the knob registry, extracted by PARSING the catalog
+    modules (never importing them). Tests inject explicit sets."""
+
+    def __init__(self, package_dir: Optional[Path] = None, *,
+                 event_names: Optional[Set[str]] = None,
+                 metric_names: Optional[Set[str]] = None,
+                 knob_names: Optional[Set[str]] = None):
+        self._package_dir = package_dir
+        self._event_names = event_names
+        self._metric_names = metric_names
+        self._knob_names = knob_names
+
+    @classmethod
+    def discover(cls, paths: Sequence[Path]) -> "Project":
+        for p in paths:
+            p = p.resolve()
+            candidates = [p] + list(p.parents)
+            for c in candidates:
+                if (c / "util" / "events_catalog.py").is_file():
+                    return cls(package_dir=c)
+        return cls(package_dir=None)
+
+    def _catalog_keys(self, rel: str, dict_name: str) -> Optional[Set[str]]:
+        if self._package_dir is None:
+            return None
+        path = self._package_dir / rel
+        if not path.is_file():
+            return None
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            return None
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):   # BUILTIN: Dict = {
+                targets = [node.target]
+            else:
+                continue
+            if isinstance(node.value, ast.Dict) \
+                    and any(isinstance(t, ast.Name) and t.id == dict_name
+                            for t in targets):
+                return {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+        return None
+
+    @property
+    def event_names(self) -> Optional[Set[str]]:
+        if self._event_names is None:
+            self._event_names = self._catalog_keys(
+                "util/events_catalog.py", "BUILTIN")
+        return self._event_names
+
+    @property
+    def metric_names(self) -> Optional[Set[str]]:
+        if self._metric_names is None:
+            self._metric_names = self._catalog_keys(
+                "util/metrics_catalog.py", "BUILTIN")
+        return self._metric_names
+
+    @property
+    def knob_names(self) -> Optional[Set[str]]:
+        """Knobs declared in util/knobs.py via module-level _declare(...)
+        calls (first argument is the literal env-var name)."""
+        if self._knob_names is not None:
+            return self._knob_names
+        if self._package_dir is None:
+            return None
+        path = self._package_dir / "util" / "knobs.py"
+        if not path.is_file():
+            return None
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            return None
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("_declare", "declare") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+        self._knob_names = names
+        return names
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+    duration_s: float = 0.0
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "duration_s": round(self.duration_s, 3),
+            "counts": {
+                "active": len(self.active),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "total": len(self.findings),
+            },
+            "parse_errors": self.parse_errors,
+            "stale_baseline": self.stale_baseline,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def load_baseline(path: Optional[Path]) -> Dict[str, dict]:
+    if path is None or not Path(path).is_file():
+        return {}
+    data = json.loads(Path(path).read_text())
+    return dict(data.get("entries", {}))
+
+
+def save_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    entries = {}
+    for f in findings:
+        entries[f.fingerprint()] = {
+            "code": f.code, "path": f.path, "context": f.context,
+            "snippet": " ".join(f.snippet.split()),
+        }
+    payload = {
+        "comment": "shrink-only baseline of grandfathered raylint "
+                   "findings; entries may be removed, never added "
+                   "(tests/test_raylint.py enforces it stays at zero)",
+        "entries": entries,
+    }
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")
+
+
+def iter_py_files(paths: Sequence[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(
+                f for f in p.rglob("*.py")
+                if "__pycache__" not in f.parts))
+    return out
+
+
+def _rel_path(path: Path) -> str:
+    """Package-anchored path ("ray_tpu/core/runtime.py") so check
+    scoping works no matter what directory the CLI was invoked from:
+    climb out of the __init__.py chain to the package root's parent."""
+    path = path.resolve()
+    base = path.parent
+    while (base / "__init__.py").is_file():
+        base = base.parent
+    try:
+        return path.relative_to(base).as_posix()
+    except ValueError:
+        return path.name
+
+
+def run_units(units: Sequence[FileUnit], checks: Sequence,
+              project: Project,
+              baseline: Optional[Dict[str, dict]] = None) -> Report:
+    report = Report()
+    baseline = dict(baseline or {})
+    seen_fingerprints: Set[str] = set()
+    for unit in units:
+        found: List[Finding] = []
+        for check in checks:
+            if not check.applies(unit.rel):
+                continue
+            found.extend(check.run(unit, project))
+        unit.apply_suppressions(found)
+        found.extend(unit.malformed)
+        found.extend(unit.unused_suppressions())
+        for f in found:
+            fp = f.fingerprint()
+            seen_fingerprints.add(fp)
+            if not f.suppressed and fp in baseline:
+                f.baselined = True
+        found.sort(key=lambda f: (f.line, f.code))
+        report.findings.extend(found)
+    report.files_scanned = len(units)
+    report.stale_baseline = sorted(
+        fp for fp in baseline if fp not in seen_fingerprints)
+    return report
+
+
+def run_paths(paths: Sequence, checks: Sequence,
+              baseline_path: Optional[Path] = None,
+              project: Optional[Project] = None) -> Report:
+    t0 = time.monotonic()
+    paths = [Path(p) for p in paths]
+    files = iter_py_files(paths)
+    project = project or Project.discover(paths)
+    units: List[FileUnit] = []
+    parse_errors: List[str] = []
+    for f in files:
+        rel = _rel_path(f)
+        try:
+            units.append(FileUnit(rel, f.read_text()))
+        except SyntaxError as e:
+            parse_errors.append(f"{rel}: {e}")
+    report = run_units(units, checks, project,
+                       baseline=load_baseline(baseline_path))
+    report.parse_errors = parse_errors
+    report.duration_s = time.monotonic() - t0
+    return report
+
+
+def run_source(source: str, rel: str, checks: Sequence,
+               project: Optional[Project] = None) -> List[Finding]:
+    """Lint one in-memory snippet (the fixture-test entry point)."""
+    unit = FileUnit(rel, source)
+    report = run_units([unit], checks,
+                       project or Project(package_dir=None))
+    return report.findings
