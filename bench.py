@@ -861,6 +861,89 @@ def phase_core() -> dict:
         except OSError:
             pass
         ray_tpu.shutdown()
+
+    # ---- multi-agent scaling: noop + sleep-bound task and actor-call
+    # workloads spread across 1/2/4 node agents. Tasks demand the
+    # agent-only "agent" resource so the driver node never runs them.
+    # Noop throughput is the driver-dispatch ceiling (it cannot scale
+    # with agents — the driver is the bottleneck), and on a 1-core CI
+    # box CPU-bound work cannot scale either; the sleep workloads hold
+    # a worker SLOT but not the core, so their throughput tracks
+    # aggregate slots across agents and is the scale-out signal.
+    scaling = {}
+    n_sc = int(os.environ.get("RAY_TPU_BENCH_CORE_SCALE_TASKS",
+                              str(min(n, 600))))
+    io_ms = float(os.environ.get("RAY_TPU_BENCH_CORE_IO_MS", "5"))
+    for agents_n in (1, 2, 4):
+        procs = []
+        rt = ray_tpu.init(num_cpus=1, listen="127.0.0.1:0")
+        try:
+            for _ in range(agents_n):
+                procs.append(_sp.Popen(
+                    [sys.executable, "-m", "ray_tpu.core.node",
+                     rt.tcp_address, "--num-cpus", "2",
+                     "--resources", _json.dumps({"agent": 1.0})],
+                    env=env, cwd=REPO))
+            deadline = time.time() + 90
+            while (time.time() < deadline
+                   and len(rt.cluster_nodes) < agents_n + 1):
+                time.sleep(0.05)
+            if len(rt.cluster_nodes) < agents_n + 1:
+                raise RuntimeError(
+                    f"only {len(rt.cluster_nodes) - 1}/{agents_n} "
+                    "node agents registered")
+
+            @ray_tpu.remote(resources={"agent": 0.001})
+            def _noop_r():
+                return None
+
+            @ray_tpu.remote(resources={"agent": 0.001})
+            def _sleep_r():
+                time.sleep(io_ms / 1e3)
+                return None
+
+            @ray_tpu.remote(resources={"agent": 0.001})
+            class _SleepActor:
+                def hold(self):
+                    time.sleep(io_ms / 1e3)
+                    return None
+
+            ray_tpu.get([_sleep_r.remote()
+                         for _ in range(16 * agents_n)], timeout=180)
+            t0 = time.time()
+            ray_tpu.get([_noop_r.remote() for _ in range(n_sc)],
+                        timeout=600)
+            sc_noop = n_sc / (time.time() - t0)
+            t0 = time.time()
+            ray_tpu.get([_sleep_r.remote() for _ in range(n_sc)],
+                        timeout=600)
+            sc_sleep = n_sc / (time.time() - t0)
+            actors = [_SleepActor.remote() for _ in range(2 * agents_n)]
+            ray_tpu.get([a.hold.remote() for a in actors], timeout=180)
+            t0 = time.time()
+            ray_tpu.get([actors[i % len(actors)].hold.remote()
+                         for i in range(n_sc)], timeout=600)
+            sc_actor = n_sc / (time.time() - t0)
+            scaling[f"{agents_n}_agents"] = {
+                "noop_tasks_per_s": round(sc_noop, 1),
+                "sleep_tasks_per_s": round(sc_sleep, 1),
+                "sleep_actor_calls_per_s": round(sc_actor, 1),
+                "agent_slots": 2 * agents_n,
+                "io_ms": io_ms,
+                "n_calls": n_sc}
+            _progress(f"core[scale x{agents_n}]: {sc_noop:.0f} noop "
+                      f"tasks/s, {sc_sleep:.0f} sleep tasks/s, "
+                      f"{sc_actor:.0f} sleep actor calls/s")
+        except BaseException as e:  # noqa: BLE001
+            scaling[f"{agents_n}_agents"] = {"error": repr(e)[:300]}
+        finally:
+            for p in procs:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+            ray_tpu.shutdown()
+
     result = {"noop_tasks_per_s": round(tasks_s, 1),
             "actor_calls_per_s": round(actor_s, 1),
             "n_calls": n,
@@ -875,7 +958,8 @@ def phase_core() -> dict:
                 "actor": round(actor_s / legacy["actor_calls_per_s"], 2)
                 if legacy.get("actor_calls_per_s") else None,
             },
-            "transfer": transfer, "platform": "cpu"}
+            "transfer": transfer,
+            "multi_agent_scaling": scaling, "platform": "cpu"}
     try:
         with open(os.path.join(REPO, "BENCH_CORE.json"), "w") as f:
             json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -885,6 +969,129 @@ def phase_core() -> dict:
                        "result": result}, f, indent=1)
     except OSError as e:
         _progress(f"BENCH_CORE.json write failed (non-fatal): {e}")
+    return result
+
+
+def phase_dag() -> dict:
+    """Compiled-DAG A/B (no jax in the measured path): the same
+    3-stage function chain executed through the compiled pipelined
+    engine (schedule once, channel dataflow, docs/DAG.md) vs the
+    dynamic level-batched path (RAY_TPU_COMPILED_DAGS=0) — execs/s
+    with a small in-flight window, sequential p50/p99 latency, and
+    driver control traffic per execute. Acceptance bar: compiled
+    >= 10x dynamic execs/s at zero driver task messages per execute.
+    The result also lands in BENCH_DAG.json."""
+    import collections as _c
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    n = int(os.environ.get("RAY_TPU_BENCH_DAG_EXECS", "400"))
+    reps = int(os.environ.get("RAY_TPU_BENCH_DAG_REPS", "3"))
+    window = int(os.environ.get("RAY_TPU_BENCH_DAG_WINDOW", "32"))
+    TASK_KINDS = ("submit", "submit_many", "task_done", "get_request",
+                  "put")
+
+    @ray_tpu.remote
+    def _inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def _dbl(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def _dec(x):
+        return x - 1
+
+    def build():
+        with InputNode() as inp:
+            return _dec.bind(_dbl.bind(_inc.bind(inp)))
+
+    def expected(i):
+        return (i + 1) * 2 - 1
+
+    def measure(rt, comp, label):
+        assert ray_tpu.get(comp.execute(7), timeout=120) == expected(7)
+        best = {"execs_per_s": 0.0}
+        for _ in range(reps):
+            before = {k: rt.ctrl_msgs.get(k, 0) for k in TASK_KINDS}
+            f0 = rt.ctrl_frames + rt.dispatch_frames
+            pend = _c.deque()
+            t0 = time.time()
+            for i in range(n):
+                pend.append((i, comp.execute(i)))
+                if len(pend) >= window:
+                    j, ref = pend.popleft()
+                    assert ray_tpu.get(ref, timeout=120) == expected(j)
+            while pend:
+                j, ref = pend.popleft()
+                assert ray_tpu.get(ref, timeout=120) == expected(j)
+            dur = time.time() - t0
+            task_msgs = sum(rt.ctrl_msgs.get(k, 0) - before[k]
+                            for k in TASK_KINDS)
+            frames = rt.ctrl_frames + rt.dispatch_frames - f0
+            rate = n / dur
+            if rate > best["execs_per_s"]:
+                best = {"execs_per_s": round(rate, 1),
+                        "driver_task_msgs_per_exec":
+                            round(task_msgs / n, 4),
+                        "ctrl_frames_per_exec": round(frames / n, 4)}
+        lats = []
+        for i in range(min(n, 200)):
+            t1 = time.time()
+            assert ray_tpu.get(comp.execute(i), timeout=120) \
+                == expected(i)
+            lats.append(time.time() - t1)
+        lats.sort()
+        best["p50_ms"] = round(lats[len(lats) // 2] * 1e3, 3)
+        best["p99_ms"] = round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3)
+        best["n_execs"] = n
+        _progress(f"dag[{label}]: {best['execs_per_s']:.0f} execs/s, "
+                  f"p50 {best['p50_ms']}ms, p99 {best['p99_ms']}ms, "
+                  f"{best['driver_task_msgs_per_exec']} driver task "
+                  "msgs/exec")
+        return best
+
+    # dynamic first: the kill switch pins the level-batched path, on a
+    # fresh runtime so neither leg sees the other's warm state
+    os.environ["RAY_TPU_COMPILED_DAGS"] = "0"
+    try:
+        rt = ray_tpu.init(num_cpus=3)
+        comp = build().experimental_compile()
+        assert comp.stats["mode"] == "batched", comp.stats
+        dynamic = measure(rt, comp, "dynamic")
+        comp.close()
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_COMPILED_DAGS", None)
+
+    rt = ray_tpu.init(num_cpus=3)
+    try:
+        comp = build().experimental_compile()
+        assert comp.stats["mode"] == "pipelined", comp.stats
+        compiled = measure(rt, comp, "compiled")
+        comp.close()
+    finally:
+        ray_tpu.shutdown()
+
+    result = {"pipeline_stages": 3,
+              "compiled": compiled,
+              "dynamic_batched": dynamic,
+              "speedup_execs_per_s": round(
+                  compiled["execs_per_s"] / dynamic["execs_per_s"], 2)
+              if dynamic.get("execs_per_s") else None,
+              "platform": "cpu"}
+    try:
+        with open(os.path.join(REPO, "BENCH_DAG.json"), "w") as f:
+            json.dump({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                       "phase": "dag",
+                       "command": "JAX_PLATFORMS=cpu python bench.py "
+                                  "--phase dag",
+                       "result": result}, f, indent=1)
+    except OSError as e:
+        _progress(f"BENCH_DAG.json write failed (non-fatal): {e}")
     return result
 
 
@@ -2026,7 +2233,7 @@ def main():
     ap.add_argument("--phase",
                     choices=["kernels", "train", "train-llama", "serve",
                              "flash-ab", "probe-8b", "data", "core",
-                             "events", "recovery", "serve_ft",
+                             "dag", "events", "recovery", "serve_ft",
                              "serve_scale", "driver_ft", "train_ft"])
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
@@ -2045,6 +2252,7 @@ def main():
                  "probe-8b": phase_probe_8b,
                  "data": phase_data,
                  "core": phase_core,
+                 "dag": phase_dag,
                  "events": phase_events,
                  "recovery": phase_recovery,
                  "serve_ft": phase_serve_ft,

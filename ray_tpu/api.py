@@ -247,7 +247,16 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     rt = runtime_mod.get_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get([refs], timeout=timeout)[0]
-    return rt.get(list(refs), timeout=timeout)
+    # compiled-DAG futures (docs/DAG.md): resolved by the pipeline's
+    # driver-side controller, never by the object store
+    if getattr(refs, "_is_dag_ref", False):
+        return refs.get(timeout=timeout)
+    refs = list(refs)
+    if any(getattr(r, "_is_dag_ref", False) for r in refs):
+        return [r.get(timeout=timeout)
+                if getattr(r, "_is_dag_ref", False)
+                else rt.get([r], timeout=timeout)[0] for r in refs]
+    return rt.get(refs, timeout=timeout)
 
 
 def put(value: Any) -> ObjectRef:

@@ -352,6 +352,87 @@ class ShmStore:
         self._used = 0
 
 
+class ChannelSegment:
+    """A reusable shared-memory window for one compiled-DAG channel.
+
+    Unlike store objects (immutable, allocate/seal/free per value), a
+    channel segment is REWRITTEN every execution: the writer copies the
+    packed payload at offset 0 and notifies the reader with
+    (seqno, size, segment_name) over the channel socket; the depth-1
+    ack handshake guarantees the reader consumed seqno N before the
+    writer overwrites with N+1, so no header or fence lives in the
+    segment itself. Growth allocates a fresh generation-suffixed
+    segment (the notify frame carries the name, so readers re-attach
+    lazily) and unlinks the outgrown one."""
+
+    def __init__(self, base_name: str, capacity: int):
+        self.base_name = base_name
+        self.capacity = max(int(capacity), 1 << 12)
+        self.gen = 0
+        self._seg = shared_memory.SharedMemory(
+            name=self._name(), create=True, size=self.capacity)
+
+    def _name(self) -> str:
+        return f"{self.base_name}g{self.gen}"
+
+    @property
+    def name(self) -> str:
+        return self._name()
+
+    def write(self, payload) -> str:
+        """Copy payload into the segment (growing it if needed);
+        returns the segment name the reader should attach."""
+        size = len(payload)
+        if size > self.capacity:
+            old = self._seg
+            while self.capacity < size:
+                self.capacity *= 2
+            self.gen += 1
+            self._seg = shared_memory.SharedMemory(
+                name=self._name(), create=True, size=self.capacity)
+            old.close()
+            try:
+                old.unlink()
+            except FileNotFoundError:
+                pass
+        self._seg.buf[:size] = payload
+        return self._name()
+
+    def close(self) -> None:
+        seg, self._seg = self._seg, None
+        if seg is not None:
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class ChannelSegmentReader:
+    """Reader-side attachment cache for a channel's segments. The
+    writer's growth protocol changes the segment name at most a few
+    times over a channel's life; everything else is one cached-mmap
+    memoryview slice per read."""
+
+    def __init__(self):
+        self._seg = None
+        self._name = None
+
+    def view(self, name: str, size: int) -> memoryview:
+        if name != self._name:
+            self.close()
+            seg = shared_memory.SharedMemory(name=name, create=False)
+            _untrack(seg)
+            self._seg, self._name = seg, name
+        return self._seg.buf[:size]
+
+    def close(self) -> None:
+        seg, self._seg = self._seg, None
+        self._name = None
+        if seg is not None:
+            seg.close()
+
+
 def make_store(capacity_bytes: int, is_owner: bool):
     """Return the best available store backend (native C++ if built)."""
     try:

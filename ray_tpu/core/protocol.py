@@ -81,7 +81,33 @@ WIRE_KINDS = frozenset({
     "revoke_tasks", "shutdown", "get_reply", "heartbeat_ack",
     # worker <-> worker (direct actor calls)
     "dcall", "dresult",
+    # compiled-DAG channel plane (writer -> reader data sockets)
+    "ch_open", "ch_notify", "ch_ack", "ch_err",
+    # telemetry reports: the sys.metrics / sys.spans / sys.events
+    # payloads are framework-pure after the PR-13 delta-format change
+    # (tuple-keyed series ride msgpack maps); report channels carrying
+    # arbitrary user payloads fall back per-frame like any other kind
+    "report",
 })
+
+# Per-kind count of frames that attempted binary framing and fell back
+# to cloudpickle (payload not wire-pure). Steady-state telemetry tests
+# assert the hot kinds stay at zero; also exported as the
+# ray_tpu_wire_fallbacks_total metric so worker-side fallbacks surface
+# in the driver's cluster view.
+import collections as _collections  # noqa: E402
+
+wire_fallbacks: "_collections.Counter" = _collections.Counter()
+
+
+def _record_fallback(kind) -> None:
+    try:
+        wire_fallbacks[kind] += 1
+        from ..util import metrics_catalog as _mcat  # noqa: PLC0415
+        _mcat.get("ray_tpu_wire_fallbacks_total").inc(
+            tags={"kind": str(kind)})
+    except Exception:
+        pass
 
 _wire_enabled = (msgpack is not None
                  and knobs.get_bool("RAY_TPU_WIRE"))
@@ -207,6 +233,7 @@ def encode_message(msg) -> Optional[bytes]:
         return _WIRE_BYTE + msgpack.packb(list(msg), use_bin_type=True,
                                           default=_pack_default)
     except Exception:
+        _record_fallback(msg[0])
         return None
 
 

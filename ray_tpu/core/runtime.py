@@ -38,9 +38,10 @@ from .protocol import (Connection, ConnectionClosed, tcp_listener,
                        unix_listener)
 from .task import TaskSpec, ActorCreationSpec
 from ..util import knobs
-from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
-                          PlacementGroupError, RuntimeNotInitializedError,
-                          TaskCancelledError, TaskError, WorkerCrashedError)
+from ..exceptions import (ActorDiedError, CompiledDagError, GetTimeoutError,
+                          ObjectLostError, PlacementGroupError,
+                          RuntimeNotInitializedError, TaskCancelledError,
+                          TaskError, WorkerCrashedError)
 
 
 _mcat_mod = None
@@ -397,6 +398,10 @@ class DriverRuntime:
         self.dispatched_tasks = 0
         self.ctrl_frames = 0
         self.ctrl_msgs: collections.Counter = collections.Counter()
+        # compiled-DAG controllers by dag_id (docs/DAG.md); acquires
+        # queue here until the dispatcher can pin every stage's worker
+        self.compiled_dags: Dict[str, Any] = {}
+        self._dag_acquires: List[dict] = []
         # (worker_id, task_id) pairs reclaimed from a blocked worker's
         # lease: a result that slips in anyway (revoke raced a user
         # thread) must be dropped, not double-sealed over the re-run
@@ -1033,6 +1038,11 @@ class DriverRuntime:
             self._create_pg(item[1])
         elif kind == "api_remove_pg":
             self._remove_pg(item[1])
+        elif kind == "api_dag_acquire":
+            self._dag_acquires.append(item[1])
+            self._process_dag_acquires()
+        elif kind == "api_dag_release":
+            self._dag_release(item[1], item[2], item[3])
 
     def _handle_worker_msg(self, wid: str, m):
         from .protocol import RECV_ERROR  # noqa: PLC0415
@@ -1149,6 +1159,18 @@ class DriverRuntime:
                 self._cancel(e.owner_task, m[2])
             else:
                 self._cancel(m[1], m[2])
+        elif mtype == "dag_ready":
+            ctl = self.compiled_dags.get(m[1])
+            if ctl is not None:
+                ctl.on_ready(m[2], m[3])
+        elif mtype == "dag_error":
+            ctl = self.compiled_dags.get(m[1])
+            if ctl is not None:
+                ctl.on_install_error(m[2], m[3])
+        elif mtype == "dag_down":
+            ctl = self.compiled_dags.get(m[1])
+            if ctl is not None:
+                ctl.on_down(m[2], m[3])
         elif mtype == "report":
             h = self.report_handlers.get(m[1])
             if h:
@@ -2431,6 +2453,10 @@ class DriverRuntime:
                 self._seal(pg.ready_ref,
                            self.store.put_value(pg.ready_ref, True))
 
+        # 0.5 compiled-DAG placements waiting on worker spawns
+        if self._dag_acquires:
+            self._process_dag_acquires()
+
         # 1. actor creations (dedicated worker each)
         still = collections.deque()
         while self.pending_actors:
@@ -3215,6 +3241,116 @@ class DriverRuntime:
                 return w
         return None
 
+    # ---------------- compiled-DAG placement (docs/DAG.md) -----------
+    def _process_dag_acquires(self):
+        rest = []
+        for acq in self._dag_acquires:
+            if not self._try_place_dag(acq):
+                if time.time() > acq["deadline"]:
+                    acq["reply"].put({"error": (
+                        "placement timed out: not enough idle workers "
+                        "for the compiled-DAG stages")})
+                else:
+                    rest.append(acq)
+        self._dag_acquires = rest
+
+    def _dag_pick_worker(self, pref_node: str,
+                         need: Dict[str, float],
+                         used: set) -> Optional[WorkerState]:
+        # dependency-local first, then any node; plain CPU workers
+        # before idle TPU-capable ones (same fallback rule as tasks)
+        best = None
+        for w in self.workers.values():
+            if (w.state != "idle" or w.conn is None or w.purpose
+                    or w.worker_id in used):
+                continue
+            node = self.cluster_nodes.get(w.node_id)
+            if node is None or not node.alive:
+                continue
+            if need and not res_mod.fits(node.avail, need):
+                continue
+            score = (w.node_id == pref_node, not w.tpu_capable)
+            if best is None or score > best[0]:
+                best = (score, w)
+        return best[1] if best else None
+
+    def _try_place_dag(self, acq: dict) -> bool:
+        """True when the acquire resolved (placement committed or a
+        terminal error was replied); False keeps it queued."""
+        placement: Dict[Any, dict] = {}
+        node_of: Dict[Any, str] = {}
+        used: set = set()
+        spawn_nodes: List[str] = []
+        for r in acq["reqs"]:
+            sid = r["sid"]
+            if r["kind"] == "method":
+                aid = r["actor_id"]
+                ae = self.gcs.actors.get(aid)
+                if ae is None or ae.state == "DEAD":
+                    acq["reply"].put({"error": f"actor:{aid}:dead"})
+                    return True
+                w = self._worker_for_actor(aid)
+                if w is None or ae.state != "ALIVE" or w.conn is None:
+                    return False     # still starting: retry next pass
+                placement[sid] = {"wid": w.worker_id,
+                                  "node_id": w.node_id, "conn": w.conn,
+                                  "pinned": False}
+                node_of[sid] = w.node_id
+            else:
+                pref = sched_mod.compiled_stage_node(
+                    r.get("deps") or (), node_of, self.node_id)
+                need = {"CPU": float(r.get("num_cpus") or 1)}
+                w = self._dag_pick_worker(pref, need, used)
+                if w is None:
+                    spawn_nodes.append(pref)
+                    continue
+                used.add(w.worker_id)
+                placement[sid] = {"wid": w.worker_id,
+                                  "node_id": w.node_id, "conn": w.conn,
+                                  "pinned": True, "need": need}
+                node_of[sid] = w.node_id
+        if spawn_nodes:
+            for nid in spawn_nodes:
+                node = self.cluster_nodes.get(nid)
+                if node is None or not node.alive:
+                    node = self.cluster_nodes[self.node_id]
+                starting = sum(
+                    1 for w in self.workers.values()
+                    if w.node_id == node.node_id
+                    and w.state == "starting" and w.purpose is None)
+                # one outstanding spawn per node per pass: registration
+                # re-triggers _schedule, which retries this acquire
+                if starting == 0 and self._can_spawn(node):
+                    self._spawn_worker(None, node_id=node.node_id)
+            return False
+        # every stage has a worker: commit atomically
+        for sid, p in placement.items():
+            if not p["pinned"]:
+                continue
+            w = self.workers[p["wid"]]
+            w.state = "dag"
+            w.current_task = f"dag:{acq['dag_id']}"
+            need = p.pop("need")
+            res_mod.acquire(self._wnode_avail(w), need)
+            w.held_resources = dict(need)
+        acq["reply"].put({"placement": placement})
+        return True
+
+    def _dag_release(self, dag_id: str, wids: List[str], info: dict):
+        for wid in wids:
+            w = self.workers.get(wid)
+            if w is None or w.state != "dag":
+                continue
+            res_mod.release(self._wnode_avail(w), w.held_resources)
+            w.held_resources = {}
+            w.state = "idle"
+            w.current_task = None
+        self._emit("dag.channel.close", dag_id=dag_id,
+                   channels=int(info.get("channels", 0)))
+        self._emit("dag.teardown", dag_id=dag_id,
+                   reason=str(info.get("reason", "")),
+                   workers=len(wids))
+
     # ---------------- completions ----------------
     def _on_task_done(self, wid: str, task_id: str, sealed, error):
         te = self.gcs.tasks.get(task_id)
@@ -3345,6 +3481,14 @@ class DriverRuntime:
         if w is None or w.state == "dead":
             return
         w.state = "dead"
+        # a compiled-DAG participant died: fail that pipeline's
+        # in-flight executions (typed CompiledDagError) and tear its
+        # channels down; the next execute() re-compiles transparently
+        for ctl in list(self.compiled_dags.values()):
+            try:
+                ctl.on_worker_dead(wid)
+            except Exception:
+                traceback.print_exc()
         # a dead worker's gauge series would otherwise report its last
         # "current state" forever (counters/histograms stay: history)
         self.cluster_metrics.drop_source({"worker_id": wid})
@@ -4278,6 +4422,32 @@ class DriverRuntime:
     def remove_placement_group(self, pg_id: str) -> None:
         self.inbox.put(("api_remove_pg", pg_id))
 
+    # ---------------- compiled DAGs (docs/DAG.md) ----------------
+    def dag_acquire(self, dag_id: str, reqs: List[dict],
+                    timeout: float) -> Dict[Any, dict]:
+        """Pin one worker per compiled-DAG stage (dependency-local).
+        Blocks the calling API thread; placement itself happens on the
+        dispatcher. Raises CompiledDagError when placement fails."""
+        reply: "queue.Queue" = queue.Queue()
+        self.inbox.put(("api_dag_acquire", {
+            "dag_id": dag_id, "reqs": reqs, "reply": reply,
+            "deadline": time.time() + timeout}))
+        try:
+            res = reply.get(timeout=timeout + 5.0)
+        except queue.Empty:
+            raise CompiledDagError("compiled-DAG placement timed out",
+                                   cause="dispatcher unresponsive") \
+                from None
+        if "error" in res:
+            raise CompiledDagError("compiled-DAG placement failed",
+                                   cause=res["error"])
+        return res["placement"]
+
+    def dag_release(self, dag_id: str, wids: List[str],
+                    channels: int = 0, reason: str = "") -> None:
+        self.inbox.put(("api_dag_release", dag_id, list(wids),
+                        {"channels": channels, "reason": reason}))
+
     def get_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
         for n in self.cluster_nodes.values():
@@ -4315,6 +4485,11 @@ class DriverRuntime:
         if self._shutdown.is_set():
             return
         self._flush_submits()
+        for ctl in list(self.compiled_dags.values()):
+            try:
+                ctl.close()
+            except Exception:
+                pass
         self._shutdown.set()
         self._submit_buf_event.set()   # unblock the flush loop
         if self._persist is not None:

@@ -70,3 +70,25 @@ def hard_affinity_node(strategy) -> Optional[str]:
             and not strategy.soft):
         return strategy.node_id
     return None
+
+
+def compiled_stage_node(deps, node_of, driver_node: str) -> str:
+    """Preferred node for a compiled-DAG stage (docs/DAG.md): the node
+    where most of its upstream stages landed — a same-node channel is a
+    shm rewrite, a cross-node one is a socket copy — falling back to
+    the driver's node for roots. `node_of` maps already-placed stage
+    ids to node ids; unplaced deps (shouldn't happen in topo order) are
+    ignored. Ties break toward the first-listed dependency, keeping
+    chains anchored where their head landed."""
+    counts: dict = {}
+    order: List[str] = []
+    for d in deps:
+        nid = node_of.get(d)
+        if nid is None:
+            continue
+        if nid not in counts:
+            order.append(nid)
+        counts[nid] = counts.get(nid, 0) + 1
+    if not counts:
+        return driver_node
+    return max(order, key=lambda n: counts[n])

@@ -938,6 +938,15 @@ class WorkerLoop:
         # __ray_save__ checkpoint shipping (actors that define the hook)
         self._ckpt_lock = threading.Lock()
         self._last_ckpt = 0.0
+        # compiled-DAG plane (docs/DAG.md): built on first dag_install
+        self._dag_ctx = None
+        self.socket_path = socket_path
+
+    def _dag(self):
+        if self._dag_ctx is None:
+            from .dag_runtime import WorkerDagContext  # noqa: PLC0415
+            self._dag_ctx = WorkerDagContext(self)
+        return self._dag_ctx
 
     # ---- main -------------------------------------------------------------
     def run(self) -> None:
@@ -1035,7 +1044,18 @@ class WorkerLoop:
             elif mtype == "drop_device":
                 from . import device_store  # noqa: PLC0415
                 device_store.drop(msg[1])
+            elif mtype == "dag_install":
+                # compile-time only; steady-state executions never
+                # touch this socket (docs/DAG.md)
+                self._dag().install(msg[1])
+            elif mtype == "dag_start":
+                self._dag().start(msg[1], msg[2])
+            elif mtype == "dag_teardown":
+                if self._dag_ctx is not None:
+                    self._dag_ctx.teardown(msg[1])
             elif mtype == "shutdown":
+                if self._dag_ctx is not None:
+                    self._dag_ctx.teardown_all()
                 self._shutdown.set()
 
     # ---- telemetry --------------------------------------------------------

@@ -185,3 +185,19 @@ class StreamInterruptedError(RayTpuError):
     def __init__(self, message: str, cause_repr: str = ""):
         self.cause_repr = cause_repr
         super().__init__(message)
+
+
+class CompiledDagError(RayTpuError):
+    """A compiled DAG's pipeline infrastructure failed: a pinned
+    participant died, a channel peer closed mid-execution, or the
+    install handshake broke. In-flight executions fail with this (the
+    `cause` names what broke); the channels are torn down and the next
+    `execute()` transparently re-compiles. User exceptions raised
+    INSIDE a stage do not surface this — they propagate through the
+    channels as ordinary TaskErrors without tearing the pipeline
+    down."""
+
+    def __init__(self, message: str, cause: str = ""):
+        self.cause = cause
+        super().__init__(message if not cause
+                         else f"{message} (cause: {cause})")

@@ -124,6 +124,28 @@ BUILTIN: Dict[str, _SPEC] = {
         "info", "worker process spawned"),
     "worker.death": (
         "warning", "worker process died or was terminated"),
+    # ---- compiled DAGs (docs/DAG.md) ----
+    "dag.compile": (
+        "info", "compiled-DAG pipeline placed and wired: attrs carry "
+        "stage, pinned-worker, and channel counts (schedule once — "
+        "every execute() after this costs zero driver messages)"),
+    "dag.channel.open": (
+        "info", "one reusable object channel connected (same-node: "
+        "rewritten shm segment; cross-node: persistent socket)"),
+    "dag.channel.close": (
+        "info", "a compiled DAG's channels released at teardown "
+        "(segments unlinked, sockets closed)"),
+    "dag.teardown": (
+        "info", "compiled-DAG pipeline released its pinned workers; "
+        "attrs carry the reason (close(), failure cause, shutdown)"),
+    "dag.fail": (
+        "error", "compiled-DAG pipeline infrastructure failed "
+        "(participant death / channel loss); in-flight executions got "
+        "CompiledDagError and the next execute() re-compiles"),
+    "dag.exec.fallback": (
+        "info", "compiled DAG running on the dynamic level-batched "
+        "path (RAY_TPU_COMPILED_DAGS=0 or a pipeline-ineligible graph "
+        "shape; attrs carry the reason)"),
     # ---- scheduler ----
     "scheduler.backpressure": (
         "warning", "task/actor pending past the stuck-warning window "

@@ -93,6 +93,30 @@ _declare("RAY_TPU_DIRECT_CALLS", "bool", True,
 _declare("RAY_TPU_WIRE", "bool", True,
          "Compact msgpack codec for hot control-frame kinds. 0 forces "
          "legacy all-pickle framing.", "core dispatch")
+_declare("RAY_TPU_COMPILED_DAGS", "bool", True,
+         "Compiled-DAG pipelined execution (docs/DAG.md): compile "
+         "resolves placement once, pins a worker per stage and "
+         "pre-opens reusable channels; execute() pushes input with "
+         "zero driver control messages. 0 falls back to the "
+         "level-batched dynamic path (one submit_many per level).",
+         "core dispatch")
+_declare("RAY_TPU_DAG_CHANNEL_BYTES", "int", 1 << 20,
+         "Initial capacity of a compiled-DAG same-node channel "
+         "segment. A payload larger than the current capacity grows "
+         "the channel into a fresh generation-suffixed segment (the "
+         "old one is unlinked); cross-node edges are unaffected (they "
+         "ride the peer socket frame).", "core dispatch")
+_declare("RAY_TPU_DAG_CHANNEL_DEPTH", "int", 16,
+         "Ack window of a compiled-DAG channel for inline payloads: a "
+         "writer may run this many seqnos ahead of its reader before "
+         "blocking, which is what lets pipeline stages overlap. "
+         "Shared-memory segment payloads always gate at depth 1 (the "
+         "segment is rewritten in place, so the previous payload must "
+         "be consumed first).", "core dispatch")
+_declare("RAY_TPU_DAG_COMPILE_TIMEOUT_S", "float", 30.0,
+         "Deadline for a compiled DAG's placement + channel install "
+         "handshake. Expiry raises CompiledDagError and releases any "
+         "partially pinned workers.", "core dispatch")
 
 # ---------------------------------------------------------------------------
 # core: runtime + object store

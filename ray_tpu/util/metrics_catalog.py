@@ -96,6 +96,20 @@ BUILTIN: Dict[str, _SPEC] = {
         "gauge", "host memory pressure (1 - available/total); the RSS "
         "watchdog kills a worker as it approaches 1.0", (), "ratio",
         None),
+    # ---- compiled-DAG plane (docs/DAG.md) ----
+    "ray_tpu_dag_execs_total": (
+        "counter", "compiled-DAG executions by mode (pipelined = "
+        "channel pipeline, zero driver messages; batched = dynamic "
+        "level-batched fallback)", ("mode",), "execs", None),
+    "ray_tpu_dag_channel_reuse_total": (
+        "counter", "channel writes that reused an already-open channel "
+        "(every write after a channel's first — the allocate/seal/free "
+        "work the pipeline avoids)", (), "writes", None),
+    "ray_tpu_wire_fallbacks_total": (
+        "counter", "control frames of a wire-eligible kind that fell "
+        "back to cloudpickle framing (should stay 0 in steady state; "
+        "a payload the msgpack codec cannot express)", ("kind",),
+        "frames", None),
     # ---- peer-to-peer object transfer plane (core/object_transfer.py) ----
     "ray_tpu_transfer_bytes_pulled_total": (
         "counter", "object bytes pulled directly from holder nodes",

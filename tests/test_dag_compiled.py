@@ -1,0 +1,227 @@
+"""Compiled-DAG pipelined engine (docs/DAG.md): zero driver messages
+in steady state, channel reuse, typed failure + transparent
+re-compile, teardown hygiene, and the RAY_TPU_COMPILED_DAGS kill
+switch."""
+import glob
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.exceptions import CompiledDagError, TaskError
+
+
+@ray_tpu.remote
+def _add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def _mul(x, y):
+    return x * y
+
+
+@ray_tpu.remote
+def _boom(x):
+    if x == 13:
+        raise ValueError("unlucky input")
+    return x
+
+
+@ray_tpu.remote
+def _big(x):
+    return b"x" * (200 * 1024) + bytes([x % 256])
+
+
+@ray_tpu.remote
+def _size(b):
+    return len(b)
+
+
+def _runtime():
+    from ray_tpu.core import runtime as rt_mod
+    return rt_mod.get_runtime()
+
+
+def test_pipelined_execute_zero_driver_ctrl_msgs(rt):
+    """THE acceptance invariant: after compile, execute() + get() move
+    data worker->worker and worker->driver over channels only — the
+    control-plane ctrl_msgs counters must not move at all."""
+    node = _runtime()
+    with InputNode() as inp:
+        dag = _mul.bind(_add.bind(inp, 1), 2)
+    comp = dag.experimental_compile()
+    assert comp.stats["mode"] == "pipelined"
+    assert ray_tpu.get(comp.execute(5)) == 12     # compile + warm-up
+    before = dict(node.ctrl_msgs)
+    for i in range(20):
+        assert ray_tpu.get(comp.execute(i)) == (i + 1) * 2
+    after = dict(node.ctrl_msgs)
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in set(after) | set(before)
+             if after.get(k, 0) != before.get(k, 0)}
+    assert delta == {}, f"driver saw control messages: {delta}"
+    assert comp.stats["execs"] == 21
+    assert comp.stats["submit_calls"] == 0
+    comp.close()
+
+
+def test_multi_output_and_input_attrs(rt):
+    """Input sub-field binding + MultiOutputNode with an input
+    passthrough (a driver-resolved output slot)."""
+    with InputNode() as inp:
+        dag = MultiOutputNode(
+            [_add.bind(inp["a"], 1), _mul.bind(inp["b"], 3), inp["a"]])
+    comp = dag.experimental_compile()
+    assert comp.stats["mode"] == "pipelined"
+    for k in range(3):
+        out = ray_tpu.get(comp.execute({"a": 10 + k, "b": 2}))
+        assert out == [11 + k, 6, 10 + k]
+    comp.close()
+
+
+def test_same_node_channels_reuse_one_segment(rt):
+    """>inline-threshold same-node payloads ride ONE shm segment
+    rewritten per call — N executes must not grow /dev/shm."""
+    with InputNode() as inp:
+        dag = _size.bind(_big.bind(inp))
+    comp = dag.experimental_compile()
+    assert ray_tpu.get(comp.execute(0)) == 200 * 1024 + 1
+    segs_after_first = len(glob.glob("/dev/shm/rtpu_dagch_*"))
+    for i in range(8):
+        assert ray_tpu.get(comp.execute(i)) == 200 * 1024 + 1
+    assert len(glob.glob("/dev/shm/rtpu_dagch_*")) == segs_after_first
+    comp.close()
+
+
+def test_user_exception_rides_channel_pipeline_survives(rt):
+    """A stage raising is a RESULT (TaskError at get()), not an
+    infrastructure failure: downstream stages skip, the pipeline keeps
+    running, no re-compile."""
+    with InputNode() as inp:
+        dag = _add.bind(_boom.bind(inp), 1)
+    comp = dag.experimental_compile()
+    assert ray_tpu.get(comp.execute(1)) == 2
+    with pytest.raises(TaskError):
+        ray_tpu.get(comp.execute(13))
+    assert ray_tpu.get(comp.execute(5)) == 6
+    assert comp.stats["recompiles"] == 0
+    comp.close()
+
+
+def test_sigkill_participant_typed_error_then_recompile(rt):
+    """Chaos: SIGKILL a pinned participant mid-pipeline. In-flight
+    executions fail with CompiledDagError (typed, with a cause), the
+    channels tear down, and the NEXT execute() transparently
+    re-compiles onto fresh workers — zero lost results for executions
+    that already delivered."""
+    node = _runtime()
+    with InputNode() as inp:
+        dag = _mul.bind(_add.bind(inp, 1), 2)
+    comp = dag.experimental_compile()
+    delivered = comp.execute(5)
+    assert ray_tpu.get(delivered) == 12
+    pinned = [w for w in node.workers.values() if w.state == "dag"]
+    assert len(pinned) == 2
+    victim = pinned[0]
+    inflight = comp.execute(7)
+    os.kill(victim.pid, signal.SIGKILL)
+    with pytest.raises(CompiledDagError):
+        ray_tpu.get(inflight, timeout=15)
+    # a result delivered BEFORE the death stays retrievable
+    assert ray_tpu.get(delivered) == 12
+    # next execute() re-compiles; give the pool a moment to replace
+    # the dead worker
+    deadline = time.time() + 15
+    out = None
+    while time.time() < deadline:
+        try:
+            out = ray_tpu.get(comp.execute(9), timeout=15)
+            break
+        except CompiledDagError:
+            time.sleep(0.1)
+    assert out == 20
+    assert comp.stats["recompiles"] >= 1
+    # the replacement pipeline is steady-state again
+    before = dict(node.ctrl_msgs)
+    for i in range(5):
+        assert ray_tpu.get(comp.execute(i)) == (i + 1) * 2
+    after = dict(node.ctrl_msgs)
+    assert {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(after) | set(before)
+            if after.get(k, 0) != before.get(k, 0)} == {}
+    comp.close()
+
+
+def test_compile_close_cycles_leak_no_segments_or_pins(rt):
+    """Teardown hygiene: N compile/close cycles leave no channel shm
+    segments behind and release every pinned worker."""
+    node = _runtime()
+    baseline = set(glob.glob("/dev/shm/rtpu_dagch_*"))
+    for cycle in range(3):
+        with InputNode() as inp:
+            dag = _size.bind(_big.bind(inp))
+        comp = dag.experimental_compile()
+        assert ray_tpu.get(comp.execute(cycle)) == 200 * 1024 + 1
+        comp.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        leaked = set(glob.glob("/dev/shm/rtpu_dagch_*")) - baseline
+        pinned = [w for w in node.workers.values() if w.state == "dag"]
+        if not leaked and not pinned:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"channel segments leaked: {leaked}"
+    assert not pinned, f"workers left pinned: {pinned}"
+
+
+def test_kill_switch_falls_back_to_batched(rt, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_COMPILED_DAGS", "0")
+    with InputNode() as inp:
+        dag = _add.bind(inp, 1)
+    comp = dag.experimental_compile()
+    assert comp.stats["mode"] == "batched"
+    assert "RAY_TPU_COMPILED_DAGS" in (comp._fallback_reason or "")
+    assert ray_tpu.get(comp.execute(1)) == 2      # ObjectRef path
+    assert comp.stats["submit_calls"] == 1
+
+
+def test_ineligible_shapes_fall_back_with_reason(rt):
+    """Placement-constrained or dynamic-value stages can't ride the
+    pipeline — they degrade to the batched plan, with the reason
+    recorded for the dag.exec.fallback event."""
+    from ray_tpu.core.scheduling import NodeAffinitySchedulingStrategy
+    node = _runtime()
+
+    pinned_fn = _add.options(scheduling_strategy=
+                             NodeAffinitySchedulingStrategy(node.node_id))
+    with InputNode() as inp:
+        dag = pinned_fn.bind(inp, 1)
+    comp = dag.experimental_compile()
+    assert comp.stats["mode"] == "batched"
+    assert "placement" in comp._fallback_reason
+
+    ref = ray_tpu.put(41)
+    with InputNode() as inp:
+        dag2 = _add.bind(inp, ref)
+    comp2 = dag2.experimental_compile()
+    assert comp2.stats["mode"] == "batched"
+    assert "ObjectRef" in comp2._fallback_reason
+    assert ray_tpu.get(comp2.execute(1)) == 42
+
+
+def test_dag_refs_are_driver_local(rt):
+    """CompiledDagRefs never convert to ObjectRefs: passing one to a
+    task (serializing it) must fail loudly, not hang."""
+    with InputNode() as inp:
+        dag = _add.bind(inp, 1)
+    comp = dag.experimental_compile()
+    r = comp.execute(1)
+    with pytest.raises(TypeError):
+        import cloudpickle
+        cloudpickle.dumps(r)
+    assert ray_tpu.get(r) == 2
+    comp.close()

@@ -113,10 +113,14 @@ def test_cross_actor_edge_materializes_and_is_correct(rt):
     ray_tpu.kill(b)
 
 
-def test_compiled_dag_chain_device_edges(rt):
-    """The compiled-DAG chain the VERDICT asks for: intermediate edges
+def test_compiled_dag_chain_device_edges(rt, monkeypatch):
+    """The compiled-DAG chain the VERDICT asks for, on the dynamic
+    level-batched path (RAY_TPU_COMPILED_DAGS=0): intermediate edges
     stay device-resident (transfer counters prove no D2H), results
-    unchanged vs eager execution."""
+    unchanged vs eager execution. (The pipelined engine beats device
+    edges outright: same-actor stages hand values over in-process —
+    see test_compiled_dag_pipelined_actor_chain.)"""
+    monkeypatch.setenv("RAY_TPU_COMPILED_DAGS", "0")
     from ray_tpu.dag import InputNode
     actor = JaxActor.bind()
     with InputNode() as inp:
@@ -133,6 +137,26 @@ def test_compiled_dag_chain_device_edges(rt):
     # second execute reuses the compiled plan and stays device-resident
     out2 = ray_tpu.get(dag.execute(8))
     assert out2 == float(np.arange(8).sum() * 2)
+    ray_tpu.kill(handle)
+
+
+def test_compiled_dag_pipelined_actor_chain(rt):
+    """Pipelined engine, same chain: same-actor stages hand values
+    over IN-PROCESS (no serialization, no device-store bookkeeping at
+    all) and results match the eager path."""
+    from ray_tpu.dag import InputNode
+    actor = JaxActor.bind()
+    with InputNode() as inp:
+        dag = actor.total.bind(actor.double.bind(actor.make.bind(inp)))
+    comp = dag.experimental_compile()
+    assert comp.stats["mode"] == "pipelined"
+    assert ray_tpu.get(comp.execute(256)) == float(
+        np.arange(256).sum() * 2)
+    assert ray_tpu.get(comp.execute(8)) == float(np.arange(8).sum() * 2)
+    handle = actor._handle
+    c = ray_tpu.get(handle.counters.remote())
+    assert c["materialized"] == 0
+    comp.close()
     ray_tpu.kill(handle)
 
 

@@ -112,6 +112,17 @@ def test_catalog_requires_train_fault_tolerance_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_compiled_dag_events():
+    """The compiled-DAG lifecycle chain (docs/DAG.md): compile ->
+    channel open -> [fail ->] teardown, plus the fallback marker the
+    kill-switch/ineligibility tests key on — the catalog must keep
+    carrying it."""
+    for required in ("dag.compile", "dag.channel.open",
+                     "dag.channel.close", "dag.teardown", "dag.fail",
+                     "dag.exec.fallback"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

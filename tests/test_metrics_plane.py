@@ -129,6 +129,53 @@ def test_catalog_requires_dispatch_plane_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_compiled_dag_metrics():
+    """The compiled-DAG plane (docs/DAG.md): BENCH_DAG and the
+    zero-ctrl-frame acceptance tests key on these series — the catalog
+    must keep carrying them."""
+    for required, kind in (
+            ("ray_tpu_dag_execs_total", "counter"),
+            ("ray_tpu_dag_channel_reuse_total", "counter"),
+            ("ray_tpu_wire_fallbacks_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
+def test_steady_state_workload_zero_wire_fallbacks(rt):
+    """Every control frame a steady-state workload produces — task
+    submits/dones, leases, seals, actor calls, AND the telemetry delta
+    reports (PR-8 leftover: 'report' joined WIRE_KINDS this PR) —
+    must ride the binary wire. A fallback here means a payload
+    regressed to cloudpickle framing."""
+    from ray_tpu.core import protocol as proto
+
+    @ray_tpu.remote
+    def _noop(x):
+        return x
+
+    @ray_tpu.remote
+    class _Cnt:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    a = _Cnt.remote()
+    ray_tpu.get(a.bump.remote())              # warm-up: spawn + register
+    ray_tpu.get([_noop.remote(i) for i in range(4)])
+    before = dict(proto.wire_fallbacks)
+    ray_tpu.get([_noop.remote(i) for i in range(32)])
+    assert ray_tpu.get([a.bump.remote() for _ in range(8)])[-1] == 9
+    time.sleep(0.1)
+    delta = {k: proto.wire_fallbacks.get(k, 0) - before.get(k, 0)
+             for k in set(proto.wire_fallbacks) | set(before)
+             if proto.wire_fallbacks.get(k, 0) != before.get(k, 0)}
+    assert delta == {}, f"wire-codec fallbacks in steady state: {delta}"
+    ray_tpu.kill(a)
+
+
 def test_no_uncataloged_builtin_metric_literals():
     """Lint: any Counter/Gauge/Histogram constructed with a literal name
     inside the package must use a cataloged ray_tpu_ name (user-facing

@@ -155,6 +155,38 @@ def test_impure_payload_falls_back():
     assert proto.encode_message(("get_reply", "r", {1, 2})) is None
 
 
+def test_fallbacks_are_counted_per_kind():
+    """Each wire-eligible frame that degrades to cloudpickle bumps the
+    wire_fallbacks counter (and its catalog metric) under its kind —
+    the signal the steady-state zero-fallback test keys on. Frames of
+    non-wire kinds are NOT fallbacks (pickle is their native framing)."""
+    class Weird:
+        pass
+
+    before = dict(proto.wire_fallbacks)
+    assert proto.encode_message(("task_done", "t", [], Weird())) is None
+    assert proto.encode_message(("report", "sys.metrics", Weird())) is None
+    assert proto.encode_message(("register", "w1", 42)) is None  # not wire
+    assert proto.wire_fallbacks["task_done"] == \
+        before.get("task_done", 0) + 1
+    assert proto.wire_fallbacks["report"] == before.get("report", 0) + 1
+    assert proto.wire_fallbacks.get("register", 0) == \
+        before.get("register", 0)
+
+
+def test_report_frames_ride_binary_wire():
+    """PR-8 leftover: telemetry delta reports (sys.metrics / sys.spans
+    payloads) are framework-pure and must take the msgpack path."""
+    payload = {"metrics": [{"name": "m", "kind": "counter", "help": "h",
+                            "boundaries": None,
+                            "series": [[[["worker_id", "w1"]], 3.0]]}]}
+    body = proto.encode_message(("report", "sys.metrics", payload))
+    assert body is not None and body[0] & 0xF0 == 0xB0
+    kind, channel, decoded = proto.decode_message(body)
+    assert (kind, channel) == ("report", "sys.metrics")
+    assert decoded["metrics"][0]["name"] == "m"
+
+
 # ---------- fuzz: random nested payloads ----------
 
 def _rand_value(rng, depth=0):
