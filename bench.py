@@ -1154,6 +1154,115 @@ def phase_events() -> dict:
     return result
 
 
+def phase_obs() -> dict:
+    """Observability fast-path overhead A/B (no jax in the measured
+    path): no-op task round-trips/s AND compiled-DAG execs/s with the
+    flight recorder + sampling profiler ON (RAY_TPU_FASTPATH_SPANS=1,
+    RAY_TPU_PROFILE_HZ=25) vs fully OFF. The acceptance bar is < 2%
+    throughput overhead on both legs; the result lands in
+    BENCH_OBS.json and tests/test_perfdiff.py gates it thereafter."""
+    import collections as _c
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    n = int(os.environ.get("RAY_TPU_BENCH_OBS_TASKS", "600"))
+    n_dag = int(os.environ.get("RAY_TPU_BENCH_OBS_DAG_EXECS", "300"))
+    window = 32
+
+    def measure(label: str):
+        rt = ray_tpu.init(num_cpus=3)
+
+        @ray_tpu.remote
+        def _noop():
+            return None
+
+        @ray_tpu.remote
+        def _inc(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def _dbl(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def _dec(x):
+            return x - 1
+
+        ray_tpu.get([_noop.remote() for _ in range(32)], timeout=120)
+        tasks = 0.0
+        for _ in range(3):
+            t0 = time.time()
+            ray_tpu.get([_noop.remote() for _ in range(n)], timeout=600)
+            tasks = max(tasks, n / (time.time() - t0))
+        with InputNode() as inp:
+            dag = _dec.bind(_dbl.bind(_inc.bind(inp)))
+        comp = dag.experimental_compile()
+        execs = 0.0
+        if comp.stats["mode"] == "pipelined":
+            assert ray_tpu.get(comp.execute(7), timeout=120) == 15
+            for _ in range(2):
+                pend = _c.deque()
+                t0 = time.time()
+                for i in range(n_dag):
+                    pend.append((i, comp.execute(i)))
+                    if len(pend) >= window:
+                        j, ref = pend.popleft()
+                        assert ray_tpu.get(ref, timeout=120) \
+                            == (j + 1) * 2 - 1
+                while pend:
+                    j, ref = pend.popleft()
+                    assert ray_tpu.get(ref, timeout=120) \
+                        == (j + 1) * 2 - 1
+                execs = max(execs, n_dag / (time.time() - t0))
+        comp.close()
+        del rt
+        ray_tpu.shutdown()
+        _progress(f"obs[{label}]: {tasks:.0f} noop tasks/s, "
+                  f"{execs:.0f} dag execs/s")
+        return tasks, execs
+
+    # Interleaved A/B, best-of per arm (same discipline as
+    # phase_events: never let one arm ride a warmer process). The
+    # knobs are plain env reads, so each arm's fresh runtime — and its
+    # forked workers — see them at init.
+    on_t = off_t = on_d = off_d = 0.0
+    try:
+        for _round in range(3):
+            os.environ["RAY_TPU_FASTPATH_SPANS"] = "1"
+            os.environ["RAY_TPU_PROFILE_HZ"] = "25"
+            t, d = measure("recorder+profiler ON")
+            on_t, on_d = max(on_t, t), max(on_d, d)
+            os.environ["RAY_TPU_FASTPATH_SPANS"] = "0"
+            os.environ["RAY_TPU_PROFILE_HZ"] = "0"
+            t, d = measure("recorder+profiler OFF")
+            off_t, off_d = max(off_t, t), max(off_d, d)
+    finally:
+        os.environ.pop("RAY_TPU_FASTPATH_SPANS", None)
+        os.environ.pop("RAY_TPU_PROFILE_HZ", None)
+
+    result = {
+        "noop_tasks_per_s_obs_on": round(on_t, 1),
+        "noop_tasks_per_s_obs_off": round(off_t, 1),
+        "dag_execs_per_s_obs_on": round(on_d, 1),
+        "dag_execs_per_s_obs_off": round(off_d, 1),
+        "task_overhead_pct": round((off_t - on_t) / off_t * 100.0, 2)
+        if off_t else None,
+        "dag_overhead_pct": round((off_d - on_d) / off_d * 100.0, 2)
+        if off_d else None,
+        "n_calls": n, "n_dag_execs": n_dag, "profile_hz": 25,
+        "platform": "cpu",
+        "note": "overhead_pct < 0 means the ON run measured faster "
+                "(noise floor)",
+    }
+    try:
+        with open(os.path.join(REPO, "BENCH_OBS.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        _progress(f"BENCH_OBS.json write failed (non-fatal): {e}")
+    return result
+
+
 def phase_recovery() -> dict:
     """Recovery-plane benchmark (no jax in the measured path), two
     numbers into BENCH_RECOVERY.json: (1) happy-path lineage-recording
@@ -2233,7 +2342,8 @@ def main():
     ap.add_argument("--phase",
                     choices=["kernels", "train", "train-llama", "serve",
                              "flash-ab", "probe-8b", "data", "core",
-                             "dag", "events", "recovery", "serve_ft",
+                             "dag", "events", "obs", "recovery",
+                             "serve_ft",
                              "serve_scale", "driver_ft", "train_ft"])
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
@@ -2254,6 +2364,7 @@ def main():
                  "core": phase_core,
                  "dag": phase_dag,
                  "events": phase_events,
+                 "obs": phase_obs,
                  "recovery": phase_recovery,
                  "serve_ft": phase_serve_ft,
                  "serve_scale": phase_serve_scale,

@@ -382,6 +382,90 @@ def cmd_post_mortem(args):
               f"death_cause={a['death_cause'] or '-'}")
 
 
+def _post(address: str, route: str, payload: dict):
+    url = address.rstrip("/") + route
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        sys.stderr.write(f"error: {url} -> HTTP {e.code}: {body}\n")
+        sys.exit(2)
+    except (urllib.error.URLError, OSError) as e:
+        sys.stderr.write(
+            f"error: cannot reach dashboard at {address} ({e})\n")
+        sys.exit(2)
+
+
+def _collapsed_from_payload(payload: dict) -> str:
+    """Worker snapshot payload -> collapsed-stack text (same format as
+    the driver store's aggregate export)."""
+    merged = {}
+    for task, stack, n in payload.get("samples") or ():
+        line = f"task:{task};{stack}" if task else stack
+        merged[line] = merged.get(line, 0) + n
+    return "\n".join(f"{s} {n}" for s, n in
+                     sorted(merged.items(), key=lambda kv: -kv[1]))
+
+
+def cmd_profile(args):
+    """`ray_tpu profile` — the always-on sampling profiler
+    (docs/OBSERVABILITY.md). `show` (default) exports the driver-side
+    aggregate; start/stop/snapshot/status drive one worker's sampler
+    live over the control plane."""
+    action = args.action
+    if action in ("start", "stop", "snapshot", "status"):
+        if not args.worker:
+            sys.stderr.write(f"error: profile {action} needs "
+                             "--worker <worker id>\n")
+            sys.exit(2)
+        payload = {"worker": args.worker, "action": action}
+        if action == "start":
+            payload["hz"] = args.hz
+        reply = _post(args.address, "/api/profile", payload)
+        if action == "snapshot" and args.format == "collapsed":
+            text = _collapsed_from_payload(reply)
+            if args.output:
+                with open(args.output, "w") as f:
+                    f.write(text + "\n")
+                print(f"wrote {args.output}")
+            else:
+                print(text)
+            return
+        print(json.dumps(reply, indent=2))
+        return
+    # show: driver-side aggregate, collapsed / speedscope / summary
+    from urllib.parse import urlencode
+    params = {"format": args.format}
+    if args.worker:
+        params["worker"] = args.worker
+    if args.task:
+        params["task"] = args.task
+    route = "/api/profile?" + urlencode(params)
+    if args.format == "collapsed":
+        text = _open(args.address, route).decode()
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.output} (flamegraph.pl or paste into "
+                  "speedscope.app)")
+        else:
+            print(text)
+        return
+    data = _fetch(args.address, route)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(data, f)
+        print(f"wrote {args.output}"
+              + (" (open at https://www.speedscope.app)"
+                 if args.format == "speedscope" else ""))
+    else:
+        print(json.dumps(data, indent=2))
+
+
 def cmd_job(args):
     from .core.jobs import JobSubmissionClient
     # submit runs the entrypoint as a local child unless --remote sends
@@ -595,6 +679,26 @@ def main(argv=None):
     mp.add_argument("--grep", default="",
                     help="only show metrics whose name contains this")
     mp.set_defaults(fn=cmd_metrics)
+
+    prp = sub.add_parser(
+        "profile", help="sampling profiler: export the cluster "
+                        "aggregate or start/stop/snapshot one worker's "
+                        "sampler live")
+    prp.add_argument("action", nargs="?", default="show",
+                     choices=["show", "start", "stop", "snapshot",
+                              "status"])
+    prp.add_argument("--worker", default=None,
+                     help="worker id (required for start/stop/"
+                          "snapshot/status; filters `show`)")
+    prp.add_argument("--task", default=None,
+                     help="filter `show` to one task id")
+    prp.add_argument("--hz", type=float, default=100.0,
+                     help="sampling rate for `start` (default 100)")
+    prp.add_argument("--format", default="collapsed",
+                     choices=["collapsed", "speedscope", "summary"],
+                     help="`show`/`snapshot` output format")
+    prp.add_argument("-o", "--output", default=None)
+    prp.set_defaults(fn=cmd_profile)
 
     svp = sub.add_parser("serve", help="serve an Application over HTTP")
     svsub = svp.add_subparsers(dest="serve_cmd", required=True)

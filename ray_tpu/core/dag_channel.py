@@ -34,6 +34,7 @@ from __future__ import annotations
 import pickle
 import queue
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
@@ -72,6 +73,11 @@ class ChannelWriter:
         self._depth = max(1, knobs.get_int("RAY_TPU_DAG_CHANNEL_DEPTH"))
         self._outstanding: "deque[int]" = deque()
         self._closed = False
+        # cumulative seconds this writer spent BLOCKED on the consumer
+        # ack window (only time where the window actually forced a
+        # wait): the per-stage spans read deltas off it so backpressure
+        # stalls are attributed to the stage that paid them
+        self.stall_s = 0.0
 
     def open(self) -> None:
         try:
@@ -86,6 +92,20 @@ class ChannelWriter:
         """Block until at most `max_outstanding` seqnos await acks.
         Acks arrive strictly in seqno order (the reader consumes in
         order), so each recv must match the oldest outstanding."""
+        if len(self._outstanding) <= max_outstanding:
+            return
+        t0 = time.monotonic()
+        try:
+            self._drain_acks_blocking(max_outstanding)
+        finally:
+            dt = time.monotonic() - t0
+            self.stall_s += dt
+            try:
+                _mcat().get("ray_tpu_dag_channel_stall_seconds").inc(dt)
+            except Exception:
+                pass
+
+    def _drain_acks_blocking(self, max_outstanding: int) -> None:
         while len(self._outstanding) > max_outstanding:
             expect = self._outstanding[0]
             try:

@@ -27,6 +27,8 @@ dead — `CompiledDAG.execute()` then transparently re-compiles.
 """
 from __future__ import annotations
 
+import collections
+import os
 import threading
 import time
 import traceback
@@ -35,6 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..exceptions import CompiledDagError, GetTimeoutError, TaskError
 from ..util import knobs
+from ..util import tracing
 from .dag_channel import (ChannelClosed, ChannelHost, ChannelReader,
                           ChannelWriter)
 from .protocol import ConnectionClosed
@@ -44,6 +47,12 @@ from .protocol import ConnectionClosed
 # channel handshake already bounds UNdelivered executions to the
 # pipeline depth).
 _RESULT_BUFFER_CAP = 1024
+
+# Flight-recorder ring capacity (per dag per process). Spans recorded
+# beyond this between two telemetry flushes are dropped oldest-first
+# and counted in ray_tpu_trace_spans_dropped_total — the recorder is
+# always-on, so its worst case must be a bounded window, not a queue.
+_SPAN_RING_CAP = 4096
 
 
 def _mcat():
@@ -75,7 +84,7 @@ def eval_input_expr(expr: Tuple, input_args: Tuple,
 
 class _WorkerDag:
     __slots__ = ("dag_id", "stages", "readers", "in_order", "input_ch",
-                 "writers", "thread", "stop")
+                 "writers", "thread", "stop", "span_ring", "span_drops")
 
     def __init__(self, dag_id: str):
         self.dag_id = dag_id
@@ -86,6 +95,15 @@ class _WorkerDag:
         self.writers: Dict[str, ChannelWriter] = {}
         self.thread: Optional[threading.Thread] = None
         self.stop = False
+        # flight-recorder ring: (sid, seq, t0, t1, stall) tuples. The
+        # exec loop only pays this append; span dicts, derived ids and
+        # histogram observes happen at telemetry-flush cadence
+        # (drain_stage_spans). Bounded so a stalled flusher can never
+        # grow memory — overflow counts into
+        # ray_tpu_trace_spans_dropped_total.
+        self.span_ring: collections.deque = collections.deque(
+            maxlen=_SPAN_RING_CAP)
+        self.span_drops = 0
 
 
 class WorkerDagContext:
@@ -111,6 +129,23 @@ class WorkerDagContext:
             host = self._ensure_host()
             d = _WorkerDag(dag_id)
             d.stages = plan["stages"]
+            for st in d.stages:
+                # static flight-recorder parent of this stage's spans:
+                # first upstream-stage arg (local or channel) wins;
+                # input-fed / dependency-free stages parent to the
+                # driver's exec-submit span. Resolved once here so the
+                # per-seqno path derives ids from a plain key.
+                pkey = "drv"
+                for ent in (list(st["args"])
+                            + list(st["kwargs"].values())):
+                    if ent[0] == "lo":
+                        pkey = ent[1]
+                        break
+                    if ent[0] == "ch":
+                        # ch_id format: "<dag_id>.<sid>.<consumer_wid>"
+                        pkey = ent[1].split(".")[1]
+                        break
+                st["_span_parent"] = pkey
             d.in_order = list(plan["in_chans"])
             d.input_ch = plan.get("input_ch")
             for ch_id in d.in_order:
@@ -152,6 +187,15 @@ class WorkerDagContext:
                 self._host.unregister(ch_id)
         for w in d.writers.values():
             w.close()
+        try:
+            # the dag left the registry above — convert whatever its
+            # ring still holds so teardown never loses recorded spans
+            leftover: List[dict] = []
+            self._drain_dag_spans(d, leftover)
+            for sp in leftover:
+                self._loop.record_span(sp)
+        except Exception:
+            pass
 
     def teardown_all(self) -> None:
         for dag_id in list(self._dags):
@@ -190,8 +234,16 @@ class WorkerDagContext:
             except ChannelClosed as e:
                 self._report_down(d, repr(e))
                 return
+            spans_on = knobs.get_bool("RAY_TPU_FASTPATH_SPANS")
+            stage_t: Dict[int, Tuple[float, float]] = {}
             for st in d.stages:
+                t0 = time.time()
                 vals[("lo", st["sid"])] = self._run_stage(d, st, vals)
+                stage_t[st["sid"]] = (t0, time.time())
+            # per-writer stall baselines: the write loop below may block
+            # on ack windows, and each stage's span attributes exactly
+            # the stall its own out-channels paid this seqno
+            stall0 = {ch_id: w.stall_s for ch_id, w in d.writers.items()}
             try:
                 for st in d.stages:
                     for ch_id in st["outs"]:
@@ -200,6 +252,92 @@ class WorkerDagContext:
             except CompiledDagError as e:
                 self._report_down(d, repr(e))
                 return
+            if spans_on:
+                try:
+                    # hot path records a tuple per stage, nothing more;
+                    # drain_stage_spans does the expensive conversion
+                    # at telemetry-flush cadence
+                    ring = d.span_ring
+                    for st in d.stages:
+                        sid = st["sid"]
+                        t0, t1 = stage_t[sid]
+                        stall = sum(
+                            d.writers[ch].stall_s - stall0.get(ch, 0.0)
+                            for ch in st["outs"] if ch in d.writers)
+                        if len(ring) == ring.maxlen:
+                            d.span_drops += 1
+                        ring.append((sid, seq, t0, t1, stall))
+                except Exception:
+                    pass   # flight recorder must never fail the pipeline
+
+    def drain_stage_spans(self) -> List[dict]:
+        """Convert buffered (sid, seq, t0, t1, stall) ring entries into
+        full span dicts — OFF the per-seqno hot path, at telemetry-flush
+        cadence. Span ids are DERIVED from (dag_id, sid, seqno), so the
+        upstream stage — in a different process — produced the exact
+        parent id this side derives locally: the cross-worker tree needs
+        zero coordination and zero extra wire traffic (spans ride the
+        telemetry heartbeat, keeping the steady-state ctrl counters
+        flat)."""
+        with self._lock:
+            dags = list(self._dags.values())
+        out: List[dict] = []
+        for d in dags:
+            self._drain_dag_spans(d, out)
+        return out
+
+    def _drain_dag_spans(self, d: _WorkerDag, out: List[dict]) -> None:
+        ring = d.span_ring
+        if not ring and not d.span_drops:
+            return
+        drops, d.span_drops = d.span_drops, 0
+        if drops:
+            try:
+                _mcat().get(
+                    "ray_tpu_trace_spans_dropped_total").inc(drops)
+            except Exception:
+                pass
+        wid = self._loop.worker_id
+        pid = os.getpid()
+        node_id = knobs.get_raw("RAY_TPU_NODE_ID")
+        by_sid = {st["sid"]: st for st in d.stages}
+        durs: Dict[int, List[float]] = {}
+        tid_cache: Dict[int, str] = {}
+        while True:
+            try:
+                sid, seq, t0, t1, stall = ring.popleft()
+            except IndexError:
+                break
+            st = by_sid.get(sid) or {}
+            trace_id = tid_cache.get(seq)
+            if trace_id is None:
+                trace_id = tracing.derived_trace_id(d.dag_id, seq)
+                tid_cache[seq] = trace_id
+            span = {
+                "trace_id": trace_id,
+                "span_id": tracing.derived_span_id(
+                    d.dag_id, sid, seq),
+                "parent_span_id": tracing.derived_span_id(
+                    d.dag_id, st.get("_span_parent", "drv"), seq),
+                "task_id": f"{d.dag_id}.{sid}",
+                "name": st.get("name") or f"dag_stage:{sid}",
+                "cat": "dag_stage",
+                "dag_id": d.dag_id, "sid": sid, "seqno": seq,
+                "start": t0, "end": t1, "status": "ok",
+                "pid": pid, "worker_id": wid,
+                "node_id": node_id,
+            }
+            if stall > 0:
+                span["ack_stall_s"] = stall
+            out.append(span)
+            durs.setdefault(sid, []).append(t1 - t0)
+        for sid, vals in durs.items():
+            try:
+                _mcat().get(
+                    "ray_tpu_dag_stage_exec_seconds").observe_many(
+                    vals, tags={"dag_id": d.dag_id, "sid": str(sid)})
+            except Exception:
+                pass
 
     def _run_stage(self, d: _WorkerDag, st: dict,
                    vals: Dict[Tuple, Any]) -> Any:
@@ -295,12 +433,22 @@ class DriverDagController:
         self._drv_exprs: List[Tuple] = list(cplan.get("drv_exprs") or ())
         self._term_by_sid: Dict[int, str] = {}
         self.stats = {"execs": 0, "channels": 0, "workers": 0}
+        # driver-side flight-recorder ring: execute()/_collect() append
+        # bare tuples; _drain_spans converts to span dicts off the hot
+        # path (on worker-span ingest and timeline export)
+        self._span_ring: collections.deque = collections.deque(
+            maxlen=_SPAN_RING_CAP)
+        self._span_drops = 0
         timeout = knobs.get_float("RAY_TPU_DAG_COMPILE_TIMEOUT_S")
         try:
             self._compile(cplan, timeout)
         except BaseException:
             self._teardown("compile failed")
             raise
+        try:
+            rt._span_drains.append(self._drain_spans)
+        except Exception:
+            pass
 
     # -- compile ------------------------------------------------------------
     def _compile(self, cplan: dict, timeout: float) -> None:
@@ -519,11 +667,71 @@ class DriverDagController:
             pass
         self._teardown(err.cause or "failure")
 
+    def _drain_spans(self) -> None:
+        """Convert buffered driver-side ring entries (exec submits,
+        result arrivals) into span dicts on rt.trace_spans. Runs on
+        worker-span ingest / timeline export — never on the execute()
+        hot path."""
+        ring = self._span_ring
+        if not ring and not self._span_drops:
+            return
+        drops, self._span_drops = self._span_drops, 0
+        if drops:
+            try:
+                _mcat().get(
+                    "ray_tpu_trace_spans_dropped_total").inc(drops)
+            except Exception:
+                pass
+        pid = os.getpid()
+        node_id = getattr(self.rt, "node_id", "")
+        while True:
+            try:
+                kind, sid, seq, t0, t1 = ring.popleft()
+            except IndexError:
+                break
+            if kind == "drv":
+                span = {
+                    "trace_id": tracing.derived_trace_id(
+                        self.dag_id, seq),
+                    "span_id": tracing.derived_span_id(
+                        self.dag_id, "drv", seq),
+                    "parent_span_id": "",
+                    "task_id": f"{self.dag_id}.exec",
+                    "name": f"dag_exec:{self.dag_id}",
+                    "cat": "dag_submit",
+                    "dag_id": self.dag_id, "seqno": seq,
+                    "start": t0, "end": t1,
+                    "status": "ok", "pid": pid,
+                    "worker_id": "driver", "node_id": node_id,
+                }
+            else:
+                span = {
+                    "trace_id": tracing.derived_trace_id(
+                        self.dag_id, seq),
+                    "span_id": tracing.derived_span_id(
+                        self.dag_id, "res", sid, seq),
+                    "parent_span_id": tracing.derived_span_id(
+                        self.dag_id, sid, seq),
+                    "task_id": f"{self.dag_id}.{sid}",
+                    "name": f"dag_result:{sid}",
+                    "cat": "dag_result",
+                    "dag_id": self.dag_id, "sid": sid, "seqno": seq,
+                    "start": t0, "end": t1, "status": "ok",
+                    "pid": pid, "worker_id": "driver",
+                    "node_id": node_id,
+                }
+            self.rt.trace_spans.append(span)
+
     def _teardown(self, reason: str) -> None:
         if self._torn_down:
             return
         self._torn_down = True
         self.dead = True
+        try:
+            self._drain_spans()
+            self.rt._span_drains.remove(self._drain_spans)
+        except Exception:
+            pass
         if self._failure is None:
             self._failure = CompiledDagError("compiled DAG torn down",
                                              cause=reason)
@@ -557,6 +765,7 @@ class DriverDagController:
     # -- execute ------------------------------------------------------------
     def execute(self, input_args: Tuple,
                 input_kwargs: Dict[str, Any]) -> int:
+        t_submit = time.time()
         with self._exec_lock:
             if self.dead:
                 raise self._failure
@@ -585,6 +794,18 @@ class DriverDagController:
                 tags={"mode": "pipelined"})
         except Exception:
             pass
+        if knobs.get_bool("RAY_TPU_FASTPATH_SPANS"):
+            try:
+                # driver-local root span of this execution: input-fed
+                # stages derive this exact id as their parent. Only a
+                # tuple append here — _drain_spans builds the dict off
+                # the submit path
+                ring = self._span_ring
+                if len(ring) == ring.maxlen:
+                    self._span_drops += 1
+                ring.append(("drv", None, seq, t_submit, time.time()))
+            except Exception:
+                pass
         return seq
 
     def make_ref(self, seq: int, slot: Tuple) -> CompiledDagRef:
@@ -601,11 +822,25 @@ class DriverDagController:
                 seq, value = reader.read_value()
             except ChannelClosed:
                 return
+            now = time.time()
             with self._cond:
                 ent = self._inflight.get(seq)
                 if ent is not None:
                     ent["ch"][ch_id] = value
                     self._cond.notify_all()
+            if knobs.get_bool("RAY_TPU_FASTPATH_SPANS"):
+                try:
+                    # instant span marking the result's arrival at the
+                    # driver, parented to the terminal stage's derived
+                    # span (ch_id: "<dag_id>.<sid>.drv"). Tuple append
+                    # only — converted by _drain_spans
+                    ring = self._span_ring
+                    if len(ring) == ring.maxlen:
+                        self._span_drops += 1
+                    ring.append(("res", ch_id.split(".")[1], seq,
+                                 now, now))
+                except Exception:
+                    pass
 
     def get_slot(self, seq: int, slot: Tuple,
                  timeout: Optional[float] = None) -> Any:

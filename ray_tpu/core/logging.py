@@ -59,6 +59,14 @@ def mark_current_task(task_id: Optional[str]) -> None:
     os.write to fd 1 keeps ordering with both Python prints (flushed
     first) and native writes, which share the O_APPEND fd. No-op when
     output was never redirected (interactive worker: no file to tag)."""
+    try:
+        # the sampling profiler shares the task markers: tell it which
+        # task now owns this thread BEFORE the redirect check, so
+        # attribution works even in interactive (unredirected) workers
+        from ..observability import sampling_profiler  # noqa: PLC0415
+        sampling_profiler.mark_thread(task_id)
+    except Exception:
+        pass
     if not _redirected:
         return
     try:

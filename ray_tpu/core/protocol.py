@@ -88,6 +88,8 @@ WIRE_KINDS = frozenset({
     # (tuple-keyed series ride msgpack maps); report channels carrying
     # arbitrary user payloads fall back per-frame like any other kind
     "report",
+    # on-demand profiler control (driver -> worker) and its reply
+    "profile_ctl", "profile_reply",
 })
 
 # Per-kind count of frames that attempted binary framing and fell back
@@ -132,7 +134,7 @@ _SPEC_PURE_FIELDS = (
     "actor_id", "method_name", "concurrency_group",
     "placement_group_id", "bundle_index", "func_id", "dep_object_ids",
     "reconstructions", "trace_id", "span_id", "parent_span_id",
-    "tpu_ids",
+    "tpu_ids", "lease_id",
 )
 
 _LOC_FIELDS = ("kind", "size", "data", "name", "node_id", "spill_path",

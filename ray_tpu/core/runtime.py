@@ -467,6 +467,10 @@ class DriverRuntime:
         self.cluster_metrics = ClusterMetricsStore()
         self.trace_spans: collections.deque = collections.deque(
             maxlen=8192)
+        # deferred driver-side span producers (compiled-DAG controllers
+        # buffer submit/result markers in bounded rings; see
+        # drain_fastpath_spans)
+        self._span_drains: List[Any] = []
 
         # cluster event plane (util/events.py): lifecycle events from
         # this process and every worker/node-agent merge here, indexed
@@ -474,6 +478,18 @@ class DriverRuntime:
         # and post-mortem bundles
         from ..util.events import ClusterEventStore  # noqa: PLC0415
         self.cluster_events = ClusterEventStore()
+
+        # cluster profile plane (observability/sampling_profiler.py):
+        # workers ship folded-stack deltas over sys.profile on the same
+        # telemetry heartbeat as metrics/spans; profile_ctl round-trips
+        # (start/stop/snapshot) resolve through rid-keyed futures like
+        # cross-node fetches
+        from ..observability.sampling_profiler import \
+            ClusterProfileStore  # noqa: PLC0415
+        self.profile_store = ClusterProfileStore()
+        self._profile_counter = 0
+        self._profile_lock = threading.Lock()
+        self._profile_replies: Dict[int, Tuple[threading.Event, dict]] = {}
         self._node_hb_timeout = knobs.get_float(
             "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S")
         # heartbeat-DECLARED death: a node silent past this long is
@@ -516,6 +532,7 @@ class DriverRuntime:
         self.report_handlers["sys.metrics"] = self._on_worker_metrics
         self.report_handlers["sys.spans"] = self._on_worker_spans
         self.report_handlers["sys.events"] = self._on_worker_events
+        self.report_handlers["sys.profile"] = self._on_worker_profile
         # control-plane actors (the serve controller's autoscaler) need
         # the node table and placement-group ops; both live only in the
         # driver, so workers reach them over report_sync channels
@@ -1171,6 +1188,13 @@ class DriverRuntime:
             ctl = self.compiled_dags.get(m[1])
             if ctl is not None:
                 ctl.on_down(m[2], m[3])
+        elif mtype == "profile_reply":
+            _, rid, payload = m
+            with self._profile_lock:
+                pair = self._profile_replies.get(rid)
+            if pair is not None:
+                pair[1]["payload"] = payload
+                pair[0].set()
         elif mtype == "report":
             h = self.report_handlers.get(m[1])
             if h:
@@ -2738,6 +2762,15 @@ class DriverRuntime:
                     self.pending_tasks.popleft()
                     self._pending_since.pop(cand.task_id, None)
                     lease.append(cand)
+            if len(lease) > 1:
+                # Stamp the lease id onto every spec BEFORE the wire
+                # send: the worker's exec spans carry it as a span
+                # attribute, so the timeline can join a multi-task
+                # grant back to the lease_grant span without any extra
+                # frames (flight recorder, docs/OBSERVABILITY.md).
+                lid = f"lease-{w.worker_id}-{self.lease_grants + 1}"
+                for s in lease:
+                    s.lease_id = lid
             try:
                 if len(lease) == 1:
                     w.conn.send(("exec_task", spec))
@@ -2782,6 +2815,21 @@ class DriverRuntime:
                            f"{len(lease)}-slot task lease",
                            worker_id=w.worker_id, node_id=w.node_id,
                            task_id=spec.task_id, slots=len(lease))
+                if knobs.get_bool("RAY_TPU_FASTPATH_SPANS"):
+                    # driver-local instant span: zero wire traffic,
+                    # joined to the workers' exec spans by lease_id
+                    self.trace_spans.append({
+                        "trace_id": spec.trace_id,
+                        "span_id": spec.lease_id,
+                        "parent_span_id": spec.parent_span_id,
+                        "task_id": spec.task_id,
+                        "name": f"lease_grant:{len(lease)}",
+                        "cat": "lease_grant",
+                        "start": now, "end": now, "status": "ok",
+                        "pid": os.getpid(), "worker_id": "driver",
+                        "node_id": self.node_id,
+                        "lease_id": spec.lease_id,
+                        "slots": len(lease)})
                 try:
                     _mcat().get("ray_tpu_lease_grants_total").inc()
                     _mcat().get("ray_tpu_dispatch_batch_size").observe(
@@ -4217,7 +4265,20 @@ class DriverRuntime:
         self.cluster_metrics.ingest(
             {"node_id": node, "worker_id": wid}, payload)
 
+    def drain_fastpath_spans(self) -> None:
+        """Flush deferred driver-side span rings (compiled-DAG submit
+        and result markers) into trace_spans. Runs when worker spans
+        are ingested and when the timeline is exported, so readers see
+        the complete parented tree without the execute() hot path ever
+        paying dict-build or id-derivation costs."""
+        for fn in list(self._span_drains):
+            try:
+                fn()
+            except Exception:
+                pass
+
     def _on_worker_spans(self, wid: str, payload) -> None:
+        self.drain_fastpath_spans()
         w = self.workers.get(wid)
         node = (w.node_id if w is not None and w.node_id else None) \
             or self.node_id
@@ -4228,6 +4289,36 @@ class DriverRuntime:
             if not sp.get("node_id"):
                 sp["node_id"] = node
             self.trace_spans.append(sp)
+
+    def _on_worker_profile(self, wid: str, payload) -> None:
+        self.profile_store.ingest(wid, payload)
+
+    def profile_ctl(self, worker_id: str, action: str,
+                    arg: Any = None, timeout: float = 5.0) -> dict:
+        """Drive one worker's sampling profiler over the control plane:
+        action in {"start", "stop", "snapshot", "status"} (arg = hz for
+        start). Blocks for the worker's reply (sub-ms handler on its
+        reader thread) and returns the reply payload."""
+        conn = self._conn_by_wid.get(worker_id)
+        w = self.workers.get(worker_id)
+        if conn is None or w is None or w.state == "dead":
+            raise ValueError(f"no live worker {worker_id!r}")
+        ev = threading.Event()
+        box: dict = {}
+        with self._profile_lock:
+            self._profile_counter += 1
+            rid = self._profile_counter
+            self._profile_replies[rid] = (ev, box)
+        try:
+            conn.send(("profile_ctl", rid, action, arg))
+            if not ev.wait(timeout):
+                raise TimeoutError(
+                    f"profile_ctl({action}) to {worker_id} timed out "
+                    f"after {timeout}s")
+        finally:
+            with self._profile_lock:
+                self._profile_replies.pop(rid, None)
+        return box.get("payload", {})
 
     # ---------------- event plane ----------------
     def _emit(self, event_type: str, message: str = "", **fields) -> None:

@@ -58,6 +58,10 @@ class TaskSpec:
     trace_id: str = ""
     span_id: str = ""
     parent_span_id: str = ""
+    # multi-slot lease this spec was granted under (runtime lease
+    # dispatch stamps it); the worker's exec span carries it so the
+    # timeline links every slot back to its lease-grant span
+    lease_id: str = ""
 
 
 @dataclasses.dataclass

@@ -259,6 +259,11 @@ class WorkerRuntime:
         # real one before the first task runs); the default passthrough
         # keeps early sends working
         self._batch = _MsgBatcher(conn, enabled=False)
+        # WorkerLoop points this at its span buffer so fast-path
+        # instrumentation (direct-call submits, DAG stages) can record
+        # spans that ride the telemetry heartbeat — never the control
+        # plane
+        self._span_sink = None
         # ---- driver-bypass actor calls (docs/SCHEDULING.md) ----
         self._direct_enabled = knobs.get_bool("RAY_TPU_DIRECT_CALLS")
         self._direct_lock = threading.Lock()
@@ -473,6 +478,28 @@ class WorkerRuntime:
             mcat.get("ray_tpu_direct_actor_calls_total").inc()
         except Exception:
             pass
+        # flight recorder: the SUBMIT span of a driver-bypass call is
+        # recorded by the CALLER (the driver never sees the task); the
+        # callee's exec span parents to spec.span_id as usual, so the
+        # timeline stays a single tree with zero driver hops
+        if self._span_sink is not None \
+                and knobs.get_bool("RAY_TPU_FASTPATH_SPANS"):
+            try:
+                now = time.time()
+                self._span_sink({
+                    "trace_id": getattr(spec, "trace_id", "") or "",
+                    "span_id": getattr(spec, "span_id", "") or "",
+                    "parent_span_id":
+                        getattr(spec, "parent_span_id", "") or "",
+                    "task_id": spec.task_id,
+                    "name": f"dcall:{spec.method_name}",
+                    "cat": "dcall_submit",
+                    "start": now, "end": now, "status": "ok",
+                    "pid": os.getpid(), "worker_id": self.worker_id,
+                    "node_id": knobs.get_raw("RAY_TPU_NODE_ID"),
+                })
+            except Exception:
+                pass
         return True
 
     # ---- core verbs -------------------------------------------------------
@@ -935,6 +962,12 @@ class WorkerLoop:
         self._telemetry_lock = threading.Lock()
         self._last_flush = 0.0
         self._heartbeat_on = True   # set from env in run()
+        self.rt._span_sink = self.record_span
+        # always-on sampling profiler (off at hz=0; profile_ctl can
+        # start/stop/snapshot it at runtime)
+        from ..observability import sampling_profiler  # noqa: PLC0415
+        self._profiler = sampling_profiler.SamplingProfiler(
+            hz=knobs.get_float("RAY_TPU_PROFILE_HZ"))
         # __ray_save__ checkpoint shipping (actors that define the hook)
         self._ckpt_lock = threading.Lock()
         self._last_ckpt = 0.0
@@ -979,6 +1012,14 @@ class WorkerLoop:
                 self._create_actor(payload)
             elif kind == "actor_task":
                 self._dispatch_actor_task(payload)
+        # graceful exit: ship whatever the batcher and the telemetry
+        # buffers still hold — the final spans/events/metric deltas of
+        # a completed job must not die with the process
+        try:
+            self._batch.flush()
+        except Exception:
+            pass
+        self._flush_telemetry()
         try:
             self.conn.close()
         except Exception:
@@ -1053,6 +1094,9 @@ class WorkerLoop:
             elif mtype == "dag_teardown":
                 if self._dag_ctx is not None:
                     self._dag_ctx.teardown(msg[1])
+            elif mtype == "profile_ctl":
+                self._handle_profile_ctl(
+                    msg[1], msg[2], msg[3] if len(msg) > 3 else None)
             elif mtype == "shutdown":
                 if self._dag_ctx is not None:
                     self._dag_ctx.teardown_all()
@@ -1064,20 +1108,77 @@ class WorkerLoop:
         LLM engine records tokens continuously with no task boundary)."""
         while not self._shutdown.is_set():
             time.sleep(interval)
+            self._memory_gauges()
             self._flush_telemetry()
+
+    def _memory_gauges(self) -> None:
+        """Per-device HBM + host RSS gauges, refreshed per heartbeat
+        (observability/profiler.py's memory accounting wired into the
+        metrics plane; {} on backends without memory_stats)."""
+        try:
+            from ..observability import profiler  # noqa: PLC0415
+            mcat.get("ray_tpu_worker_host_rss_bytes").set(
+                profiler.host_rss_bytes())
+            for dev, used in profiler.hbm_usage().items():
+                mcat.get("ray_tpu_worker_hbm_used_bytes").set(
+                    used, tags={"device": dev})
+        except Exception:
+            pass
+
+    def _handle_profile_ctl(self, rid, action, arg) -> None:
+        """On-demand profiler control (runs on the reader thread: every
+        action is sub-millisecond and never blocks on user work)."""
+        prof = self._profiler
+        try:
+            if action == "start":
+                hz = float(arg) if arg else 100.0
+                prof.set_hz(hz)
+                events_mod.emit(
+                    "worker.profile.start",
+                    f"sampling profiler started at {hz:g} Hz",
+                    worker_id=self.worker_id, hz=hz)
+                payload = prof.status()
+            elif action == "stop":
+                prof.stop()
+                events_mod.emit(
+                    "worker.profile.stop", "sampling profiler stopped",
+                    worker_id=self.worker_id)
+                payload = prof.status()
+            elif action == "snapshot":
+                payload = prof.snapshot()
+            else:
+                payload = prof.status()
+        except Exception as e:  # noqa: BLE001
+            payload = {"error": repr(e)}
+        try:
+            self.conn.send(("profile_reply", rid, payload))
+        except Exception:
+            pass   # driver gone; nothing to reply to
+
+    def record_span(self, span: dict) -> None:
+        """Buffer an externally-built span record (fast-path
+        instrumentation: dcall submits, compiled-DAG stages) for the
+        next telemetry flush — spans ride sys.spans on the heartbeat,
+        never the control plane."""
+        with self._telemetry_lock:
+            self._spans.append(span)
 
     def _record_span(self, spec: TaskSpec, span_id: str, start: float,
                      end: float, status: str) -> None:
-        with self._telemetry_lock:
-            self._spans.append({
+        entry = {
             "trace_id": getattr(spec, "trace_id", "") or "",
             "span_id": span_id,
             "parent_span_id": getattr(spec, "span_id", "") or "",
             "task_id": spec.task_id, "name": spec.name,
-                "start": start, "end": end, "status": status,
-                "pid": os.getpid(), "worker_id": self.worker_id,
-                "node_id": knobs.get_raw("RAY_TPU_NODE_ID"),
-            })
+            "start": start, "end": end, "status": status,
+            "pid": os.getpid(), "worker_id": self.worker_id,
+            "node_id": knobs.get_raw("RAY_TPU_NODE_ID"),
+        }
+        lease = getattr(spec, "lease_id", "") or ""
+        if lease:
+            entry["lease_id"] = lease
+        with self._telemetry_lock:
+            self._spans.append(entry)
 
     def _flush_telemetry(self, min_interval: float = 0.0) -> None:
         """Ship buffered spans + registry deltas. Never raises — a
@@ -1090,7 +1191,19 @@ class WorkerLoop:
             if min_interval and now - self._last_flush < min_interval:
                 return
             self._last_flush = now
+        # compiled-DAG stage spans sit in per-dag rings as bare tuples;
+        # the expensive dict/derived-id conversion runs here, at flush
+        # cadence, never on the per-seqno exec loop
+        dag_spans: List[dict] = []
+        if self._dag_ctx is not None:
+            try:
+                dag_spans = self._dag_ctx.drain_stage_spans()
+            except Exception:
+                dag_spans = []
+        with self._telemetry_lock:
             spans, self._spans = self._spans, []
+            if dag_spans:
+                spans.extend(dag_spans)
             try:
                 payload = self._delta_exporter.collect()
             except Exception:
@@ -1100,12 +1213,18 @@ class WorkerLoop:
         except Exception:
             events = None
         try:
+            prof = self._profiler.collect_delta()
+        except Exception:
+            prof = None
+        try:
             if spans:
                 self.conn.send(("report", "sys.spans", spans))
             if payload:
                 self.conn.send(("report", "sys.metrics", payload))
             if events:
                 self.conn.send(("report", "sys.events", events))
+            if prof:
+                self.conn.send(("report", "sys.profile", prof))
         except Exception:  # ConnectionClosed included: driver is gone
             pass
 
@@ -1451,6 +1570,10 @@ class WorkerLoop:
             self._actor_reply(spec, None, None)
             self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
+            # os._exit skips the finally block: ship this call's span
+            # and any buffered telemetry NOW or it dies with the process
+            self._finish_task_telemetry(spec, exec_span, t0, "ok")
+            self._flush_telemetry()
             os._exit(0)  # works from threadpool threads too
         except BaseException as e:  # noqa: BLE001
             status = "error"
@@ -1516,6 +1639,9 @@ class WorkerLoop:
             self._task_done(spec.task_id, [], None)
             self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
+            # os._exit skips the finally block: ship this call's span
+            self._finish_task_telemetry(spec, exec_span, t0, "ok")
+            self._flush_telemetry()
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
             status = "error"
@@ -1551,6 +1677,9 @@ class WorkerLoop:
             self._actor_reply(spec, None, None)
             self._batch.flush()
             self.conn.send(("actor_exit", self.rt.current_actor_id))
+            # os._exit skips the finally block: ship this call's span
+            self._finish_task_telemetry(spec, exec_span, t0, "ok")
+            self._flush_telemetry()
             os._exit(0)
         except BaseException as e:  # noqa: BLE001
             status = "error"

@@ -15,6 +15,9 @@ panel the reference renders is available as JSON:
   GET /api/workers     — worker processes
   GET /api/placement_groups
   GET /api/timeline    — chrome-trace events
+  GET /api/profile     — sampling-profiler aggregate
+                         (?format=summary|collapsed|speedscope,
+                          ?worker=<wid>, ?task=<task id>)
   GET /metrics         — Prometheus text exposition
 
 Job submission over HTTP (reference: python/ray/dashboard/modules/job/
@@ -141,6 +144,20 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(forensics.build_post_mortem(sid))
             elif route == "/api/timeline":
                 self._json(timeline_mod.timeline_events())
+            elif route == "/api/profile":
+                from ..core.runtime import get_runtime
+                store = get_runtime().profile_store
+                fmt = (q.get("format") or ["summary"])[0]
+                worker = (q.get("worker") or [None])[0]
+                task = (q.get("task") or [None])[0]
+                if fmt == "collapsed":
+                    self._send(200,
+                               store.collapsed(worker, task).encode(),
+                               "text/plain; charset=utf-8")
+                elif fmt == "speedscope":
+                    self._json(store.speedscope(worker, task))
+                else:
+                    self._json(store.summary())
             elif route == "/api/serve":
                 self._json(_serve_status())
             elif route == "/api/serve/router":
@@ -187,7 +204,8 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/events",
                                        "/api/post_mortem",
                                        "/api/jobs",
-                                       "/api/timeline", "/metrics"]})
+                                       "/api/timeline", "/api/profile",
+                                       "/metrics"]})
             else:
                 self._json({"error": f"no route {route}"}, 404)
         except (BrokenPipeError, ConnectionResetError):
@@ -220,6 +238,14 @@ class _Handler(BaseHTTPRequestHandler):
                 sid = route.split("/")[3]
                 self._json({"submission_id": sid,
                             "stopped": _jobs().stop_job(sid)})
+            elif route == "/api/profile":
+                # drive one worker's sampling profiler:
+                # {"worker": wid, "action": start|stop|snapshot|status,
+                #  "hz": 100}  (core/worker.py profile_ctl verb)
+                from ..core.runtime import get_runtime
+                self._json(get_runtime().profile_ctl(
+                    body["worker"], body.get("action", "status"),
+                    body.get("hz")))
             else:
                 self._json({"error": f"no route {route}"}, 404)
         except (BrokenPipeError, ConnectionResetError):

@@ -62,6 +62,48 @@ def device_memory_profile(path: Optional[str] = None) -> bytes:
     return data
 
 
+def hbm_usage() -> Dict[str, int]:
+    """bytes-in-use per local accelerator device (device.memory_stats,
+    the cheap always-callable sibling of device_memory_profile). Only
+    consults jax when user code already imported it — a worker that
+    never touched jax must not pay the import — AND only when a
+    backend is already live: jax.local_devices() on a cold process
+    would initialize the backend, which breaks a later
+    jax.distributed.initialize() (multihost SPMD workers would die on
+    'must be called before any JAX computations'). Returns {} on
+    backends that do not report memory stats (CPU)."""
+    import sys
+    if "jax" not in sys.modules:
+        return {}
+    import jax
+    try:
+        from jax._src import xla_bridge  # noqa: PLC0415
+        if not getattr(xla_bridge, "_backends", None):
+            return {}
+    except Exception:
+        return {}
+    out: Dict[str, int] = {}
+    try:
+        for dev in jax.local_devices():
+            stats_fn = getattr(dev, "memory_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if not stats:
+                continue
+            used = stats.get("bytes_in_use")
+            if used is not None:
+                out[str(dev.id)] = int(used)
+    except Exception:
+        pass
+    return out
+
+
+def host_rss_bytes() -> int:
+    """This process's resident set size (/proc/self/statm)."""
+    with open("/proc/self/statm") as f:
+        pages = int(f.read().split()[1])
+    return pages * os.sysconf("SC_PAGE_SIZE")
+
+
 def timed_steps(step_fn, state, batch, *, warmup: int = 2,
                 iters: int = 10, sync=None) -> Dict[str, Any]:
     """Wall-time a jitted step the way bench.py does: warmup, then time
@@ -88,4 +130,5 @@ def timed_steps(step_fn, state, batch, *, warmup: int = 2,
 
 
 __all__ = ["start_trace", "stop_trace", "trace", "annotate",
-           "device_memory_profile", "timed_steps"]
+           "device_memory_profile", "hbm_usage", "host_rss_bytes",
+           "timed_steps"]

@@ -11,6 +11,13 @@ TaskSpec (util/tracing.py); worker processes ship their execution spans
 back over the telemetry channel and they render here parented to the
 submit span (args.parent_span_id + chrome flow arrows), so one export
 shows the full submit → dispatch → execute tree across processes.
+
+Zero-driver fast paths ride the same channel (the flight recorder,
+docs/OBSERVABILITY.md): direct-call submit spans (cat "dcall_submit"),
+lease grants ("lease_grant"), and compiled-DAG per-stage spans
+("dag_stage", parented across worker processes by DERIVED ids —
+util/tracing.derived_span_id — with ack-window stall time as an
+`ack_stall_s` arg) all merge into this one export.
 """
 from __future__ import annotations
 
@@ -25,6 +32,12 @@ _US = 1_000_000.0
 
 def timeline_events() -> List[Dict[str, Any]]:
     rt = get_runtime()
+    try:
+        # compiled-DAG controllers defer driver-side submit/result
+        # spans in bounded rings; surface them before reading the store
+        rt.drain_fastpath_spans()
+    except Exception:
+        pass
     events: List[Dict[str, Any]] = []
     pid = 1   # single "process": the cluster; tid = worker lane
 
@@ -86,18 +99,26 @@ def timeline_events() -> List[Dict[str, Any]]:
             start, end = sp["start"], sp["end"]
         except (KeyError, TypeError):
             continue
+        args = {"task_id": sp.get("task_id"),
+                "span_id": sp.get("span_id"),
+                "parent_span_id": sp.get("parent_span_id"),
+                "trace_id": sp.get("trace_id"),
+                "status": sp.get("status"),
+                "node_id": sp.get("node_id"),
+                "worker_pid": sp.get("pid")}
+        # fast-path span attributes (compiled-DAG stages, lease grants,
+        # direct calls) pass straight through to the trace viewer
+        for extra in ("dag_id", "sid", "seqno", "ack_stall_s",
+                      "lease_id", "slots"):
+            if sp.get(extra) is not None:
+                args[extra] = sp[extra]
         events.append({
-            "name": sp.get("name", "task"), "cat": "task_exec",
+            "name": sp.get("name", "task"),
+            "cat": sp.get("cat", "task_exec"),
             "ph": "X", "ts": start * _US,
             "dur": max(1.0, (end - start) * _US),
             "pid": pid, "tid": lane(sp.get("worker_id")),
-            "args": {"task_id": sp.get("task_id"),
-                     "span_id": sp.get("span_id"),
-                     "parent_span_id": sp.get("parent_span_id"),
-                     "trace_id": sp.get("trace_id"),
-                     "status": sp.get("status"),
-                     "node_id": sp.get("node_id"),
-                     "worker_pid": sp.get("pid")},
+            "args": args,
         })
         if sp.get("parent_span_id"):
             events.append({

@@ -124,6 +124,13 @@ BUILTIN: Dict[str, _SPEC] = {
         "info", "worker process spawned"),
     "worker.death": (
         "warning", "worker process died or was terminated"),
+    "worker.profile.start": (
+        "info", "a worker's sampling profiler started (or changed "
+        "rate) via RAY_TPU_PROFILE_HZ or an on-demand profile_ctl "
+        "request; attrs carry the hz"),
+    "worker.profile.stop": (
+        "info", "a worker's sampling profiler stopped via an "
+        "on-demand profile_ctl request"),
     # ---- compiled DAGs (docs/DAG.md) ----
     "dag.compile": (
         "info", "compiled-DAG pipeline placed and wired: attrs carry "

@@ -265,6 +265,24 @@ _declare("RAY_TPU_EVENT_BUFFER", "int", 4096,
          "(overflow counts surface as events.dropped).", "telemetry")
 _declare("RAY_TPU_EVENT_STORE", "int", 16384,
          "Driver-side cluster event store ring size.", "telemetry")
+_declare("RAY_TPU_FASTPATH_SPANS", "bool", True,
+         "Trace spans on the zero-driver fast paths (direct "
+         "worker->worker calls, task leases, compiled-DAG stages); "
+         "spans ride the existing telemetry heartbeat, never the "
+         "control plane.", "telemetry")
+_declare("RAY_TPU_PROFILE_HZ", "float", 0,
+         "Always-on sampling profiler rate per worker (stack samples "
+         "per second; 0 disables the sampler thread). Can be raised "
+         "per worker at runtime via the profile control plane.",
+         "telemetry")
+_declare("RAY_TPU_PROFILE_MAX_STACKS", "int", 2048,
+         "Distinct folded stacks a worker aggregates between "
+         "telemetry flushes; overflow collapses into a single "
+         "'(overflow)' bucket so profiler memory stays bounded.",
+         "telemetry")
+_declare("RAY_TPU_PROFILE_DEPTH", "int", 24,
+         "Max frames kept per sampled stack (deepest frames beyond "
+         "this are truncated).", "telemetry")
 
 # ---------------------------------------------------------------------------
 # serve plane (docs/SERVING.md)

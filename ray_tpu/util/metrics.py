@@ -139,6 +139,27 @@ class Histogram(Metric):
             self._sum[key] += value
             self._count[key] += 1
 
+    def observe_many(self, values: Sequence[float],
+                     tags: Optional[Dict[str, str]] = None) -> None:
+        """Bulk observe: one key computation and one lock acquisition
+        for the whole batch — flush-cadence consumers (flight-recorder
+        ring drains) record hundreds of samples per call."""
+        if not values:
+            return
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            if key not in self._buckets:
+                self._buckets[key] = [0] * (len(self.boundaries) + 1)
+                self._sum[key] = 0.0
+                self._count[key] = 0
+            buckets = self._buckets[key]
+            total = 0.0
+            for v in values:
+                buckets[bisect.bisect_left(self.boundaries, v)] += 1
+                total += v
+            self._sum[key] += total
+            self._count[key] += len(values)
+
     def percentile(self, p: float,
                    tags: Optional[Dict[str, str]] = None) -> float:
         """Linear-interpolated percentile estimate from bucket counts."""

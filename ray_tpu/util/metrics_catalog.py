@@ -105,6 +105,15 @@ BUILTIN: Dict[str, _SPEC] = {
         "counter", "channel writes that reused an already-open channel "
         "(every write after a channel's first — the allocate/seal/free "
         "work the pipeline avoids)", (), "writes", None),
+    "ray_tpu_dag_stage_exec_seconds": (
+        "histogram", "one compiled-DAG stage's compute time per "
+        "execution, measured in the pinned worker (the per-stage view "
+        "behind the flight-recorder spans)", ("dag_id", "sid"),
+        "seconds", _FAST),
+    "ray_tpu_dag_channel_stall_seconds": (
+        "counter", "seconds compiled-DAG channel writers spent blocked "
+        "on the consumer ack window (backpressure: the downstream "
+        "stage is the bottleneck)", (), "seconds", None),
     "ray_tpu_wire_fallbacks_total": (
         "counter", "control frames of a wire-eligible kind that fell "
         "back to cloudpickle framing (should stay 0 in steady state; "
@@ -139,6 +148,21 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_worker_tasks_total": (
         "counter", "tasks executed by this worker", ("status",),
         "tasks", None),
+    "ray_tpu_profile_samples_total": (
+        "counter", "stack samples taken by the always-on sampling "
+        "profiler (RAY_TPU_PROFILE_HZ / profile_ctl)", (), "samples",
+        None),
+    "ray_tpu_trace_spans_dropped_total": (
+        "counter", "fast-path spans dropped because the bounded "
+        "flight-recorder ring overflowed between telemetry flushes",
+        (), "spans", None),
+    "ray_tpu_worker_hbm_used_bytes": (
+        "gauge", "accelerator memory in use per local device "
+        "(jax memory_stats; absent on backends that do not report "
+        "it)", ("device",), "bytes", None),
+    "ray_tpu_worker_host_rss_bytes": (
+        "gauge", "worker process resident set size", (), "bytes",
+        None),
     # ---- serve LLM engine ----
     "ray_tpu_llm_engine_tokens_generated": (
         "counter", "tokens sampled across all requests", ("engine",),

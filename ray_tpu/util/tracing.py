@@ -12,6 +12,7 @@ export one parented tree across processes.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import threading
 from typing import Optional, Tuple
 
@@ -26,6 +27,22 @@ def new_span_id() -> str:
 
 def new_trace_id() -> str:
     return rand_hex(32)
+
+
+def derived_span_id(*parts) -> str:
+    """Deterministic span id from structural coordinates (e.g.
+    ``(dag_id, stage_id, seqno)``). Both endpoints of a zero-driver hop
+    can derive the SAME id independently, so compiled-DAG stage spans
+    parent across processes without any driver coordination or extra
+    wire traffic."""
+    key = ".".join(str(p) for p in parts).encode()
+    return hashlib.blake2b(key, digest_size=8).hexdigest()
+
+
+def derived_trace_id(*parts) -> str:
+    """Deterministic trace id companion to derived_span_id."""
+    key = ".".join(str(p) for p in parts).encode()
+    return hashlib.blake2b(key, digest_size=16).hexdigest()
 
 
 def current() -> Optional[Tuple[str, str]]:

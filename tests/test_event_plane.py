@@ -123,6 +123,13 @@ def test_catalog_requires_compiled_dag_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_profiler_events():
+    """The sampling-profiler control verbs (docs/OBSERVABILITY.md):
+    start/stop are operator actions worth an audit trail."""
+    for required in ("worker.profile.start", "worker.profile.stop"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

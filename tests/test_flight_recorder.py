@@ -1,0 +1,437 @@
+"""Cross-worker flight recorder (docs/OBSERVABILITY.md).
+
+Covers: span continuity over every zero-driver fast path — direct
+worker->worker actor calls, multi-task lease grants, and compiled-DAG
+channel hops — plus the always-on sampling profiler's aggregation,
+control verbs, and graceful-exit telemetry flush.
+
+The invariants under test:
+  * every execution produces a span that reaches the driver store;
+  * every span's parent resolves inside the collected set (zero
+    orphans), even when the hop never touched the driver;
+  * recording spans on a fast path adds ZERO task-plane control
+    frames (the spans ride the existing telemetry heartbeat).
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+from ray_tpu.util import tracing
+
+# task-plane control message kinds: the fast paths must stay silent on
+# these while spans flow (telemetry "report" frames are expected and
+# explicitly NOT counted — that channel exists so tracing never rides
+# the control plane)
+TASK_KINDS = ("submit", "submit_many", "task_done", "get_request",
+              "put")
+
+
+def _poll(fn, timeout=15.0, interval=0.25):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+@ray_tpu.remote
+def _double(x):
+    return 2 * x
+
+
+@ray_tpu.remote
+class _Peer:
+    def pong(self, x):
+        return x + 1
+
+
+@ray_tpu.remote
+class _Caller:
+    def __init__(self, peer):
+        self.peer = peer
+
+    def relay(self, x):
+        # resolves the peer's address once, then rides a
+        # worker->worker socket: no driver hop on the repeat calls
+        return ray_tpu.get(self.peer.pong.remote(x))
+
+
+# ---------- derived ids ----------
+
+def test_derived_span_ids_are_deterministic_and_type_insensitive():
+    """Both endpoints of a zero-driver hop derive the SAME id with no
+    coordination; int vs str coordinates must not fork the id (the
+    producer knows sid as an int, the consumer parses it from a
+    channel-id string)."""
+    a = tracing.derived_span_id("dag-abc", 3, 17)
+    b = tracing.derived_span_id("dag-abc", "3", "17")
+    assert a == b
+    assert len(a) == 16 and int(a, 16) >= 0
+    assert a != tracing.derived_span_id("dag-abc", 3, 18)
+    t = tracing.derived_trace_id("dag-abc", 17)
+    assert len(t) == 32
+    assert t == tracing.derived_trace_id("dag-abc", "17")
+
+
+# ---------- span continuity per fast path ----------
+
+def _span_ids(rt):
+    ids = {sp.get("span_id") for sp in rt.trace_spans}
+    # driver-side submit spans live in the GCS task table
+    ids |= {getattr(te, "span_id", "") for te in rt.gcs.tasks.values()}
+    ids.discard("")
+    ids.discard(None)
+    return ids
+
+
+def _task_ids(rt, refs):
+    return {rt.gcs.objects[r.id].owner_task for r in refs}
+
+
+def test_plain_task_exec_spans_parent_to_submit(rt):
+    refs = [_double.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(8)]
+    task_ids = _task_ids(rt, refs)
+
+    def collected():
+        got = [sp for sp in rt.trace_spans
+               if sp.get("task_id") in task_ids
+               and sp.get("cat") is None]
+        return got if len(got) == len(task_ids) else None
+
+    spans = _poll(collected)
+    assert spans, "exec spans never reached the driver store"
+    ids = _span_ids(rt)
+    for sp in spans:
+        assert sp["parent_span_id"], sp
+        assert sp["parent_span_id"] in ids, \
+            f"orphan exec span {sp['span_id']}"
+        assert sp["worker_id"] and sp["worker_id"] != "driver"
+
+
+def test_lease_grant_spans_join_worker_execs(rt):
+    """A multi-task lease grant records one driver-local span and
+    stamps its lease_id onto every spec, so the workers' exec spans
+    carry the attribute that joins them to the grant."""
+    refs = [_double.remote(i) for i in range(40)]
+    ray_tpu.get(refs, timeout=60)
+    task_ids = _task_ids(rt, refs)
+
+    def leased():
+        got = [sp for sp in rt.trace_spans
+               if sp.get("task_id") in task_ids
+               and sp.get("lease_id")]
+        return got or None
+
+    leased_spans = _poll(leased)
+    assert leased_spans, \
+        "no exec span carried a lease_id (40-task fan-out on 8 " \
+        "workers must produce at least one multi-slot lease)"
+    grant_ids = {sp.get("lease_id") for sp in rt.trace_spans
+                 if sp.get("cat") == "lease_grant"}
+    for sp in leased_spans:
+        assert sp["lease_id"] in grant_ids, \
+            f"exec span references unknown lease {sp['lease_id']}"
+
+
+def test_direct_actor_call_spans_without_driver_hops(rt):
+    """Worker->worker direct calls: the callee's submit-side span is
+    recorded IN the calling worker and shipped on its heartbeat — the
+    task plane stays silent while the spans flow."""
+    peer = _Peer.remote()
+    caller = _Caller.remote(peer)
+    assert ray_tpu.get(caller.relay.remote(1), timeout=60) == 2
+    before = {k: rt.ctrl_msgs.get(k, 0) for k in TASK_KINDS}
+    n = 20
+    for i in range(n):
+        assert ray_tpu.get(caller.relay.remote(i), timeout=60) == i + 1
+
+    def dcall_spans():
+        got = [sp for sp in rt.trace_spans
+               if sp.get("cat") == "dcall_submit"]
+        return got if len(got) >= n else None
+
+    spans = _poll(dcall_spans)
+    # dcall_submit spans record ONLY on the direct-call success path,
+    # so their presence is itself proof the calls bypassed the driver
+    assert spans, "direct-call submit spans never arrived"
+    # the dcall submit span is the propagated trace context itself:
+    # trace_id flows from the caller's active span
+    for sp in spans[:n]:
+        assert sp["trace_id"], sp
+        assert sp["worker_id"] != "driver"
+    # the driver never saw task-plane traffic for the direct calls
+    # (each relay() itself is one driver-submitted actor task; the
+    # INNER pong() hops are what must stay off the control plane)
+    delta = {k: rt.ctrl_msgs.get(k, 0) - before[k] for k in TASK_KINDS}
+    assert sum(delta.values()) <= 2 * n + 4, delta
+
+
+def test_compiled_dag_stage_spans_full_parented_tree(rt):
+    """Every compiled-DAG execution yields one span per stage, all in
+    one derived trace, parented producer->consumer across worker
+    processes with ZERO driver involvement — and zero orphans."""
+    with InputNode() as inp:
+        dag = _double.bind(_double.bind(inp))
+    comp = dag.experimental_compile()
+    try:
+        if comp.stats["mode"] != "pipelined":
+            pytest.skip("compiled-DAG pipelined mode unavailable")
+        n = 12
+        before = {k: rt.ctrl_msgs.get(k, 0) for k in TASK_KINDS}
+        for i in range(n):
+            assert ray_tpu.get(comp.execute(i), timeout=60) == 4 * i
+        delta = {k: rt.ctrl_msgs.get(k, 0) - before[k]
+                 for k in TASK_KINDS if rt.ctrl_msgs.get(k, 0)
+                 - before[k]}
+        assert delta == {}, \
+            f"compiled execs leaked task-plane ctrl msgs: {delta}"
+
+        dag_id = comp._ctl.dag_id
+
+        def stage_spans():
+            got = [sp for sp in rt.trace_spans
+                   if sp.get("cat") == "dag_stage"
+                   and sp.get("dag_id") == dag_id]
+            return got if len(got) >= 2 * n else None
+
+        spans = _poll(stage_spans)
+        assert spans, "dag stage spans never reached the driver"
+        by_seq = {}
+        for sp in spans:
+            by_seq.setdefault(sp["seqno"], []).append(sp)
+        ids = {sp["span_id"] for sp in rt.trace_spans}
+        orphans = [sp for sp in spans
+                   if sp["parent_span_id"] not in ids]
+        assert orphans == [], \
+            f"{len(orphans)} orphan stage spans (of {len(spans)})"
+        # per execution: one span per stage, a single derived trace,
+        # and the chain roots at the driver's dag_submit span
+        seq = spans[0]["seqno"]
+        chain = sorted(by_seq[seq], key=lambda s: s["sid"])
+        assert len(chain) == 2
+        assert len({s["trace_id"] for s in chain}) == 1
+        assert chain[0]["trace_id"] == tracing.derived_trace_id(
+            dag_id, seq)
+        assert chain[1]["parent_span_id"] == chain[0]["span_id"]
+        root_parent = tracing.derived_span_id(dag_id, "drv", seq)
+        assert chain[0]["parent_span_id"] == root_parent
+        # the driver's submit + result spans close the loop locally
+        assert any(sp.get("cat") == "dag_submit"
+                   and sp["span_id"] == root_parent
+                   for sp in rt.trace_spans)
+        assert _poll(lambda: [
+            sp for sp in rt.trace_spans
+            if sp.get("cat") == "dag_result"
+            and sp.get("dag_id") == dag_id] or None)
+    finally:
+        comp.close()
+
+
+def test_timeline_export_merges_fastpath_spans(rt):
+    """One chrome-trace export carries driver submit spans, worker
+    exec spans, AND the fast-path categories with their attributes."""
+    import ray_tpu.observability  # noqa: F401  (package init)
+    timeline_mod = sys.modules["ray_tpu.observability.timeline"]
+    ray_tpu.get([_double.remote(i) for i in range(4)], timeout=60)
+
+    def has_exec():
+        ev = timeline_mod.timeline_events()
+        return ev if any(e.get("cat") == "task_exec" for e in ev) \
+            else None
+
+    events = _poll(has_exec)
+    assert events
+    cats = {e.get("cat") for e in events}
+    assert "submit" in cats and "task_exec" in cats
+    submit_ids = {e["args"]["span_id"] for e in events
+                  if e.get("cat") == "submit"}
+    all_ids = submit_ids | {e["args"].get("span_id") for e in events
+                            if e.get("args")}
+    for e in events:
+        if e.get("cat") not in ("task_exec", "dag_stage"):
+            continue
+        parent = e["args"].get("parent_span_id")
+        if parent:
+            assert parent in all_ids, f"unresolvable parent {parent}"
+    # fast-path attributes pass through to the viewer
+    for e in events:
+        if e.get("cat") == "dag_stage":
+            assert "dag_id" in e["args"] and "seqno" in e["args"]
+
+
+def test_fastpath_spans_kill_switch():
+    """RAY_TPU_FASTPATH_SPANS=0 silences the recorder cluster-wide.
+    Workers inherit the knob at fork, so the whole cluster runs in a
+    subprocess with the switch thrown before init."""
+    code = r"""
+import time
+import ray_tpu
+from ray_tpu.dag import InputNode
+from ray_tpu.util.jaxenv import force_cpu
+force_cpu(n_virtual_devices=2)
+
+rt = ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def _double(x):
+    return 2 * x
+
+with InputNode() as inp:
+    dag = _double.bind(inp)
+comp = dag.experimental_compile()
+for i in range(5):
+    assert ray_tpu.get(comp.execute(i), timeout=60) == 2 * i
+comp.close()
+time.sleep(1.5)     # one heartbeat: nothing should land
+fastpath = [sp for sp in rt.trace_spans
+            if sp.get("cat") in ("dag_stage", "dag_submit",
+                                 "dag_result", "dcall_submit",
+                                 "lease_grant")]
+assert fastpath == [], fastpath
+print("KILLSWITCH_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RAY_TPU_FASTPATH_SPANS="0")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180,
+                          env=env, cwd=os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__))))
+    assert "KILLSWITCH_OK" in proc.stdout, \
+        f"stdout={proc.stdout[-2000:]}\nstderr={proc.stderr[-2000:]}"
+
+
+# ---------- sampling profiler ----------
+
+@ray_tpu.remote
+def _spin(sec):
+    t0 = time.time()
+    while time.time() - t0 < sec:
+        sum(range(500))
+    return True
+
+
+def test_profiler_start_snapshot_stop_and_attribution(rt):
+    """The control verbs drive one worker's sampler live; samples are
+    attributed to the running task via the PR-3 task markers and
+    aggregate in the driver store."""
+    started = []
+    for wid in list(rt.workers):
+        try:
+            st = rt.profile_ctl(wid, "start", 200.0)
+        except ValueError:
+            continue        # worker died between listing and send
+        assert st["hz"] == 200.0
+        started.append(wid)
+    assert started, "no live worker to profile"
+    try:
+        ref = _spin.remote(0.8)
+        assert ray_tpu.get(ref, timeout=60) is True
+
+        def attributed():
+            col = rt.profile_store.collapsed()
+            return col if "task:tsk-" in col else None
+
+        # flush rides the heartbeat; the store eventually carries a
+        # task-attributed tower for the busy loop
+        col = _poll(attributed, timeout=20.0)
+        assert col and "task:tsk-" in col, \
+            f"no task-attributed stacks in:\n{col}"
+    finally:
+        for w in list(rt.workers):
+            try:
+                rt.profile_ctl(w, "stop")
+            except Exception:
+                pass
+    # speedscope export round-trips the same aggregate
+    ss = rt.profile_store.speedscope()
+    assert ss["profiles"][0]["samples"]
+    assert len(ss["profiles"][0]["samples"]) == \
+        len(ss["profiles"][0]["weights"])
+    assert rt.profile_store.summary()["samples_total"] > 0
+
+
+def test_profiler_events_are_emitted(rt):
+    wid = next(iter(rt.workers))
+    rt.profile_ctl(wid, "start", 50.0)
+    rt.profile_ctl(wid, "stop")
+
+    def seen():
+        rows, _total = rt.cluster_events.query(
+            types=["worker.profile.start", "worker.profile.stop"])
+        return rows if len(rows) >= 2 else None
+
+    assert _poll(seen), "profile start/stop events never arrived"
+
+
+def test_worker_memory_gauges_flow(rt):
+    """The telemetry heartbeat publishes per-worker host RSS (and HBM
+    when jax is live in the worker); the merged exposition carries the
+    gauge tagged by worker."""
+    ray_tpu.get(_double.remote(1), timeout=60)
+
+    def scraped():
+        from ray_tpu.util import metrics as metrics_mod
+        text = metrics_mod.cluster_exposition()
+        return text if "ray_tpu_worker_host_rss_bytes" in text else None
+
+    text = _poll(scraped)
+    assert text, "host RSS gauge never reached the exposition"
+
+
+# ---------- graceful-exit flush (satellite 1) ----------
+
+def test_short_lived_worker_flushes_spans_on_exit():
+    """A worker that exits right after its task (actor exit path) must
+    flush pending telemetry BEFORE dying — its exec span reaches the
+    driver store even though no heartbeat ever fired."""
+    code = r"""
+import time
+import ray_tpu
+from ray_tpu.util.jaxenv import force_cpu
+force_cpu(n_virtual_devices=2)
+
+rt = ray_tpu.init(num_cpus=1)
+
+@ray_tpu.remote
+class _OneShot:
+    def only_call(self):
+        return 42
+    def die(self):
+        ray_tpu.actor_exit()
+
+a = _OneShot.remote()
+ref = a.only_call.remote()
+assert ray_tpu.get(ref, timeout=60) == 42
+task_id = rt.gcs.objects[ref.id].owner_task
+try:
+    ray_tpu.get(a.die.remote(), timeout=60)
+except Exception:
+    pass
+deadline = time.time() + 10
+found = False
+while time.time() < deadline:
+    spans = [sp for sp in rt.trace_spans
+             if sp.get("task_id") == task_id]
+    if spans:
+        found = True
+        break
+    time.sleep(0.2)
+assert found, "exec span lost when the worker exited"
+print("FLUSH_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180,
+                          env=env, cwd=os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__))))
+    assert "FLUSH_OK" in proc.stdout, \
+        f"stdout={proc.stdout[-2000:]}\nstderr={proc.stderr[-2000:]}"

@@ -141,6 +141,21 @@ def test_catalog_requires_compiled_dag_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_observability_fastpath_metrics():
+    """The flight-recorder / sampling-profiler plane
+    (docs/OBSERVABILITY.md): per-stage exec latency, ack-window stall
+    attribution, sampler volume, and the worker memory gauges the
+    telemetry heartbeat publishes."""
+    for required, kind in (
+            ("ray_tpu_dag_stage_exec_seconds", "histogram"),
+            ("ray_tpu_dag_channel_stall_seconds", "counter"),
+            ("ray_tpu_profile_samples_total", "counter"),
+            ("ray_tpu_worker_hbm_used_bytes", "gauge"),
+            ("ray_tpu_worker_host_rss_bytes", "gauge")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_steady_state_workload_zero_wire_fallbacks(rt):
     """Every control frame a steady-state workload produces — task
     submits/dones, leases, seals, actor calls, AND the telemetry delta

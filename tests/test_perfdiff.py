@@ -1,0 +1,144 @@
+"""Perf-regression gate (tools/perfdiff.py, docs/OBSERVABILITY.md).
+
+The committed BENCH_*.json snapshots are the performance baseline;
+perfdiff turns them into an enforced gate: these tests run it against
+HEAD on every tier-1 pass, and self-test that an injected regression
+actually trips the nonzero exit.
+
+All but the CLI test call perfdiff.main() in-process — same argv
+surface, no interpreter spawn per case (the tier-1 budget on a 1-core
+box is tight)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import perfdiff  # noqa: E402
+
+
+def _run(capsys, *argv):
+    rc = perfdiff.main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_committed_bench_files_pass_the_gate(capsys):
+    """The working tree's BENCH files vs their committed (HEAD)
+    baselines: no regression. Files not yet in HEAD (a brand-new
+    benchmark) are skipped, not failed — a fresh BENCH_*.json must
+    never break the suite before its first commit."""
+    rc, out = _run(capsys, "--git-baseline", "--repo", REPO)
+    assert rc == 0, out[-3000:]
+    assert "gated metrics" in out
+
+
+def test_cli_entrypoint_exit_code(tmp_path):
+    """One real subprocess proving the `python -m tools.perfdiff`
+    surface and its exit code (everything else runs in-process)."""
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    (old / "BENCH_X.json").write_text(json.dumps({"p99_ms": 2.0}))
+    (new / "BENCH_X.json").write_text(json.dumps({"p99_ms": 9.0}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.perfdiff", str(old), str(new)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1, proc.stdout
+    assert "REGRESSION" in proc.stdout
+
+
+def test_injected_regression_trips_nonzero_exit(tmp_path, capsys):
+    """Self-test (satellite 5): a 20% throughput drop against a 10%
+    tolerance must exit 1 and name the regressed metric."""
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    base = {"ts": "x", "phase": "obs", "command": "c",
+            "result": {"noop_tasks_per_s": 1000.0, "p99_ms": 2.0,
+                       "overhead_pct": 1.0, "n_calls": 600}}
+    cur = json.loads(json.dumps(base))
+    cur["result"]["noop_tasks_per_s"] = 800.0      # -20%: regression
+    (old / "BENCH_X.json").write_text(json.dumps(base))
+    (new / "BENCH_X.json").write_text(json.dumps(cur))
+    rc, out = _run(capsys, str(old), str(new))
+    assert rc == 1, out
+    assert "REGRESSION" in out
+    assert "noop_tasks_per_s" in out
+
+
+def test_within_tolerance_passes(tmp_path, capsys):
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    base = {"noop_tasks_per_s_obs_on": 5000.0, "task_overhead_pct": 0.5}
+    cur = {"noop_tasks_per_s_obs_on": 4700.0, "task_overhead_pct": 1.2}
+    (old / "BENCH_OBS.json").write_text(json.dumps(base))
+    (new / "BENCH_OBS.json").write_text(json.dumps(cur))
+    rc, out = _run(capsys, str(old), str(new))   # -6% < 10% tolerance
+    assert rc == 0, out
+
+
+def test_pct_metrics_gate_on_point_delta(tmp_path, capsys):
+    """*_pct metrics gate on absolute percentage points: overhead
+    creeping 0.5 -> 12 points is a regression even though both runs
+    were 'fast'."""
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    (old / "BENCH_X.json").write_text(
+        json.dumps({"overhead_pct": 0.5}))
+    (new / "BENCH_X.json").write_text(
+        json.dumps({"overhead_pct": 12.0}))
+    rc, out = _run(capsys, str(old), str(new))
+    assert rc == 1, out
+
+
+def test_lower_is_better_direction(tmp_path, capsys):
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    (old / "BENCH_X.json").write_text(json.dumps({"p99_ms": 2.0}))
+    (new / "BENCH_X.json").write_text(json.dumps({"p99_ms": 3.0}))
+    rc, out = _run(capsys, str(old), str(new))   # +50% latency
+    assert rc == 1, out
+    # improvement is never a regression
+    rc, out = _run(capsys, str(new), str(old))
+    assert rc == 0, out
+
+
+def test_missing_baseline_file_is_skipped_not_failed(tmp_path, capsys):
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    (old / "BENCH_A.json").write_text(json.dumps({"p99_ms": 2.0}))
+    (new / "BENCH_A.json").write_text(json.dumps({"p99_ms": 2.0}))
+    (new / "BENCH_B.json").write_text(json.dumps({"p99_ms": 9.0}))
+    rc, out = _run(capsys, str(old), str(new))
+    assert rc == 0, out
+    assert "skipped" in out
+
+
+def test_per_metric_tolerance_override(tmp_path, capsys):
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    (old / "BENCH_X.json").write_text(
+        json.dumps({"noop_tasks_per_s": 1000.0}))
+    (new / "BENCH_X.json").write_text(
+        json.dumps({"noop_tasks_per_s": 800.0}))
+    rc, out = _run(capsys, str(old), str(new),
+                   "--metric-tolerance", "noop_tasks_per_s=25")
+    assert rc == 0, out

@@ -1,0 +1,237 @@
+"""perfdiff: compare two sets of BENCH_*.json and flag regressions.
+
+The repo commits benchmark snapshots (BENCH_CORE.json, BENCH_DAG.json,
+BENCH_OBS.json, ...) next to the code that produced them. This tool
+turns those snapshots into a regression gate:
+
+    python -m tools.perfdiff OLD_DIR NEW_DIR
+    python -m tools.perfdiff --git-baseline [REV]      # baseline from git
+
+Both BENCH shapes in the tree are understood: the wrapped form
+(``{"ts", "phase", "command", "result": {...}}``) and the flat form
+(BENCH_EVENTS.json). Every numeric leaf becomes a dotted metric path
+(``result.noop_tasks_per_s`` flattens to ``noop_tasks_per_s`` — the
+wrapper keys ts/phase/command are metadata, not metrics).
+
+Direction is inferred from the metric name:
+
+  higher-is-better   *per_s*, *throughput*, *speedup*, *steps_per*
+  lower-is-better    *latency*, *overhead*, *stall*, *_seconds*, *_ms*,
+                     *frames_per*, *msgs_per*
+  percentage-point   *_pct (gated on absolute point delta, not ratio —
+                     an overhead going 0.5% -> 2.6% is the regression,
+                     not the 420% relative blowup)
+  informational      everything else (shown, never gated)
+
+Exit codes: 0 = within tolerance, 1 = regression, 2 = usage/IO error.
+Used by tests/test_perfdiff.py to gate the committed BENCH files
+against HEAD on every tier-1 run.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# wrapper metadata in the wrapped BENCH shape — never metrics
+_META_KEYS = {"ts", "phase", "command", "note", "platform"}
+
+_HIGHER = ("per_s", "throughput", "speedup", "steps_per", "calls_per")
+_LOWER = ("latency", "overhead_s", "stall", "_seconds", "_ms",
+          "frames_per", "msgs_per", "queued_s", "_bytes")
+
+
+def classify(name: str) -> str:
+    """'higher' | 'lower' | 'pct' | 'info' for a dotted metric path."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf.endswith("_pct"):
+        return "pct"
+    if any(t in leaf for t in _HIGHER):
+        return "higher"
+    if any(t in leaf for t in _LOWER):
+        return "lower"
+    return "info"
+
+
+def flatten(obj, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a BENCH document as dotted paths. The wrapped
+    shape's ``result`` layer is elided so the same benchmark compares
+    across both shapes."""
+    out: Dict[str, float] = {}
+    if not isinstance(obj, dict):
+        return out
+    for key, val in obj.items():
+        if not prefix and key in _META_KEYS:
+            continue
+        path = key if key == "result" and not prefix else (
+            f"{prefix}.{key}" if prefix else key)
+        if key == "result" and not prefix:
+            out.update(flatten(val))
+        elif isinstance(val, dict):
+            out.update(flatten(val, path))
+        elif isinstance(val, bool):
+            continue
+        elif isinstance(val, (int, float)):
+            out[path] = float(val)
+    return out
+
+
+def compare(base: Dict[str, float], cur: Dict[str, float],
+            tolerance_pct: float,
+            per_metric: Optional[Dict[str, float]] = None
+            ) -> List[Tuple[str, str, float, float, float, str]]:
+    """[(metric, direction, base, cur, delta, verdict)] over the common
+    metric set; verdict in {'ok', 'REGRESSED', 'info'}."""
+    rows = []
+    per_metric = per_metric or {}
+    for name in sorted(set(base) & set(cur)):
+        b, c = base[name], cur[name]
+        kind = classify(name)
+        tol = per_metric.get(name, tolerance_pct)
+        if kind == "pct":
+            # percentage-point metric: gate the absolute point delta
+            delta = c - b
+            verdict = "REGRESSED" if delta > tol else "ok"
+        elif kind == "info" or abs(b) < 1e-12:
+            delta = c - b
+            verdict = "info"
+        else:
+            delta = (c - b) / abs(b) * 100.0
+            if kind == "higher":
+                verdict = "REGRESSED" if delta < -tol else "ok"
+            else:
+                verdict = "REGRESSED" if delta > tol else "ok"
+        rows.append((name, kind, b, c, delta, verdict))
+    return rows
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _git_show(rev: str, relpath: str, repo: str) -> Optional[dict]:
+    """File contents at `rev`, or None if it does not exist there (a
+    brand-new benchmark has no baseline to regress against)."""
+    proc = subprocess.run(
+        ["git", "show", f"{rev}:{relpath}"], cwd=repo,
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return None
+
+
+def _pairs_from_dirs(old_dir: str, new_dir: str
+                     ) -> Iterable[Tuple[str, dict, dict]]:
+    if os.path.isfile(old_dir) and os.path.isfile(new_dir):
+        yield os.path.basename(new_dir), _load(old_dir), _load(new_dir)
+        return
+    for new_path in sorted(glob.glob(os.path.join(new_dir,
+                                                  "BENCH_*.json"))):
+        fname = os.path.basename(new_path)
+        old_path = os.path.join(old_dir, fname)
+        if not os.path.isfile(old_path):
+            print(f"perfdiff: {fname}: no baseline in {old_dir}, "
+                  "skipped")
+            continue
+        yield fname, _load(old_path), _load(new_path)
+
+
+def _pairs_from_git(rev: str, repo: str, files: List[str]
+                    ) -> Iterable[Tuple[str, dict, dict]]:
+    if not files:
+        files = sorted(glob.glob(os.path.join(repo, "BENCH_*.json")))
+    for path in files:
+        rel = os.path.relpath(path, repo)
+        base = _git_show(rev, rel, repo)
+        if base is None:
+            # not in the baseline rev: new benchmark, nothing to gate
+            print(f"perfdiff: {rel}: not in {rev}, skipped")
+            continue
+        yield rel, base, _load(path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perfdiff",
+        description="compare BENCH_*.json sets and flag regressions")
+    p.add_argument("old", nargs="?",
+                   help="baseline dir (or single file)")
+    p.add_argument("new", nargs="?",
+                   help="current dir (or single file)")
+    p.add_argument("--git-baseline", nargs="?", const="HEAD",
+                   default=None, metavar="REV",
+                   help="take the baseline from this git rev "
+                        "(default HEAD); positional args become the "
+                        "files to check (default: repo BENCH_*.json)")
+    p.add_argument("--tolerance", type=float, default=10.0,
+                   help="allowed regression percent "
+                        "(points for *_pct metrics); default 10")
+    p.add_argument("--metric-tolerance", action="append", default=[],
+                   metavar="NAME=PCT",
+                   help="per-metric tolerance override (repeatable)")
+    p.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    per_metric: Dict[str, float] = {}
+    for spec in args.metric_tolerance:
+        name, _, pct = spec.partition("=")
+        try:
+            per_metric[name] = float(pct)
+        except ValueError:
+            print(f"perfdiff: bad --metric-tolerance {spec!r}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        if args.git_baseline is not None:
+            files = [f for f in (args.old, args.new) if f]
+            pairs = list(_pairs_from_git(args.git_baseline, args.repo,
+                                         files))
+        elif args.old and args.new:
+            pairs = list(_pairs_from_dirs(args.old, args.new))
+        else:
+            p.print_usage(sys.stderr)
+            return 2
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perfdiff: {e}", file=sys.stderr)
+        return 2
+
+    regressed = 0
+    compared = 0
+    for fname, base_doc, cur_doc in pairs:
+        rows = compare(flatten(base_doc), flatten(cur_doc),
+                       args.tolerance, per_metric)
+        if not rows:
+            continue
+        print(f"\n== {fname} ==")
+        width = max(len(r[0]) for r in rows)
+        for name, kind, b, c, delta, verdict in rows:
+            unit = "pp" if kind == "pct" else (
+                "%" if kind in ("higher", "lower") else "")
+            mark = " <-- REGRESSION" if verdict == "REGRESSED" else ""
+            print(f"  {name.ljust(width)}  {b:>12.4g} -> {c:>12.4g}  "
+                  f"{delta:+8.2f}{unit or ' '} [{kind}]{mark}")
+            if verdict == "REGRESSED":
+                regressed += 1
+            if verdict != "info":
+                compared += 1
+    if not pairs:
+        print("perfdiff: nothing to compare", file=sys.stderr)
+        return 2
+    print(f"\nperfdiff: {compared} gated metrics, "
+          f"{regressed} regression(s)")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
