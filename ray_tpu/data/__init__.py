@@ -11,6 +11,7 @@ from .dataset import (Dataset, from_items, from_blocks, from_numpy,
                       read_tfrecords, AggregateFn)
 from .device_loader import device_put_iterator
 from . import preprocessors
+from . import service
 
 # ray.data.range parity name
 range = range_  # noqa: A001
@@ -20,4 +21,4 @@ __all__ = ["Block", "Dataset", "from_items", "from_blocks", "from_numpy",
            "range", "range_", "read_text", "read_jsonl", "read_csv",
            "read_npy", "read_parquet", "read_images", "read_binary_files",
            "read_tfrecords", "AggregateFn",
-           "device_put_iterator", "preprocessors"]
+           "device_put_iterator", "preprocessors", "service"]

@@ -323,6 +323,25 @@ class Dataset:
     def split_for_worker(self, rank: int, world: int) -> "Dataset":
         return self.streaming_split(world)[rank]
 
+    def to_service(self, job_name: str, *, mode: str = "fcfs",
+                   world_size: int = 1, epochs: int = 1,
+                   dataset_name: Optional[str] = None,
+                   n_slices: Optional[int] = None) -> str:
+        """Register this dataset's plan with the shared data service.
+
+        The plan runs once on the service's data-worker pool no matter
+        how many jobs consume it; consumers obtain per-consumer
+        iterators via ``data.service.iterator(job_name, rank=...)``.
+        Returns the dataset key (jobs registering the same plan — or
+        the same explicit ``dataset_name`` — share production). See
+        docs/DATA_SERVICE.md.
+        """
+        from . import service
+        return service.register(self, job_name, mode=mode,
+                                world_size=world_size, epochs=epochs,
+                                dataset_name=dataset_name,
+                                n_slices=n_slices)
+
     # ---------------- execution ----------------
     def iter_blocks(self) -> Iterator[Block]:
         if self._materialized is not None:

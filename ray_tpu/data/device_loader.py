@@ -4,6 +4,12 @@ Reference parity: ray.train.torch.prepare_data_loader's device-mover +
 iter_torch_batches prefetching. TPU version: a background thread stages the
 NEXT batch's jax.device_put (optionally with a NamedSharding spanning the
 mesh) while the current step runs, so HBM fill rides behind compute.
+
+Prefetch depth defaults to the ``RAY_TPU_DATA_PREFETCH_DEPTH`` knob when
+``prefetch=None``. Abandoning the iterator mid-stream (``close()`` /
+``GeneratorExit`` / garbage collection) signals the producer thread to
+stop: its puts are timeout-bounded and re-check a stop event, so it never
+parks forever on a full queue the consumer will no longer drain.
 """
 from __future__ import annotations
 
@@ -17,10 +23,14 @@ _SENTINEL = object()
 
 
 def device_put_iterator(host_batches: Iterator[Dict[str, np.ndarray]],
-                        *, sharding=None, prefetch: int = 2,
+                        *, sharding=None, prefetch: Optional[int] = None,
                         dtypes: Optional[Dict[str, Any]] = None):
     import jax
     import jax.numpy as jnp
+
+    if prefetch is None:
+        from ..util import knobs
+        prefetch = knobs.get_int("RAY_TPU_DATA_PREFETCH_DEPTH")
 
     def convert(batch):
         out = {}
@@ -44,27 +54,57 @@ def device_put_iterator(host_batches: Iterator[Dict[str, np.ndarray]],
 
     q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
     err: list = []
+    stop = threading.Event()
+
+    def bounded_put(item) -> bool:
+        """Put that never parks past the stop signal. Returns False if
+        the consumer abandoned the iterator."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for batch in host_batches:
-                q.put(convert(batch))
+                if not bounded_put(convert(batch)):
+                    return  # consumer gone; drop remaining batches
         except BaseException as e:  # noqa: BLE001
             err.append(e)
         finally:
-            q.put(_SENTINEL)
+            close = getattr(host_batches, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+            bounded_put(_SENTINEL)
 
     t = threading.Thread(target=producer, daemon=True,
                          name="rtpu-device-loader")
     t.start()
 
-    while True:
-        # raylint: disable=RT003 the producer's finally ALWAYS posts the
-        # sentinel (even on error), and a full queue drains as this
-        # consumer iterates — the get cannot park forever
-        item = q.get()
-        if item is _SENTINEL:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            # raylint: disable=RT003 the producer's finally ALWAYS posts
+            # the sentinel (even on error), and a full queue drains as
+            # this consumer iterates — the get cannot park forever
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # consumer abandoned us (GeneratorExit / close / GC) or we hit
+        # the sentinel: release the producer, then drain so a put that
+        # raced the stop flag cannot strand it
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

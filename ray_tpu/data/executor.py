@@ -115,22 +115,28 @@ class _StatefulMapActor:
 
 def execute_plan(source_blocks: Iterator[Block], stages: Sequence[Stage],
                  stats: Optional[DatasetStats] = None,
-                 parallelism: int = MAX_IN_FLIGHT) -> Iterator[Block]:
-    """Stream blocks through the fused stage chain."""
+                 parallelism: int = MAX_IN_FLIGHT,
+                 local: bool = False) -> Iterator[Block]:
+    """Stream blocks through the fused stage chain.
+
+    ``local=True`` forces the inline execution paths even when the core
+    runtime is initialized — used by data-service workers, which are
+    themselves actors and must not fan out nested remote tasks.
+    """
     stats = stats or DatasetStats()
     stages = fuse_stages(list(stages))
     stream: Iterator[Block] = source_blocks
     for stage in stages:
-        stream = _apply_stage(stream, stage, stats, parallelism)
+        stream = _apply_stage(stream, stage, stats, parallelism, local)
     return stream
 
 
 def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
-                 parallelism: int) -> Iterator[Block]:
+                 parallelism: int, local: bool = False) -> Iterator[Block]:
     if stage.kind == "map_block":
         if stage.compute == "actors" and stage.fn_constructor is not None:
-            return _actor_pool_map(stream, stage, stats, parallelism)
-        return _task_map(stream, stage, stats, parallelism)
+            return _actor_pool_map(stream, stage, stats, parallelism, local)
+        return _task_map(stream, stage, stats, parallelism, local)
     if stage.kind == "shuffle":
         def shuffled() -> Iterator[Block]:
             t0 = time.time()
@@ -140,7 +146,7 @@ def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
             yield from out
         return shuffled()
     if stage.kind == "exchange":
-        return _apply_exchange(stream, stage, stats, parallelism)
+        return _apply_exchange(stream, stage, stats, parallelism, local)
     if stage.kind == "window":
         def windowed() -> Iterator[Block]:
             t0 = time.time()
@@ -155,11 +161,12 @@ def _apply_stage(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
 
 def _apply_exchange(stream: Iterator[Block], stage: Stage,
                     stats: DatasetStats,
-                    parallelism: int) -> Iterator[Block]:
+                    parallelism: int,
+                    local_mode: bool = False) -> Iterator[Block]:
     """Distributed two-round shuffle (map-partition + reduce-merge) over
     the core runtime; inline two-round fallback without it."""
     from .exchange import run_exchange_distributed, run_exchange_local
-    if _runtime() is not None:
+    if not local_mode and _runtime() is not None:
         return run_exchange_distributed(stream, stage.exchange, stats,
                                         parallelism)
 
@@ -172,8 +179,8 @@ def _apply_exchange(stream: Iterator[Block], stage: Stage,
 
 
 def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
-              parallelism: int) -> Iterator[Block]:
-    rt = _runtime()
+              parallelism: int, local: bool = False) -> Iterator[Block]:
+    rt = None if local else _runtime()
     if rt is None:
         def local() -> Iterator[Block]:
             for i, block in enumerate(stream):
@@ -239,8 +246,9 @@ def _task_map(stream: Iterator[Block], stage: Stage, stats: DatasetStats,
 
 
 def _actor_pool_map(stream: Iterator[Block], stage: Stage,
-                    stats: DatasetStats, parallelism: int) -> Iterator[Block]:
-    rt = _runtime()
+                    stats: DatasetStats, parallelism: int,
+                    local: bool = False) -> Iterator[Block]:
+    rt = None if local else _runtime()
     import cloudpickle
     ctor_bytes = cloudpickle.dumps(stage.fn_constructor)
     if rt is None:

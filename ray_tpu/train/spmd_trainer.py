@@ -112,13 +112,17 @@ class SpmdTrainer:
                     self.report_fn(m)
             if cfg.checkpoint_every and (i + 1) % cfg.checkpoint_every == 0:
                 manager.save(jax.device_get(state), i + 1)
-            try:
-                nxt = next(data)
-                batch = {k: jnp.asarray(v) for k, v in nxt.items()}
-            except StopIteration:
-                data = self.data_iter_fn()
-                batch = {k: jnp.asarray(v)
-                         for k, v in next(data).items()}
+            # only draw ahead if another step will run: finite streams
+            # (e.g. a data-service iterator on its last epoch) end
+            # exactly at total_steps and must not be over-drawn
+            if i + 1 < cfg.total_steps:
+                try:
+                    nxt = next(data)
+                    batch = {k: jnp.asarray(v) for k, v in nxt.items()}
+                except StopIteration:
+                    data = self.data_iter_fn()
+                    batch = {k: jnp.asarray(v)
+                             for k, v in next(data).items()}
 
         final_ckpt = None
         if cfg.checkpoint_every:

@@ -21,9 +21,10 @@ _SPEC = Tuple[str, str]
 
 SEVERITIES = ("info", "warning", "error")
 
-# <subsystem>.<event> or <subsystem>.<object>.<event> (the serve plane
-# namespaces per object: serve.replica.*, serve.request.*)
-NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,2}$")
+# <subsystem>.<event> up to <subsystem>.<service>.<object>.<event>
+# (the serve plane namespaces per object: serve.replica.*; the data
+# service namespaces per verb: data.service.shard.grant)
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,3}$")
 
 BUILTIN: Dict[str, _SPEC] = {
     # ---- task lifecycle (driver dispatcher) ----
@@ -236,6 +237,22 @@ BUILTIN: Dict[str, _SPEC] = {
     "data.executor_stall": (
         "warning", "streaming stage producer stalled on the in-flight "
         "backpressure budget"),
+    # ---- data service (shared data plane) ----
+    "data.service.register": (
+        "info", "dataset plan or consumer job registered with the "
+        "data-service dispatcher (also emitted on dispatcher restore)"),
+    "data.service.epoch": (
+        "info", "epoch lifecycle: production complete for an epoch, or "
+        "a job's consumers crossed the epoch barrier"),
+    "data.service.shard.grant": (
+        "info", "a produced block was leased to a consumer (at-most-"
+        "once handout; consumer acks retire the grant)"),
+    "data.service.shard.revoke": (
+        "warning", "outstanding shard grants returned to the pool "
+        "(lease expiry, consumer re-attach, or data-worker death)"),
+    "data.service.worker.scale": (
+        "info", "data-worker pool scaled up or down by the dispatcher "
+        "autoscaler"),
 }
 
 

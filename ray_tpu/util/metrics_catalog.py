@@ -222,6 +222,21 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_data_blocks_total": (
         "counter", "blocks processed by a streaming stage", ("stage",),
         "blocks", None),
+    # ---- data service (shared data plane) ----
+    "ray_tpu_data_service_queue_depth": (
+        "gauge", "produced blocks held by the data service awaiting "
+        "consumption (per dataset, current epoch)", ("dataset",),
+        "blocks", None),
+    "ray_tpu_data_service_outstanding_shards": (
+        "gauge", "shard grants handed to consumers and not yet acked",
+        ("job",), "shards", None),
+    "ray_tpu_data_service_consumer_lag": (
+        "gauge", "blocks of the current epoch a consumer has not yet "
+        "acked (eligible minus consumed)", ("job", "consumer"),
+        "blocks", None),
+    "ray_tpu_data_service_shards_granted_total": (
+        "counter", "shard grants issued by the dispatcher",
+        ("job", "mode"), "shards", None),
     # ---- train loop ----
     "ray_tpu_train_step_time_s": (
         "histogram", "wall time between session.report() calls",

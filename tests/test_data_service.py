@@ -1,0 +1,508 @@
+"""Shared data service (ISSUE 17): one dispatcher + autoscaled data
+workers feed many jobs. Covers both sharding modes, the coordinated
+epoch barrier, shared production across jobs, the PR-11 fast_forward
+seek, direct (relay-free) block delivery, and the device-loader
+shutdown path. Chaos legs (worker/dispatcher SIGKILL, gang reshard,
+full acceptance) are `slow`-marked and build their own runtimes.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data import service
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.shutdown()
+    r = ray_tpu.init(num_cpus=8)
+    yield r
+    try:
+        service.shutdown_service()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def _tokens_ds(n_rows=160, block_rows=10):
+    """16-block pipeline; each block maps 1:1 so bids are predictable."""
+    return rd.range_(n_rows, block_rows=block_rows).map_batches(
+        lambda b: {"x": b["id"] * 2})
+
+
+def _consume(job, rank, cid, out, limit=None):
+    it = service.iterator(job, rank=rank, consumer_id=cid)
+    rows = 0
+    for i, b in enumerate(it):
+        rows += len(next(iter(b.values())))
+        if limit is not None and i + 1 >= limit:
+            break
+    it.close()
+    out[cid] = {"rows": rows, "bids": sorted(it.consumed_bids),
+                "stats": dict(it.stats)}
+
+
+def _expected_bids(epochs, n_blocks=16, n_slices=4):
+    exp = set()
+    for e in range(epochs):
+        for i in range(n_blocks):
+            exp.add(f"e{e}-s{i % n_slices}-b{i // n_slices}")
+    return exp
+
+
+# ---------- plan registration ----------
+
+def test_plan_rejects_cluster_topology_stages():
+    ds = rd.range_(64).random_shuffle()
+    with pytest.raises(ValueError, match="shuffle"):
+        service.plan_bytes_of(ds)
+
+
+def test_register_is_idempotent_and_shares_by_name(rt):
+    ds = _tokens_ds()
+    k1 = ds.to_service("reg_a", dataset_name="reg_shared")
+    k2 = ds.to_service("reg_b", mode="rr", world_size=1,
+                       dataset_name="reg_shared")
+    assert k1 == k2 == "reg_shared"
+    # same job re-registered with the same world: no reshard
+    k3 = ds.to_service("reg_a", dataset_name="reg_shared")
+    assert k3 == k1
+    st = service._call("stats")
+    assert "reg_shared" in st["datasets"]
+    assert st["jobs"]["reg_a"]["generation"] == \
+        st["jobs"]["reg_a"]["generation"]
+
+
+def test_bad_mode_rejected(rt):
+    with pytest.raises(ValueError, match="mode"):
+        _tokens_ds().to_service("bad_mode", mode="zigzag")
+
+
+# ---------- sharding modes + census ----------
+
+def test_fcfs_two_consumers_exact_census(rt):
+    _tokens_ds().to_service("fcfs2", mode="fcfs", epochs=1,
+                            n_slices=4, dataset_name="ds_fcfs2")
+    out = {}
+    ts = [threading.Thread(target=_consume,
+                           args=("fcfs2", None, f"c{i}", out))
+          for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    assert len(out) == 2
+    bids = out["c0"]["bids"] + out["c1"]["bids"]
+    assert sorted(bids) == sorted(_expected_bids(1))   # zero lost
+    assert len(set(bids)) == len(bids)                 # zero duplicated
+    assert out["c0"]["rows"] + out["c1"]["rows"] == 160
+
+
+def test_round_robin_is_deterministic_by_rank(rt):
+    _tokens_ds().to_service("rr2", mode="round_robin", world_size=2,
+                            epochs=1, n_slices=4, dataset_name="ds_rr2")
+    out = {}
+    ts = [threading.Thread(target=_consume,
+                           args=("rr2", r, f"g{r}", out))
+          for r in range(2)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    # static assignment: rank r owns exactly the blocks with idx%2==r
+    exp = sorted(_expected_bids(1))
+    by_idx = {i: f"e0-s{i % 4}-b{i // 4}" for i in range(16)}
+    for r in range(2):
+        want = sorted(by_idx[i] for i in range(16) if i % 2 == r)
+        assert out[f"g{r}"]["bids"] == want
+    assert sorted(out["g0"]["bids"] + out["g1"]["bids"]) == exp
+
+
+def test_epoch_barrier_orders_epochs(rt):
+    _tokens_ds().to_service("ep2", mode="fcfs", epochs=2, n_slices=4,
+                            dataset_name="ds_ep2")
+    out = {}
+    _consume("ep2", None, "e_c0", out)
+    bids = out["e_c0"]["bids"]
+    assert len(bids) == 32
+    # single consumer: grant ORDER is epoch-monotonic (no e1 block is
+    # handed out until every e0 block was granted)
+    it_epochs = [int(b[1]) for b in sorted(bids)]
+    assert sorted(it_epochs) == it_epochs
+
+
+def test_shared_production_two_jobs_each_get_full_set(rt):
+    ds = _tokens_ds()
+    ds.to_service("share_a", mode="fcfs", epochs=1,
+                  dataset_name="ds_share", n_slices=4)
+    ds.to_service("share_b", mode="round_robin", world_size=1, epochs=1,
+                  dataset_name="ds_share", n_slices=4)
+    out = {}
+    ts = [threading.Thread(target=_consume,
+                           args=("share_a", None, "sa", out)),
+          threading.Thread(target=_consume,
+                           args=("share_b", 0, "sb", out))]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    exp = sorted(_expected_bids(1))
+    assert out["sa"]["bids"] == exp
+    assert out["sb"]["bids"] == exp
+    # production ran ONCE: one epoch ledger, both jobs on it
+    st = service._call("stats")
+    assert set(st["prod"]["ds_share"]["0"]["jobs"]) == \
+        {"share_a", "share_b"}
+
+
+def test_delivery_is_direct_relay_bytes_zero(rt):
+    _tokens_ds().to_service("relay0", mode="fcfs", epochs=1,
+                            n_slices=2, dataset_name="ds_relay0")
+    out = {}
+    _consume("relay0", None, "r_c0", out)
+    assert out["r_c0"]["stats"]["blocks"] == 16
+    assert out["r_c0"]["stats"]["relay_bytes"] == 0
+
+
+# ---------- fast_forward seek ----------
+
+def test_fast_forward_skips_absolute_prefix(rt):
+    _tokens_ds().to_service("ffwd", mode="round_robin", world_size=1,
+                            epochs=1, n_slices=4, dataset_name="ds_ffwd")
+    it = service.iterator("ffwd", rank=0, consumer_id="ff_c0")
+    skipped = it.fast_forward(5)
+    assert skipped == 5
+    rest = list(it)
+    assert len(rest) == 11
+    # the seek auto-acked the idx-order prefix WITHOUT delivering it:
+    # the client only ever fetched the 11 remaining blocks
+    by_idx = [f"e0-s{i % 4}-b{i // 4}" for i in range(16)]
+    assert sorted(it.consumed_bids) == sorted(by_idx[5:])
+
+
+def test_fast_forward_noop_when_already_past(rt):
+    _tokens_ds().to_service("ffwd2", mode="fcfs", epochs=1,
+                            n_slices=4, dataset_name="ds_ffwd2")
+    it = service.iterator("ffwd2", consumer_id="ff2_c0")
+    next(it)
+    it.flush_acks()
+    assert it.fast_forward(1) == 0      # already consumed 1
+    n = 1 + sum(1 for _ in it)
+    assert n == 16
+
+
+# ---------- telemetry ----------
+
+def test_service_events_and_metrics_flow(rt):
+    _tokens_ds().to_service("tele", mode="fcfs", epochs=1,
+                            n_slices=2, dataset_name="ds_tele")
+    out = {}
+    _consume("tele", None, "t_c0", out)
+    deadline = time.time() + 10
+    got = set()
+    while time.time() < deadline:
+        rt.drain_local_events()
+        rows, _ = rt.cluster_events.query(
+            types=["data.service.register", "data.service.shard.grant",
+                   "data.service.epoch", "data.service.worker.scale"],
+            limit=500)
+        got = {r["type"] for r in rows}
+        if len(got) == 4:
+            break
+        time.sleep(0.1)
+    assert "data.service.register" in got
+    assert "data.service.shard.grant" in got
+    assert "data.service.epoch" in got
+    assert "data.service.worker.scale" in got
+
+
+# ---------- device loader (satellite 2) ----------
+
+def test_device_loader_prefetch_knob(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DATA_PREFETCH_DEPTH", "3")
+    batches = [{"x": np.arange(4)} for _ in range(5)]
+    got = list(rd.device_put_iterator(iter(batches)))
+    assert len(got) == 5
+    assert got[0]["x"].dtype == np.int32   # int64 narrowed
+
+
+def test_device_loader_abandoned_iterator_releases_producer():
+    produced = []
+
+    def infinite():
+        i = 0
+        while True:
+            produced.append(i)
+            yield {"x": np.full(4, i)}
+            i += 1
+
+    it = rd.device_put_iterator(infinite(), prefetch=2)
+    first = next(it)
+    assert int(first["x"][0]) == 0
+    it.close()     # abandon mid-stream -> producer must stop
+    time.sleep(0.5)
+    n_after_close = len(produced)
+    time.sleep(0.5)
+    assert len(produced) == n_after_close, \
+        "producer thread kept running after the consumer abandoned it"
+    assert not any(t.name == "rtpu-device-loader" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_device_loader_closes_abandoned_source():
+    closed = []
+
+    class Src:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return {"x": np.arange(2)}
+
+        def close(self):
+            closed.append(True)
+
+    it = rd.device_put_iterator(Src(), prefetch=1)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and not closed:
+        time.sleep(0.05)
+    assert closed, "device loader never closed the abandoned source"
+
+
+def _slow_map(b):
+    time.sleep(0.04)
+    return {"x": b["id"] * 2}
+
+
+# ---------- chaos: data-worker SIGKILL (slow) ----------
+
+def _wait_workers(min_n, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = service._call("stats")
+        alive = [w for w, m in st["workers"].items()
+                 if m["state"] == "alive"]
+        if len(alive) >= min_n:
+            return alive
+        time.sleep(0.1)
+    raise AssertionError("data workers never came up")
+
+
+@pytest.mark.slow
+def test_chaos_data_worker_sigkill_mid_epoch(tmp_path):
+    """SIGKILL one data worker mid-epoch: its unconsumed blocks are
+    re-produced (skip_seqs keeps retired ones retired), the census
+    stays exact — zero lost, zero duplicated."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_DATA_SERVICE_MIN_WORKERS"] = "2"
+    try:
+        ray_tpu.init(num_cpus=8)
+        ds = rd.range_(400, block_rows=5).map_batches(
+            _slow_map)      # 80 blocks x ~40ms: several seconds/epoch
+        ds.to_service("chaos_w", mode="fcfs", epochs=1, n_slices=4,
+                      dataset_name="ds_chaos_w")
+        out = {}
+        th = threading.Thread(target=_consume,
+                              args=("chaos_w", None, "cw0", out))
+        th.start()
+        victims = _wait_workers(1)
+        from ray_tpu import api
+        h = api.get_actor(victims[0], timeout=10.0)
+        pid = api.get(h.pid.remote(), timeout=10.0)
+        # let some grants flow first, then kill MID-epoch
+        time.sleep(1.0)
+        acked_at_kill = service._call("stats")["jobs"]["chaos_w"]["acked"]
+        os.kill(pid, signal.SIGKILL)
+        assert acked_at_kill < 80, "epoch finished before the kill"
+        th.join(120)
+        assert not th.is_alive(), "consumer never finished"
+        exp = {f"e0-s{i % 4}-b{i // 4}" for i in range(80)}
+        assert sorted(out["cw0"]["bids"]) == sorted(exp)
+        assert out["cw0"]["rows"] == 400
+        assert out["cw0"]["stats"]["relay_bytes"] == 0
+    finally:
+        os.environ.pop("RAY_TPU_DATA_SERVICE_MIN_WORKERS", None)
+        ray_tpu.shutdown()
+
+
+# ---------- chaos: dispatcher SIGKILL with WAL (slow) ----------
+
+@pytest.mark.slow
+def test_chaos_dispatcher_sigkill_resumes_mid_epoch(tmp_path):
+    """SIGKILL the dispatcher mid-epoch with the WAL on: it restarts
+    from its __ray_save__ checkpoint (cursors + outstanding-shard
+    ledger + epoch seq intact), consumers reconcile and finish with an
+    exact census."""
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=8, state_dir=str(tmp_path / "wal"))
+        ds = rd.range_(400, block_rows=5).map_batches(_slow_map)
+        ds.to_service("chaos_d", mode="fcfs", epochs=2, n_slices=4,
+                      dataset_name="ds_chaos_d")
+        out = {}
+        th = threading.Thread(target=_consume,
+                              args=("chaos_d", None, "cd0", out))
+        th.start()
+        pid = service._call("pid")
+        inc0 = service._call("incarnation")
+        time.sleep(1.2)       # mid-epoch: some grants out, some acked
+        acked_at_kill = service._call("stats")["jobs"]["chaos_d"]["acked"]
+        assert acked_at_kill < 160, "run finished before the kill"
+        os.kill(pid, signal.SIGKILL)
+        th.join(180)
+        assert not th.is_alive(), "consumer never finished"
+        assert service._call("incarnation") > inc0, \
+            "dispatcher never restarted from checkpoint"
+        exp = {f"e{e}-s{i % 4}-b{i // 4}"
+               for e in range(2) for i in range(80)}
+        bids = out["cd0"]["bids"]
+        assert sorted(bids) == sorted(exp)       # zero lost
+        assert len(set(bids)) == len(bids)       # zero duplicated
+        assert out["cd0"]["rows"] == 800
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------- chaos: gang kill + reshard (slow) ----------
+
+@pytest.mark.slow
+def test_chaos_gang_kill_and_reshard_rebalances(tmp_path):
+    """Kill a 2-rank round-robin gang mid-epoch, re-register at
+    world=1 (the PR-11 reform path), fast_forward the surviving
+    consumer to its checkpointed position: already-acked blocks stay
+    acked, the new rank 0 owns ALL remaining blocks, census exact."""
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=8)
+        ds = rd.range_(160, block_rows=10).map_batches(
+            lambda b: {"x": b["id"] * 2})
+        ds.to_service("gang_r", mode="round_robin", world_size=2,
+                      epochs=1, n_slices=4, dataset_name="ds_gang_r")
+        # each rank consumes 3 blocks, then the gang "dies"
+        pre = {}
+        for r in range(2):
+            out = {}
+            _consume("gang_r", r, f"old{r}", out, limit=3)
+            pre[r] = out[f"old{r}"]["bids"]
+        assert len(pre[0]) == 3 and len(pre[1]) == 3
+        # reform: re-register world=1 -> generation bump + grant revoke
+        ds.to_service("gang_r", mode="round_robin", world_size=1,
+                      epochs=1, dataset_name="ds_gang_r")
+        st = service._call("stats")
+        assert st["jobs"]["gang_r"]["world"] == 1
+        # the reformed rank seeks to its own checkpointed position
+        # (trainer step count), then owns every remaining block
+        it = service.iterator("gang_r", rank=0, consumer_id="new0")
+        assert it.fast_forward(2) == 2
+        rest = list(it)
+        new_bids = sorted(it.consumed_bids)
+        delivered = pre[0] + pre[1] + new_bids
+        exp = {f"e0-s{i % 4}-b{i // 4}" for i in range(16)}
+        # zero duplicated: nothing acked by the dead ranks re-delivers
+        assert len(set(delivered)) == len(delivered)
+        assert set(delivered) <= exp
+        # the absolute seek acked exactly 2 blocks WITHOUT delivery
+        # (the trainer already trained on them pre-reshard); everything
+        # else was handed out exactly once
+        skipped = exp - set(delivered)
+        assert len(skipped) == 2
+        assert len(rest) == 16 - 6 - 2
+        # the reshard bumped the job generation, fencing stale handles
+        # from the dead gang (initial registration is generation 0)
+        st = service._call("stats")
+        assert st["jobs"]["gang_r"]["generation"] == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------- acceptance: trainer gang + sweep + double SIGKILL ----------
+
+@pytest.mark.slow
+def test_acceptance_two_jobs_survive_double_sigkill(tmp_path):
+    """End-to-end: an SpmdTrainer (8-device SPMD gang) and a 2-consumer
+    FCFS sweep share ONE registered dataset; the dispatcher AND a data
+    worker are SIGKILLed mid-run; both jobs complete with exact block
+    census and relay_bytes == 0 on every delivery."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_DATA_SERVICE_MIN_WORKERS"] = "2"
+    try:
+        ray_tpu.init(num_cpus=8, state_dir=str(tmp_path / "wal"))
+        rng = np.random.RandomState(0)
+        tok = rng.randint(0, 255, (320, 32))
+
+        def to_tokens(b):
+            return {"tokens": tok[b["id"] % 320]}
+
+        ds = rd.range_(320, block_rows=8).map_batches(to_tokens)
+        # 40 blocks/epoch; trainer sees 1 batch per block
+        ds.to_service("accept_train", mode="round_robin", world_size=1,
+                      epochs=1, n_slices=4, dataset_name="ds_accept")
+        ds.to_service("accept_sweep", mode="fcfs", epochs=1,
+                      n_slices=4, dataset_name="ds_accept")
+
+        train_it = service.iterator("accept_train", rank=0,
+                                    consumer_id="tr0")
+
+        def data():
+            for b in train_it:
+                yield {"tokens": np.asarray(b["tokens"],
+                                            dtype=np.int32)}
+
+        from ray_tpu.parallel import MeshSpec
+        from ray_tpu.train import (RunConfig, SpmdTrainer,
+                                   SpmdTrainerConfig)
+        cfg = SpmdTrainerConfig(model="llama-debug", mesh=MeshSpec(dp=8),
+                                total_steps=40, log_every=10,
+                                warmup_steps=2)
+        tr = SpmdTrainer(cfg, data, run_config=RunConfig(
+            name="accept", storage_path=str(tmp_path / "run")))
+        box = {}
+
+        def run_fit():
+            try:
+                box["res"] = tr.fit()
+            except BaseException as e:  # noqa: BLE001
+                box["err"] = e
+
+        sweep_out = {}
+        threads = [threading.Thread(target=run_fit),
+                   threading.Thread(target=_consume,
+                                    args=("accept_sweep", None, "sw0",
+                                          sweep_out)),
+                   threading.Thread(target=_consume,
+                                    args=("accept_sweep", None, "sw1",
+                                          sweep_out))]
+        [t.start() for t in threads]
+
+        # chaos: one data worker, then the dispatcher
+        victims = _wait_workers(1)
+        from ray_tpu import api
+        h = api.get_actor(victims[0], timeout=10.0)
+        wpid = api.get(h.pid.remote(), timeout=10.0)
+        time.sleep(1.0)
+        os.kill(wpid, signal.SIGKILL)
+        time.sleep(1.0)
+        dpid = service._call("pid")
+        os.kill(dpid, signal.SIGKILL)
+
+        [t.join(300) for t in threads]
+        assert not any(t.is_alive() for t in threads), "jobs hung"
+        assert "err" not in box, box.get("err")
+        assert box["res"].metrics["step"] == 40
+
+        exp = {f"e0-s{i % 4}-b{i // 4}" for i in range(40)}
+        # trainer: consumed exactly the full set, no duplicates
+        tr_bids = sorted(train_it.consumed_bids)
+        assert tr_bids == sorted(exp)
+        assert train_it.stats["relay_bytes"] == 0
+        # sweep: the two consumers partition the full set exactly
+        sw = sweep_out["sw0"]["bids"] + sweep_out["sw1"]["bids"]
+        assert sorted(sw) == sorted(exp)
+        assert len(set(sw)) == len(sw)
+        assert sweep_out["sw0"]["stats"]["relay_bytes"] == 0
+        assert sweep_out["sw1"]["stats"]["relay_bytes"] == 0
+    finally:
+        os.environ.pop("RAY_TPU_DATA_SERVICE_MIN_WORKERS", None)
+        ray_tpu.shutdown()

@@ -130,6 +130,19 @@ def test_catalog_requires_profiler_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_data_service_events():
+    """The shared data service's lifecycle chain (register -> grant ->
+    ack/revoke -> epoch -> worker scale) backs the chaos/acceptance
+    census assertions in tests/test_data_service.py and the
+    docs/DATA_SERVICE.md failure matrix — the catalog must keep
+    carrying it."""
+    for required in ("data.service.register", "data.service.epoch",
+                     "data.service.shard.grant",
+                     "data.service.shard.revoke",
+                     "data.service.worker.scale"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics
@@ -137,7 +150,7 @@ def test_no_uncataloged_event_literals():
     pkg = os.path.join(REPO, "ray_tpu")
     call = re.compile(
         r"(?:emit|emit_safe|_emit|_event|_emit_event|_emit_serve_event)"
-        r"\(\s*['\"]((?:[a-z0-9_]+\.){1,2}[a-z0-9_]+)['\"]")
+        r"\(\s*['\"]((?:[a-z0-9_]+\.){1,3}[a-z0-9_]+)['\"]")
     offenders = []
     for root, _dirs, files in os.walk(pkg):
         for f in files:

@@ -156,6 +156,20 @@ def test_catalog_requires_observability_fastpath_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_data_service_metrics():
+    """The shared data service's backpressure/lag surface (queue depth,
+    outstanding grants, per-consumer lag, grant volume) backs the
+    docs/DATA_SERVICE.md knob guidance and the bench gate — the
+    catalog must keep carrying it."""
+    for required, kind in (
+            ("ray_tpu_data_service_queue_depth", "gauge"),
+            ("ray_tpu_data_service_outstanding_shards", "gauge"),
+            ("ray_tpu_data_service_consumer_lag", "gauge"),
+            ("ray_tpu_data_service_shards_granted_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_steady_state_workload_zero_wire_fallbacks(rt):
     """Every control frame a steady-state workload produces — task
     submits/dones, leases, seals, actor calls, AND the telemetry delta

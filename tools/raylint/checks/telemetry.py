@@ -25,8 +25,9 @@ from .common import dotted, receiver, terminal_name
 # callee terminal names that take an event type as first argument
 _EMIT_NAMES = {"emit", "emit_safe", "_emit", "emit_event", "_ev_emit"}
 
-# event types look like "<subsystem>.<event>[.<event>]"
-_EVENT_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,2}$")
+# event types look like "<subsystem>.<event>" with up to two extra
+# namespace segments (serve.replica.*, data.service.shard.*)
+_EVENT_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){1,3}$")
 
 # receivers that resolve metric names through the catalog
 _MCAT_NAMES = {"mcat", "_mcat", "metrics_catalog"}
