@@ -12,10 +12,13 @@ Design invariants (docs/DATA_SERVICE.md holds the long form):
 
   * **Produce once, feed many.** A dataset plan is keyed by its
     serialized bytes; every JOB registered against that key shares one
-    production run per epoch. Within a job, consumers split the job's
-    view: `fcfs` (dynamic first-come-first-served, tune sweeps) or
-    `round_robin` (deterministic by block index modulo world, SPMD
-    ranks).
+    production run per epoch (a key collision with a DIFFERENT plan is
+    rejected, never silently shared). Within a job, consumers split
+    the job's view: `fcfs` (dynamic first-come-first-served, tune
+    sweeps) or `round_robin` (deterministic by block index modulo
+    world, SPMD ranks). A job may register LATE: blocks already
+    retired by earlier jobs are revived (retired flag cleared, owning
+    slices re-pended) and re-produced under their deterministic ids.
   * **Deterministic block identity.** A block produced by slice `s`
     of epoch `e` at position `q` is ALWAYS `e{e}-s{s}-b{q}`, with
     canonical global index `q * n_slices + s`. Re-producing a slice
@@ -31,7 +34,10 @@ Design invariants (docs/DATA_SERVICE.md holds the long form):
     grants are revoked back to the pending pool and the consumer is
     fenced; a fenced consumer's next call gets "stale" and must
     re-attach + reconcile. Generations stamp jobs (reshard) and
-    consumers (re-attach) so stale acks/grants are rejected.
+    consumers (re-attach) so stale acks/grants/refetches are
+    rejected. `next_shard` is idempotent per client request nonce —
+    an RPC retry after a lost reply replays the original grant
+    instead of stranding it.
   * **Restore closes the grant/checkpoint race.** The checkpoint
     ships AFTER the reply, so a SIGKILL between reply and checkpoint
     can lose a grant record. `__ray_restore__` therefore flags every
@@ -263,9 +269,18 @@ class DataServiceDispatcher:
     def register_dataset(self, key: str, plan_blob: bytes,
                          n_slices: int) -> Dict[str, Any]:
         with self._lock:
-            if key not in self._datasets:
+            ds = self._datasets.get(key)
+            if ds is None:
                 self._datasets[key] = {"plan": plan_blob,
                                        "n_slices": int(n_slices)}
+            elif ds["plan"] != plan_blob:
+                # sharing a key across jobs means sharing PRODUCTION;
+                # silently keeping the first plan would feed the
+                # second job someone else's data
+                return {"error":
+                        f"dataset {key!r} is already registered "
+                        f"with a different plan; use a distinct "
+                        f"dataset_name (or the same plan) to share"}
             return {"ok": True, "n_slices":
                     self._datasets[key]["n_slices"]}
 
@@ -290,6 +305,7 @@ class DataServiceDispatcher:
                 for e, ep in (self._prod.get(key) or {}).items():
                     if e < int(epochs) and job_name not in ep["jobs"]:
                         ep["jobs"].append(job_name)
+                        self._revive_retired_locked(key, ep, job_name)
                 gen = 0
             elif job["world"] != int(world) or job["mode"] != mode:
                 job["generation"] += 1
@@ -316,6 +332,33 @@ class DataServiceDispatcher:
               job=job_name, dataset=key[:12], mode=mode,
               world=str(world), generation=str(gen))
         return {"generation": gen}
+
+    def _revive_retired_locked(self, key: str, ep: Dict[str, Any],
+                               job_name: str) -> None:
+        """A job joined an epoch late: blocks retired (ref dropped)
+        before it registered were only acked by the PREVIOUS jobs and
+        must be re-produced for the newcomer. Clear their retired flag
+        (so re-offers are accepted and dispatch stops skip-listing
+        their seqs) and re-pend the done slices that own them; slices
+        still running converge through slice_done's missing-bid check.
+        Double production is harmless — offers dedup by deterministic
+        block id."""
+        ds = self._datasets.get(key)
+        if ds is None:
+            return
+        n_slices = ds["n_slices"]
+        stale_slices: Set[int] = set()
+        for m in ep["bids"].values():
+            if m.get("retired") and job_name not in m["acked_by"]:
+                m["retired"] = False
+                stale_slices.add(m["idx"] % n_slices)
+        for i in stale_slices:
+            sl = ep["slices"].get(i)
+            if sl is not None and sl["state"] == "done":
+                sl["state"] = "pending"
+                sl["worker"] = None
+        if stale_slices:
+            ep["complete"] = False
 
     def attach_consumer(self, job_name: str, cid: str,
                         rank: Optional[int] = None) -> Dict[str, Any]:
@@ -345,6 +388,10 @@ class DataServiceDispatcher:
                 cons["fenced"] = False
                 cons["lease"] = lease
                 cons["rank"] = rank
+                # the old incarnation's grants are about to revoke:
+                # its cached next_shard reply must not replay
+                cons.pop("last_req", None)
+                cons.pop("last_reply", None)
                 gen = cons["generation"]
                 revoked = [b for b, c in job["granted"].items()
                            if c == cid]
@@ -410,12 +457,16 @@ class DataServiceDispatcher:
             m["retired"] = True
 
     def next_shard(self, job_name: str, cid: str, gen: int,
-                   acks: Optional[List[str]] = None) -> Dict[str, Any]:
+                   acks: Optional[List[str]] = None,
+                   req: Optional[str] = None) -> Dict[str, Any]:
         """The consumer verb: piggybacked acks + one grant attempt.
         Never blocks — barrier / production lag / reconcile gates
         return {"status": "wait"|"reconcile"|...} for the client to
-        poll."""
-        granted: Optional[Tuple[str, Dict[str, Any], int]] = None
+        poll. `req` is the client's per-request nonce: a retried call
+        (RPC reply lost in transit) replays the cached grant instead
+        of handing out a second block, so the verb is idempotent and
+        no grant is ever stranded on a timed-out reply."""
+        reply: Optional[Dict[str, Any]] = None
         advanced: Optional[int] = None
         with self._lock:
             job = self._jobs.get(job_name)
@@ -434,6 +485,11 @@ class DataServiceDispatcher:
                 return {"status": "reconcile"}
             if job["needs_reconcile"]:
                 return {"status": "wait", "why": "peers reconciling"}
+            if req is not None and cons.get("last_req") == req:
+                # retry of a request whose reply we already computed:
+                # replay it (the cached grant is still in job
+                # ["granted"] for this cid)
+                return dict(cons["last_reply"])
             e = job["epoch"]
             if e >= job["epochs"]:
                 return {"status": "end"}
@@ -451,26 +507,31 @@ class DataServiceDispatcher:
                 idx, b = cands[0]
                 m = ep["bids"][b]
                 job["granted"][b] = cid
-                granted = (b, m, e)
+                reply = {"status": "grant", "bid": b,
+                         "ref": m["ref"], "nbytes": m["nbytes"],
+                         "epoch": e, "idx": m["idx"]}
+                if req is not None:
+                    cons["last_req"] = req
+                    cons["last_reply"] = dict(reply)
         if advanced is not None:
             _emit("data.service.epoch",
                   f"job {job_name} advanced to epoch {advanced}",
                   job=job_name, epoch=str(advanced))
             return {"status": "wait", "why": "epoch advanced",
                     "epoch": advanced}
-        if granted is None:
+        if reply is None:
             return {"status": "wait",
                     "why": "barrier or production lag"}
-        b, m, e = granted
         _emit("data.service.shard.grant",
-              f"shard {b} granted to {cid} (job {job_name})",
-              job=job_name, bid=b, consumer=cid, epoch=str(e))
+              f"shard {reply['bid']} granted to {cid} "
+              f"(job {job_name})",
+              job=job_name, bid=reply["bid"], consumer=cid,
+              epoch=str(reply["epoch"]))
         c = _mcat_get("ray_tpu_data_service_shards_granted_total")
         if c is not None:
             c.inc(tags={"job": job_name,
                         "mode": self._jobs[job_name]["mode"]})
-        return {"status": "grant", "bid": b, "ref": m["ref"],
-                "nbytes": m["nbytes"], "epoch": e, "idx": m["idx"]}
+        return reply
 
     def ack(self, job_name: str, cid: str, gen: int,
             acks: List[str]) -> Dict[str, Any]:
@@ -508,6 +569,9 @@ class DataServiceDispatcher:
                        if c == cid]
             for b in dropped:
                 del job["granted"][b]
+            # dropped grants must not replay out of the nonce cache
+            cons.pop("last_req", None)
+            cons.pop("last_reply", None)
             job["needs_reconcile"].discard(cid)
         for b in dropped:
             _emit("data.service.shard.revoke",
@@ -516,14 +580,26 @@ class DataServiceDispatcher:
                   cause="reconcile")
         return {"ok": True}
 
-    def refetch(self, job_name: str, cid: str, bid: str
+    def refetch(self, job_name: str, cid: str, gen: int, bid: str
                 ) -> Dict[str, Any]:
         """A consumer's get() on a granted ref failed (holder worker
-        died): return the re-produced ref once available."""
+        died): return the re-produced ref once available. Fenced the
+        same way as next_shard/ack — a stale consumer must not keep
+        pulling refs for a block that was revoked and re-granted
+        elsewhere (that would double-deliver the value)."""
         with self._lock:
             job = self._jobs.get(job_name)
             if job is None:
-                return {"status": "stale"}
+                return {"status": "stale",
+                        "why": f"unknown job {job_name!r}"}
+            cons = job["consumers"].get(cid)
+            if cons is None or cons["fenced"] \
+                    or gen != cons["generation"]:
+                return {"status": "stale", "why": "fenced or stale "
+                        "generation; re-attach and reconcile"}
+            if job["granted"].get(bid) != cid:
+                return {"status": "stale",
+                        "why": f"{bid} is not granted to {cid}"}
             for ep in (self._prod.get(job["dataset"]) or {}).values():
                 m = ep["bids"].get(bid)
                 if m is not None:
@@ -603,11 +679,24 @@ class DataServiceDispatcher:
                    worker: str) -> Dict[str, Any]:
         with self._lock:
             ep = (self._prod.get(key) or {}).get(epoch)
-            if ep is None:
+            ds = self._datasets.get(key)
+            if ep is None or ds is None:
                 return {"ok": False}
             sl = ep["slices"].get(slice_idx)
             if sl is not None:
-                sl["state"] = "done"
+                # a bid with no ref that is NOT retired was revived
+                # mid-run (late job registration) or lost: this run's
+                # skip list predates it, so the slice must go around
+                # again with a fresh skip list
+                missing = any(
+                    m["ref"] is None and not m.get("retired")
+                    and m["idx"] % ds["n_slices"] == slice_idx
+                    for m in ep["bids"].values())
+                if missing:
+                    sl["state"] = "pending"
+                    sl["worker"] = None
+                else:
+                    sl["state"] = "done"
             w = self._workers.get(worker)
             if w is not None and w.get("busy") == (key, epoch,
                                                   slice_idx):
@@ -1061,6 +1150,11 @@ class StaleConsumerError(RuntimeError):
     reconcile could not recover it."""
 
 
+class _GrantRevoked(Exception):
+    """A granted shard was revoked mid-fetch (lease expiry / reshard):
+    the value must not be consumed — re-attach and re-request."""
+
+
 def start_service(*, min_workers: Optional[int] = None,
                   max_workers: Optional[int] = None,
                   name: str = SERVICE_ACTOR_NAME):
@@ -1189,7 +1283,10 @@ class DataServiceIterator:
 
     def _fetch(self, grant: Dict[str, Any]):
         """Pull the block value; if the holder died mid-flight, poll
-        refetch until the re-produced copy lands."""
+        refetch until the re-produced copy lands. Raises _GrantRevoked
+        if the dispatcher fenced us meanwhile (the block may already
+        be re-granted to another consumer — consuming it here would
+        double-deliver)."""
         from ..core.object_ref import ObjectRef  # noqa: PLC0415
         api = _api()
         rt = self._runtime()
@@ -1204,9 +1301,11 @@ class DataServiceIterator:
                 if time.time() > deadline:
                     raise
                 out = _call("refetch", self._job, self._cid,
-                            grant["bid"], name=self._name)
+                            self._gen, grant["bid"], name=self._name)
                 if out.get("status") == "grant":
                     ref_id = out["ref"]
+                elif out.get("status") == "stale":
+                    raise _GrantRevoked(out.get("why", "stale"))
                 else:
                     time.sleep(_knob_float(
                         "RAY_TPU_DATA_SERVICE_POLL_S"))
@@ -1224,16 +1323,31 @@ class DataServiceIterator:
     def __next__(self):
         if self._done:
             raise StopIteration
+        import uuid  # noqa: PLC0415
         poll_s = _knob_float("RAY_TPU_DATA_SERVICE_POLL_S")
         stale_retries = 3
         while True:
+            # per-request nonce: _call may retry the RPC after a lost
+            # reply — the same nonce makes the dispatcher replay the
+            # original grant instead of handing out a second block
+            req = uuid.uuid4().hex[:12]
             out = _call("next_shard", self._job, self._cid,
-                        self._gen, self._pending_acks,
+                        self._gen, self._pending_acks, req,
                         name=self._name)
             status = out.get("status")
             if status == "grant":
                 self._pending_acks = []
-                value = self._fetch(out)
+                try:
+                    value = self._fetch(out)
+                except _GrantRevoked:
+                    # revoked mid-fetch: nothing consumed — reconcile
+                    # returns the shard to pending and we re-request
+                    stale_retries -= 1
+                    if stale_retries < 0:
+                        raise StaleConsumerError(
+                            f"consumer {self._cid} fenced mid-fetch")
+                    self._reattach()
+                    continue
                 b = out["bid"]
                 self._consumed.append(b)
                 self._pending_acks = [b]

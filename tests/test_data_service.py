@@ -155,6 +155,95 @@ def test_shared_production_two_jobs_each_get_full_set(rt):
         {"share_a", "share_b"}
 
 
+def test_late_joining_job_gets_retired_blocks_reproduced(rt):
+    """A job registering AFTER another job consumed (and retired) the
+    shared blocks must see them re-produced — the headline use case of
+    a long-lived plane with jobs joining at different times."""
+    ds = _tokens_ds()
+    ds.to_service("late_a", mode="fcfs", epochs=1, n_slices=4,
+                  dataset_name="ds_late")
+    out = {}
+    _consume("late_a", None, "la0", out)
+    assert sorted(out["la0"]["bids"]) == sorted(_expected_bids(1))
+    st = service._call("stats")
+    assert st["jobs"]["late_a"]["acked"] == 16
+    # sole job acked everything: every ref was dropped (retired)
+    assert st["queue_depth"]["ds_late"] == 0
+    # the late joiner revives the retired blocks and re-produces them
+    ds.to_service("late_b", mode="fcfs", epochs=1, n_slices=4,
+                  dataset_name="ds_late")
+    th = threading.Thread(target=_consume,
+                          args=("late_b", None, "lb0", out))
+    th.start()
+    th.join(60)
+    assert not th.is_alive(), "late joiner hung on retired blocks"
+    assert sorted(out["lb0"]["bids"]) == sorted(_expected_bids(1))
+    assert out["lb0"]["rows"] == 160
+
+
+def _draw_grant(job, cid, gen, nonce, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = service._call("next_shard", job, cid, gen, [], nonce)
+        if out.get("status") == "grant":
+            return out
+        time.sleep(0.1)
+    raise AssertionError(f"no grant for {cid} before timeout")
+
+
+def test_next_shard_retry_with_same_nonce_replays_grant(rt):
+    """An RPC retry after a lost reply must replay the SAME grant
+    (idempotent per nonce) — not hand out a second block and strand
+    the first one in the granted ledger forever."""
+    _tokens_ds().to_service("idem", mode="fcfs", epochs=1, n_slices=4,
+                            dataset_name="ds_idem")
+    gen = service._call("attach_consumer", "idem", "id_c0",
+                        None)["generation"]
+    out = _draw_grant("idem", "id_c0", gen, "n1")
+    again = service._call("next_shard", "idem", "id_c0", gen, [], "n1")
+    assert again["status"] == "grant"
+    assert again["bid"] == out["bid"]
+    assert service._call("stats")["jobs"]["idem"]["granted"] == 1
+    # a fresh nonce draws the next block
+    nxt = service._call("next_shard", "idem", "id_c0", gen, [], "n2")
+    assert nxt["status"] == "grant"
+    assert nxt["bid"] != out["bid"]
+
+
+def test_register_dataset_conflicting_plan_rejected(rt):
+    """Two jobs naming the same dataset with byte-different plans must
+    NOT silently share the first plan's data."""
+    _tokens_ds().to_service("plan_a", dataset_name="ds_conflict")
+    other = rd.range_(64, block_rows=8).map_batches(
+        lambda b: {"y": b["id"] + 1})
+    with pytest.raises(ValueError, match="different plan"):
+        other.to_service("plan_b", dataset_name="ds_conflict")
+
+
+def test_refetch_requires_grant_and_generation(rt):
+    """refetch is fenced like next_shard/ack: wrong generation, wrong
+    consumer, or an ungranted bid all get 'stale' instead of a ref."""
+    _tokens_ds().to_service("rf", mode="fcfs", epochs=1, n_slices=4,
+                            dataset_name="ds_rf")
+    gen = service._call("attach_consumer", "rf", "rf_c0",
+                        None)["generation"]
+    out = _draw_grant("rf", "rf_c0", gen, "r1")
+    bid = out["bid"]
+    ok = service._call("refetch", "rf", "rf_c0", gen, bid)
+    assert ok["status"] == "grant" and ok["ref"] == out["ref"]
+    # stale generation is fenced
+    st = service._call("refetch", "rf", "rf_c0", gen + 1, bid)
+    assert st["status"] == "stale"
+    # another consumer cannot pull a block granted elsewhere
+    gen2 = service._call("attach_consumer", "rf", "rf_c1",
+                         None)["generation"]
+    st = service._call("refetch", "rf", "rf_c1", gen2, bid)
+    assert st["status"] == "stale"
+    # an ungranted bid is fenced too
+    st = service._call("refetch", "rf", "rf_c0", gen, "e0-s0-b999")
+    assert st["status"] == "stale"
+
+
 def test_delivery_is_direct_relay_bytes_zero(rt):
     _tokens_ds().to_service("relay0", mode="fcfs", epochs=1,
                             n_slices=2, dataset_name="ds_relay0")
