@@ -1063,30 +1063,100 @@ def phase_core() -> dict:
 
             ray_tpu.get([_sleep_r.remote()
                          for _ in range(16 * agents_n)], timeout=180)
-            t0 = time.time()
-            ray_tpu.get([_noop_r.remote() for _ in range(n_sc)],
-                        timeout=600)
-            sc_noop = n_sc / (time.time() - t0)
-            t0 = time.time()
-            ray_tpu.get([_sleep_r.remote() for _ in range(n_sc)],
-                        timeout=600)
-            sc_sleep = n_sc / (time.time() - t0)
+
+            def _settle(budget=3.0):
+                # steady state between rounds: let open node leases
+                # drain/close and trailing ack batches flush, so a
+                # round measures dispatch throughput rather than the
+                # previous round's tail (same reason the top-level
+                # legs take best-of-3)
+                deadline = time.time() + budget
+                while time.time() < deadline and rt.node_leases:
+                    time.sleep(0.05)
+                time.sleep(0.5)
+
+            # noop rounds are short (~0.2s at n_sc) — double the batch
+            # so one scheduler hiccup can't swing a round by 10%
+            n_noop = 2 * n_sc
+            ray_tpu.get([_noop_r.remote() for _ in range(n_noop)],
+                        timeout=600)   # warm the grant path
+            sc_noop = 0.0
+            for _ in range(7):
+                _settle()
+                t0 = time.time()
+                ray_tpu.get([_noop_r.remote() for _ in range(n_noop)],
+                            timeout=600)
+                sc_noop = max(sc_noop, n_noop / (time.time() - t0))
+            sc_sleep = 0.0
+            for _ in range(2):
+                _settle()
+                t0 = time.time()
+                ray_tpu.get([_sleep_r.remote() for _ in range(n_sc)],
+                            timeout=600)
+                sc_sleep = max(sc_sleep, n_sc / (time.time() - t0))
             actors = [_SleepActor.remote() for _ in range(2 * agents_n)]
             ray_tpu.get([a.hold.remote() for a in actors], timeout=180)
             t0 = time.time()
             ray_tpu.get([actors[i % len(actors)].hold.remote()
                          for i in range(n_sc)], timeout=600)
             sc_actor = n_sc / (time.time() - t0)
+
+            # release the sleep actors' worker slots first — the trial
+            # drivers and their nested fan-outs need the agent CPUs
+            for a in actors:
+                ray_tpu.kill(a)
+            deadline = time.time() + 30
+            while time.time() < deadline and any(
+                    w.state != "dead"
+                    for w in rt.workers.values()
+                    if w.actor_id is not None):
+                time.sleep(0.05)
+
+            # tune-style sweep: dozens of concurrent trial drivers,
+            # each submitting fan-outs from ITS worker. With two-level
+            # scheduling the nested tasks place on the trial's own
+            # node agent (standing leases, zero driver frames steady-
+            # state), so aggregate throughput tracks agent count
+            # instead of the driver's dispatch ceiling.
+            trials_n = 6 * agents_n
+            width = int(os.environ.get(
+                "RAY_TPU_BENCH_CORE_SWEEP_WIDTH", "25"))
+            rounds = int(os.environ.get(
+                "RAY_TPU_BENCH_CORE_SWEEP_ROUNDS", "3"))
+
+            @ray_tpu.remote(num_cpus=0.05, resources={"agent": 0.001},
+                            scheduling_strategy="SPREAD")
+            class _Trial:
+                def run(self, rounds, width):
+                    for _ in range(rounds):
+                        ray_tpu.get(
+                            [_noop_r.remote() for _ in range(width)],
+                            timeout=300)
+                    return rounds * width
+
+            trials = [_Trial.remote() for _ in range(trials_n)]
+            ray_tpu.get([t.run.remote(1, width) for t in trials],
+                        timeout=300)   # warm: standing leases form
+            t0 = time.time()
+            done = ray_tpu.get(
+                [t.run.remote(rounds, width) for t in trials],
+                timeout=600)
+            sc_sweep = sum(done) / (time.time() - t0)
+
             scaling[f"{agents_n}_agents"] = {
                 "noop_tasks_per_s": round(sc_noop, 1),
                 "sleep_tasks_per_s": round(sc_sleep, 1),
                 "sleep_actor_calls_per_s": round(sc_actor, 1),
+                "sweep_tasks_per_s": round(sc_sweep, 1),
+                "sweep_trials": trials_n,
                 "agent_slots": 2 * agents_n,
                 "io_ms": io_ms,
                 "n_calls": n_sc}
             _progress(f"core[scale x{agents_n}]: {sc_noop:.0f} noop "
                       f"tasks/s, {sc_sleep:.0f} sleep tasks/s, "
-                      f"{sc_actor:.0f} sleep actor calls/s")
+                      f"{sc_actor:.0f} sleep actor calls/s, "
+                      f"{sc_sweep:.0f} sweep tasks/s "
+                      f"({trials_n} trials)")
         except BaseException as e:  # noqa: BLE001
             scaling[f"{agents_n}_agents"] = {"error": repr(e)[:300]}
         finally:

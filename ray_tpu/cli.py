@@ -113,6 +113,15 @@ def cmd_dispatch(args):
           f"{s.get('actor_pipeline')})")
     print(f"dispatch frames:     {s.get('dispatch_frames')}"
           f"  ({s.get('dispatched_tasks')} tasks)")
+    if s.get("node_leases_enabled"):
+        print(f"node leases:         {s.get('node_lease_grants')} "
+              f"granted / {s.get('node_lease_extends')} extended / "
+              f"{s.get('node_leases_open')} open "
+              f"(cap {s.get('node_lease_slots')} slots/worker; "
+              f"{s.get('node_lease_tasks')} tasks agent-dispatched)")
+        print(f"spillbacks:          {s.get('spillbacks')}")
+    else:
+        print("node leases:         OFF (RAY_TPU_NODE_LEASES=0)")
     print(f"direct actor calls:  {s.get('direct_actor_calls', 0)}"
           f"  ({s.get('direct_call_fallbacks', 0)} fell back to the "
           f"driver path)")

@@ -19,6 +19,7 @@ bound address as `runtime.tcp_address`.
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import select
 import subprocess
@@ -32,12 +33,39 @@ from typing import Dict, Optional
 from . import resources as res_mod
 from .ids import new_node_id
 from .object_store import make_store
-from .protocol import ConnectionClosed, connect_address
+from .protocol import (Connection, ConnectionClosed, connect_address,
+                       unix_listener)
 from ..util import knobs
 
 # Cross-node payloads stream in frames well under protocol.MAX_MSG so one
 # huge object can never poison the connection with an oversized frame.
 FETCH_CHUNK = knobs.get_int("RAY_TPU_FETCH_CHUNK")
+
+# Host-resolvable location kinds: any worker on this node can read them
+# out of the shared arena (or a spill file), so they are safe to hand to
+# a sibling worker as pre-resolved dependency locations.
+_HOST_KINDS = ("shm", "native", "inline", "spill")
+
+
+class _AgentLease:
+    """Agent-side half of one node-level bulk lease (two-level
+    scheduling, docs/SCHEDULING.md): a resource shape, the local workers
+    the driver assigned to it, and a FIFO of tasks to fan across them.
+    Queue entries are `[spec, owner_conn_or_None, enqueue_time]` — owner
+    None means the driver granted the task (completions stream back as
+    `nlease_done`); a live owner conn means a local worker submitted it
+    (`asubmit`) and gets the result directly (`aresult`)."""
+
+    __slots__ = ("lid", "need", "wids", "queue", "standing",
+                 "last_activity")
+
+    def __init__(self, lid: str, need: dict, wids: set, standing: bool):
+        self.lid = lid
+        self.need = need
+        self.wids = wids
+        self.queue: collections.deque = collections.deque()
+        self.standing = standing
+        self.last_activity = time.monotonic()
 
 
 class NodeAgent:
@@ -81,6 +109,36 @@ class NodeAgent:
         # block spawns/frees), bounded so they can't starve the loop.
         self._fetch_sem = threading.Semaphore(4)
 
+        # ---- two-level scheduling: agent-local dispatch plane ----------
+        # The driver grants this agent bulk leases (batches of compatible
+        # tasks plus a set of local workers); the agent fans them across
+        # those workers over a node-local unix socket and refills slots
+        # as completions arrive, without driver round trips. Workers also
+        # submit their own fan-outs here (`asubmit`) for dependency-local
+        # placement. docs/SCHEDULING.md "Two-level scheduling".
+        self._nlease_enabled = knobs.get_bool("RAY_TPU_NODE_LEASES")
+        self._sched_lock = threading.RLock()
+        self._aworkers: Dict[str, Connection] = {}     # wid -> worker conn
+        # wid -> deque of (lease_id_or_"", spec, owner_conn_or_None):
+        # tasks in flight per worker, FIFO. Depth >1 pipelines the
+        # aexec/adone round trip so a worker never idles between
+        # sub-millisecond tasks; only the head can have started (the
+        # worker executes its backlog strictly in order), which is what
+        # the spill accounting relies on.
+        self._winflight: Dict[str, collections.deque] = {}
+        self._leases: Dict[str, _AgentLease] = {}
+        # worker-submitted tasks waiting for lease capacity of their shape
+        self._nested_q: collections.deque = collections.deque()
+        self._want_last: Dict[tuple, float] = {}
+        # host-kind seal locations of recent local results, for stamping
+        # pre-resolved dependency locations onto sibling dispatches
+        self._oid_locs: collections.OrderedDict = collections.OrderedDict()
+        self._agent_listener = None
+        self.agent_addr = ""
+        self._done_batch = None
+        if self._nlease_enabled:
+            self._start_agent_plane()
+
         # Peer-to-peer transfer plane (core/object_transfer.py): this
         # host serves its sealed objects directly to peer nodes, and
         # pulls remote objects into its own arena on the driver's
@@ -112,6 +170,19 @@ class NodeAgent:
         self.driver_incarnation = 0
         self.conn = connect_address(driver_address)
         self.conn.send(("register_node", self._register_info()))
+        if self._nlease_enabled:
+            # Lease completions coalesce into ("batch", ...) frames on
+            # the node connection, same codec + cadence discipline as the
+            # worker->driver batcher (a fan-out of sub-millisecond tasks
+            # costs one frame per batch, not one per completion).
+            from .worker import _MsgBatcher  # noqa: PLC0415
+            self._done_batch = _MsgBatcher(
+                self.conn,
+                max_n=knobs.get_int("RAY_TPU_BATCH_FLUSH_N"),
+                window=knobs.get_float("RAY_TPU_BATCH_FLUSH_S"),
+                enabled=knobs.get_bool("RAY_TPU_BATCH"))
+            threading.Thread(target=self._spill_loop, daemon=True,
+                             name="node-lease-spill").start()
         # Metrics plane: this agent's registry (node-local store stats,
         # any user metrics recorded here) ships delta snapshots on the
         # node connection; the driver merges them tagged with node_id.
@@ -157,6 +228,9 @@ class NodeAgent:
             "transfer_address": self.transfer_server.address,
             "incarnation": self.incarnation,
             "pid": os.getpid(),
+            # capability flag: the driver only grants node-level bulk
+            # leases to agents that actually run the local dispatch plane
+            "node_leases": self._nlease_enabled,
         }
 
     def _heartbeat_loop(self) -> None:
@@ -352,6 +426,10 @@ class NodeAgent:
             except Exception:
                 pass
         self.workers.clear()
+        # Old bulk leases die with the old incarnation: the driver's
+        # death determination already re-pended their ledgers (fenced),
+        # and the workers they named were just terminated.
+        self._clear_lease_state()
         deadline = time.time() + window
         delay = 0.2
         while time.time() < deadline:
@@ -365,6 +443,8 @@ class NodeAgent:
                 delay = min(delay * 2, 2.0)
                 continue
             self.conn = conn
+            if self._done_batch is not None:
+                self._done_batch.conn = conn
             self._last_driver_traffic = time.monotonic()
             print(f"ray_tpu node {self.node_id} rejoined "
                   f"{self.driver_address} as incarnation "
@@ -383,6 +463,10 @@ class NodeAgent:
             if inc and inc != self.driver_incarnation:
                 print(f"ray_tpu node {self.node_id} reattached to "
                       f"driver incarnation {inc}", flush=True)
+                # the resumed driver rebuilt its lease ledger from
+                # scratch; anything granted by the old incarnation is
+                # fenced there, so holding it here would only double-run
+                self._clear_lease_state()
             self.driver_incarnation = inc
         elif mtype == "heartbeat_ack":
             pass  # run() already stamped _last_driver_traffic
@@ -421,6 +505,13 @@ class NodeAgent:
                     os.remove(loc.name)
             except Exception:
                 traceback.print_exc()
+        elif mtype == "nlease_grant":
+            _, lid, need, wids, specs, standing = m
+            self._on_nlease_grant(lid, need, wids, specs, standing)
+        elif mtype == "nlease_extend":
+            self._on_nlease_extend(m[1], m[2])
+        elif mtype == "nlease_close":
+            self._on_nlease_close(m[1])
         elif mtype == "shutdown":
             pass  # run() breaks and cleans up
 
@@ -461,6 +552,10 @@ class NodeAgent:
             [repo_root, *agent_paths,
              *[p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                if p]])
+        if self.agent_addr:
+            # workers join the agent-local dispatch plane (two-level
+            # scheduling) before they register with the driver
+            env["RAY_TPU_AGENT_ADDR"] = self.agent_addr
         if not tpu_capable:
             from ..util.jaxenv import subprocess_env_cpu  # noqa: PLC0415
             subprocess_env_cpu(env)
@@ -469,7 +564,436 @@ class NodeAgent:
              self.driver_address, wid],
             env=env, cwd=os.getcwd())
 
+    # ---- agent-local dispatch plane (two-level scheduling) ----------------
+    def _start_agent_plane(self) -> None:
+        path = os.path.join(self._tmpdir, "agent.sock")
+        self._agent_listener = unix_listener(path)
+        self.agent_addr = path
+        threading.Thread(target=self._agent_accept, daemon=True,
+                         name="agent-accept").start()
+
+    def _agent_accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._agent_listener.accept()
+            except OSError:
+                return   # listener closed: agent shutting down
+            conn = Connection(sock)
+            threading.Thread(target=self._agent_reader, args=(conn,),
+                             daemon=True, name="agent-wreader").start()
+
+    def _agent_reader(self, conn: Connection) -> None:
+        """One thread per local worker connection: registration,
+        completions, and nested submissions."""
+        wid = None
+        try:
+            while True:
+                # raylint: disable=RT003 bounded by worker lifetime: the
+                # peer is a local worker process on a unix socket; its
+                # exit closes the socket and ends this loop
+                m = conn.recv()
+                k = m[0]
+                if k == "aregister":
+                    wid = m[1]
+                    with self._sched_lock:
+                        self._aworkers[wid] = conn
+                    self._pump()
+                elif k == "adone":
+                    self._on_adone(wid, m[1], m[2], m[3])
+                elif k == "asubmit":
+                    for spec in m[1]:
+                        self._on_asubmit(spec, conn)
+                elif k == "batch":
+                    # worker-side completion batcher: unwrap in order,
+                    # refill once at the end — per-item pumps would
+                    # fragment the next aexec refill into tiny frames
+                    for bm in m[1]:
+                        if bm[0] == "adone":
+                            self._on_adone(wid, bm[1], bm[2], bm[3],
+                                           pump=False)
+                        elif bm[0] == "aregister":
+                            wid = bm[1]
+                            with self._sched_lock:
+                                self._aworkers[wid] = conn
+                        elif bm[0] == "asubmit":
+                            for spec in bm[1]:
+                                self._on_asubmit(spec, conn)
+                    self._pump()
+        except (ConnectionClosed, OSError):
+            pass
+        finally:
+            if wid is not None:
+                self._on_aworker_lost(wid, conn)
+
+    def _clear_lease_state(self) -> None:
+        with self._sched_lock:
+            self._leases.clear()
+            self._winflight.clear()
+            self._nested_q.clear()
+
+    def _oid_record(self, oid, loc) -> None:
+        with self._sched_lock:
+            self._oid_locs[oid] = loc
+            self._oid_locs.move_to_end(oid)
+            while len(self._oid_locs) > 8192:
+                self._oid_locs.popitem(last=False)
+
+    def _lease_for(self, resources) -> Optional[_AgentLease]:
+        """An open lease of exactly this resource shape with queue
+        capacity left AND a free worker, or None. The free-worker
+        requirement matters for nested submissions: queueing a child
+        behind the lease's only worker when that worker is its blocked
+        PARENT would self-deadlock until the spill timer bails it out
+        — park it instead and ask for standing capacity (_pump absorbs
+        parked tasks the moment a matching worker frees up). Caller
+        holds _sched_lock."""
+        key = tuple(sorted(resources.items()))
+        slots = max(1, knobs.get_int("RAY_TPU_NODE_LEASE_SLOTS"))
+        for lease in self._leases.values():
+            if (tuple(sorted(lease.need.items())) == key and lease.wids
+                    and len(lease.queue) < len(lease.wids) * slots
+                    and any(w in self._aworkers
+                            and not self._winflight.get(w)
+                            for w in lease.wids)):
+                return lease
+        return None
+
+    def _maybe_want(self, resources) -> None:
+        """Ask the driver for standing-lease capacity of this shape, at
+        most once a second per shape. Caller holds _sched_lock (only the
+        throttle table; the send is safe on the thread-safe conn)."""
+        key = tuple(sorted(resources.items()))
+        now = time.monotonic()
+        if now - self._want_last.get(key, 0.0) < 1.0:
+            return
+        self._want_last[key] = now
+        try:
+            self.conn.send(("nlease_want", dict(resources),
+                            max(1, len(self._nested_q))))
+        except (ConnectionClosed, OSError):
+            pass
+
+    def _forward_to_driver(self, spec, owner) -> None:
+        """Spill one worker-submitted task up to the driver queue (deps
+        not node-local, or no capacity arrived in time) and tell the
+        owner to resolve its result through the driver instead."""
+        try:
+            self.conn.send(("submit", spec))
+        except (ConnectionClosed, OSError):
+            return  # driver gone: the rejoin/death path owns recovery
+        if owner is not None:
+            try:
+                owner.send(("aspill", [spec.task_id]))
+            except (ConnectionClosed, OSError):
+                pass  # owner died; its job's failure handling covers it
+
+    def _on_asubmit(self, spec, owner: Connection) -> None:
+        """A local worker submitted a fan-out task. Place it locally when
+        every dependency is node-resolvable and a shape-matching lease
+        has capacity; otherwise park it (asking the driver for a standing
+        lease) and let the spill timer forward it if none arrives."""
+        dep_locs = []
+        with self._sched_lock:
+            for oid in getattr(spec, "dep_object_ids", None) or ():
+                loc = self._oid_locs.get(oid)
+                if loc is None:
+                    dep_locs = None
+                    break
+                dep_locs.append((oid, loc))
+        if dep_locs is None:
+            self._forward_to_driver(spec, owner)
+            return
+        # attached out-of-band at dispatch (the compact spec codec
+        # carries pure fields only)
+        spec._dep_locs = dep_locs or None
+        now = time.monotonic()
+        with self._sched_lock:
+            lease = self._lease_for(spec.resources)
+            if lease is not None:
+                lease.queue.append([spec, owner, now])
+                lease.last_activity = now
+            else:
+                self._nested_q.append([spec, owner, now])
+                self._maybe_want(spec.resources)
+        self._pump()
+
+    def _pump(self) -> None:
+        """Fan queued lease tasks across registered workers, keeping up
+        to RAY_TPU_NODE_LEASE_DEPTH tasks in flight per worker. Depth
+        >1 pipelines the aexec/adone round trip (the worker executes
+        its backlog FIFO, so sub-millisecond tasks never leave it idle
+        waiting for the next frame). Parked nested tasks are absorbed
+        only by a fully-idle worker: queueing a child behind its own
+        blocked parent would self-deadlock until the spill timer bails
+        it out. Assignment happens under the lock; the sends happen
+        outside it."""
+        depth = max(1, knobs.get_int("RAY_TPU_NODE_LEASE_DEPTH"))
+        dispatch = []
+        with self._sched_lock:
+            for lease in list(self._leases.values()):
+                key = None
+                for w in list(lease.wids):
+                    conn = self._aworkers.get(w)
+                    if conn is None:
+                        continue
+                    q = self._winflight.setdefault(
+                        w, collections.deque())
+                    while len(q) < depth:
+                        if lease.queue:
+                            spec, owner, _t0 = lease.queue.popleft()
+                        elif not q:
+                            # fully idle: absorb a parked nested task
+                            # of this lease's shape (it missed
+                            # _lease_for when every worker was
+                            # momentarily busy)
+                            if key is None:
+                                key = tuple(sorted(lease.need.items()))
+                            entry = None
+                            for e in self._nested_q:
+                                if tuple(sorted(
+                                        e[0].resources.items())) == key:
+                                    entry = e
+                                    break
+                            if entry is None:
+                                break
+                            self._nested_q.remove(entry)
+                            spec, owner, _t0 = entry
+                        else:
+                            break
+                        q.append((lease.lid, spec, owner))
+                        lease.last_activity = time.monotonic()
+                        dispatch.append((w, conn, spec, owner))
+        # one aexec frame per worker per pump round: a refill of
+        # `depth` sub-millisecond tasks costs one syscall + wakeup,
+        # not one per task (the 1-core contention profile is frame-
+        # dominated, see BENCH_CORE multi_agent_scaling)
+        by_worker: Dict[str, list] = {}
+        conns = {}
+        for w, conn, spec, owner in dispatch:
+            conns[w] = conn
+            by_worker.setdefault(w, []).append(
+                (spec, getattr(spec, "_dep_locs", None),
+                 owner is not None))
+        for w, batch in by_worker.items():
+            try:
+                conns[w].send(("aexec", batch))
+            except (ConnectionClosed, OSError):
+                self._on_aworker_lost(w, conns[w])
+
+    def _on_adone(self, wid, tid, sealed, error,
+                  pump: bool = True) -> None:
+        with self._sched_lock:
+            entry = None
+            q = self._winflight.get(wid)
+            if q:
+                # completions arrive in dispatch order (the worker
+                # executes its backlog FIFO) — but a revoked/raced
+                # frame can skip, so match by task id
+                if q[0][1].task_id == tid:
+                    entry = q.popleft()
+                else:
+                    for e in q:
+                        if e[1].task_id == tid:
+                            entry = e
+                            q.remove(e)
+                            break
+        if entry is None:
+            return
+        lid, spec, owner = entry
+        # host-kind seals are readable by every worker on this node:
+        # remember them so a sibling fan-out task depending on this
+        # result dispatches with pre-resolved locations
+        for oid, loc in sealed or ():
+            if getattr(loc, "kind", None) in _HOST_KINDS:
+                self._oid_record(oid, loc)
+        if owner is not None:
+            try:
+                owner.send(("aresult", tid, sealed, error))
+            except (ConnectionClosed, OSError):
+                pass  # owner died; nothing upstream waits on this
+        else:
+            with self._sched_lock:
+                lease = self._leases.get(lid)
+                # flush NOW only when this lease has truly drained
+                # (no queued work and no pipelined backlog on any of
+                # its workers) — the driver may be waiting on the last
+                # ack to extend or settle. Mid-stream completions ride
+                # the batch window so acks coalesce.
+                urgent = lease is None or (
+                    not lease.queue
+                    and not any(e[0] == lid
+                                for q in self._winflight.values()
+                                for e in q))
+            try:
+                self._done_batch.send(
+                    ("nlease_done", lid, [(tid, wid, sealed, error)]),
+                    urgent=urgent)
+            except (ConnectionClosed, OSError):
+                pass  # rejoin path re-pends the ledger driver-side
+        if pump:
+            self._pump()
+
+    def _on_aworker_lost(self, wid, conn: Connection) -> None:
+        """A local worker's agent connection died (process exit or
+        crash). Its in-flight task HAD started: driver-granted tasks
+        spill back with started=True (the driver applies its normal
+        worker-death retry accounting); nested tasks forward to the
+        driver for re-execution (at-least-once, like a direct-call
+        channel death)."""
+        with self._sched_lock:
+            if self._aworkers.get(wid) is conn:
+                del self._aworkers[wid]
+            entries = self._winflight.pop(wid, None) or ()
+            for lease in self._leases.values():
+                lease.wids.discard(wid)
+        # only the head of the worker's FIFO backlog can have started;
+        # the pipelined tasks behind it re-queue without burning a retry
+        spills: Dict[str, list] = {}
+        for i, (lid, spec, owner) in enumerate(entries):
+            if owner is None:
+                spills.setdefault(lid, []).append(
+                    (spec.task_id, i == 0))
+            else:
+                self._forward_to_driver(spec, owner)
+        for lid, batch in spills.items():
+            try:
+                self.conn.send(("nlease_spill", lid, batch,
+                                "worker_death"))
+            except (ConnectionClosed, OSError):
+                pass
+        self._pump()
+
+    def _on_nlease_grant(self, lid, need, wids, specs, standing) -> None:
+        now = time.monotonic()
+        with self._sched_lock:
+            lease = _AgentLease(lid, dict(need), set(wids),
+                                bool(standing))
+            for spec in specs:
+                lease.queue.append([spec, None, now])
+            self._leases[lid] = lease
+            # parked nested tasks of this shape ride the new capacity
+            key = tuple(sorted(lease.need.items()))
+            keep: collections.deque = collections.deque()
+            for entry in self._nested_q:
+                if tuple(sorted(entry[0].resources.items())) == key:
+                    lease.queue.append(entry)
+                else:
+                    keep.append(entry)
+            self._nested_q = keep
+        self._pump()
+
+    def _on_nlease_extend(self, lid, specs) -> None:
+        now = time.monotonic()
+        unknown = False
+        with self._sched_lock:
+            lease = self._leases.get(lid)
+            if lease is None:
+                unknown = True
+            else:
+                lease.last_activity = now
+                for spec in specs:
+                    lease.queue.append([spec, None, now])
+        if unknown:
+            # closed/fenced lease: hand the batch straight back unstarted
+            try:
+                self.conn.send(("nlease_spill", lid,
+                                [(s.task_id, False) for s in specs],
+                                "unknown_lease"))
+            except (ConnectionClosed, OSError):
+                pass
+            return
+        self._pump()
+
+    def _on_nlease_close(self, lid) -> None:
+        with self._sched_lock:
+            lease = self._leases.pop(lid, None)
+            if lease is not None:
+                for entry in lease.queue:
+                    # nested tasks go back to the wait queue; any
+                    # driver-owned leftovers were already re-pended
+                    # driver-side before the close
+                    if entry[1] is not None:
+                        self._nested_q.append(entry)
+        self._pump()
+
+    def _spill_loop(self) -> None:
+        """Ages out unplaceable queued tasks: lease entries that no free
+        worker picked up within RAY_TPU_NODE_LEASE_SPILL_S spill back to
+        the driver, parked nested tasks forward to it, and drained
+        standing leases release after RAY_TPU_NODE_LEASE_IDLE_S."""
+        spill_s = knobs.get_float("RAY_TPU_NODE_LEASE_SPILL_S")
+        idle_s = knobs.get_float("RAY_TPU_NODE_LEASE_IDLE_S")
+        tick = max(0.05, min(0.5, (spill_s or 1.0) / 4))
+        while True:
+            time.sleep(tick)
+            try:
+                self._spill_pass(spill_s, idle_s)
+            except Exception:
+                pass  # the timer must never die
+
+    def _spill_pass(self, spill_s: float, idle_s: float) -> None:
+        now = time.monotonic()
+        spills = []     # (lid, [(tid, False)])
+        forwards = []   # (spec, owner)
+        releases = []
+        with self._sched_lock:
+            for lid, lease in list(self._leases.items()):
+                if spill_s > 0 and lease.queue:
+                    free = any(w in self._aworkers
+                               and not self._winflight.get(w)
+                               for w in lease.wids)
+                    if not free:
+                        aged = []
+                        keep: collections.deque = collections.deque()
+                        for entry in lease.queue:
+                            spec, owner, t0 = entry
+                            if now - t0 > spill_s:
+                                if owner is None:
+                                    aged.append(spec.task_id)
+                                else:
+                                    forwards.append((spec, owner))
+                            else:
+                                keep.append(entry)
+                        lease.queue = keep
+                        if aged:
+                            spills.append(
+                                (lid, [(t, False) for t in aged]))
+                if (lease.standing and idle_s > 0 and not lease.queue
+                        and now - lease.last_activity > idle_s
+                        and not any(e[0] == lid
+                                    for q in self._winflight.values()
+                                    for e in q)):
+                    releases.append(lid)
+                    del self._leases[lid]
+            if spill_s > 0:
+                keep = collections.deque()
+                for entry in self._nested_q:
+                    spec, owner, t0 = entry
+                    if now - t0 > spill_s:
+                        forwards.append((spec, owner))
+                    else:
+                        keep.append(entry)
+                self._nested_q = keep
+        for lid, entries in spills:
+            try:
+                self.conn.send(
+                    ("nlease_spill", lid, entries, "placement_timeout"))
+            except (ConnectionClosed, OSError):
+                pass
+        for spec, owner in forwards:
+            self._forward_to_driver(spec, owner)
+        for lid in releases:
+            try:
+                self.conn.send(("nlease_release", lid))
+            except (ConnectionClosed, OSError):
+                pass
+
     def _cleanup(self) -> None:
+        if self._agent_listener is not None:
+            try:
+                self._agent_listener.close()
+            except Exception:
+                pass
         try:
             self.transfer_server.close()
         except Exception:

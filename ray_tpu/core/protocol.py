@@ -81,6 +81,11 @@ WIRE_KINDS = frozenset({
     "revoke_tasks", "shutdown", "get_reply", "heartbeat_ack",
     # worker <-> worker (direct actor calls)
     "dcall", "dresult",
+    # two-level scheduling (docs/SCHEDULING.md): driver <-> node agent
+    # bulk lease plane, and the agent-local worker dispatch plane
+    "nlease_grant", "nlease_extend", "nlease_close", "nlease_done",
+    "nlease_spill", "nlease_want", "nlease_release",
+    "aregister", "aexec", "adone", "asubmit", "aresult", "aspill",
     # compiled-DAG channel plane (writer -> reader data sockets)
     "ch_open", "ch_notify", "ch_ack", "ch_err",
     # telemetry reports: the sys.metrics / sys.spans / sys.events

@@ -100,7 +100,8 @@ class WorkerState:
     __slots__ = ("worker_id", "conn", "proc", "pid", "state", "current_task",
                  "actor_id", "held_resources", "held_tpu_ids", "blocked",
                  "started_at", "purpose", "tpu_capable", "node_id",
-                 "func_calls", "lease", "direct_addr", "last_progress")
+                 "func_calls", "lease", "direct_addr", "last_progress",
+                 "node_lease")
 
     def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
                  purpose=None, tpu_capable: bool = False,
@@ -125,6 +126,10 @@ class WorkerState:
         # in a driver-visible verb (gang tasks spinning in a user-space
         # rendezvous loop must not pin their peers behind them)
         self.last_progress = 0.0
+        # id of the NODE-level bulk lease holding this worker (two-level
+        # scheduling): the node agent, not the driver, fans tasks to it
+        # while set; resources release at lease close, not per task
+        self.node_lease: Optional[str] = None
         self.actor_id: Optional[str] = None
         self.held_resources: Dict[str, float] = {}
         self.held_tpu_ids: List[int] = []
@@ -144,7 +149,8 @@ class NodeState:
     gcs_node_manager.cc / node_manager.cc)."""
     __slots__ = ("node_id", "hostname", "total", "avail", "labels", "conn",
                  "alive", "free_tpu_ids", "last_heartbeat",
-                 "heartbeat_missed", "incarnation", "restored")
+                 "heartbeat_missed", "incarnation", "restored",
+                 "lease_capable")
 
     def __init__(self, node_id: str, hostname: str,
                  resources: Dict[str, float],
@@ -167,9 +173,42 @@ class NodeState:
         # rebuilt from persisted state by a resumed driver and not yet
         # re-registered: the agent's reattach flips this back off
         self.restored = False
+        # the agent advertised its local dispatch plane at registration
+        # (two-level scheduling): only then may the driver grant this
+        # node bulk leases
+        self.lease_capable = False
         # Specific chip indices handed to tasks/actors (get_tpu_ids):
         # concurrent TPU workloads on one host must see disjoint chips.
         self.free_tpu_ids = list(range(int(resources.get("TPU", 0))))
+
+
+class NodeLease:
+    """Driver-side ledger of one NODE-level bulk lease (two-level
+    scheduling, docs/SCHEDULING.md): a resource shape, the workers
+    claimed for it (each holding one `need` worth of the node's
+    resources until the lease closes), and the granted tasks still
+    outstanding. Standing leases carry no driver tasks — they park
+    capacity for a node's agent-local nested submissions and are
+    released by the agent when idle (or reclaimed by the tick when
+    driver work starves)."""
+
+    __slots__ = ("lease_id", "node_id", "need", "need_key", "wids",
+                 "tasks", "standing", "created_at", "last_activity")
+
+    def __init__(self, lease_id: str, node_id: str,
+                 need: Dict[str, float], wids: List[str],
+                 standing: bool = False):
+        self.lease_id = lease_id
+        self.node_id = node_id
+        self.need = dict(need)
+        self.need_key = sched_mod.shape_key(need)
+        self.wids = list(wids)
+        self.tasks: Dict[str, TaskSpec] = {}   # outstanding ledger
+        self.standing = standing
+        self.created_at = time.time()
+        # stamped at grant/extend/completion/spill: the tick watchdog
+        # force-revokes a lease whose agent stops making progress
+        self.last_activity = self.created_at
 
 
 class GenStream:
@@ -398,6 +437,25 @@ class DriverRuntime:
         self.dispatched_tasks = 0
         self.ctrl_frames = 0
         self.ctrl_msgs: collections.Counter = collections.Counter()
+        # ---- two-level scheduling (docs/SCHEDULING.md) ----
+        # NODE-level bulk leases: the driver hands a batch of compatible
+        # queued tasks plus a set of the node's workers to its agent in
+        # one frame; the agent fans them out locally and streams batched
+        # completions back. RAY_TPU_NODE_LEASES=0 kills the path.
+        self._node_leases_enabled = knobs.get_bool("RAY_TPU_NODE_LEASES")
+        self._node_lease_slots = max(
+            1, knobs.get_int("RAY_TPU_NODE_LEASE_SLOTS"))
+        if not self._batch_enabled:
+            self._node_leases_enabled = False
+        self.node_leases: Dict[str, NodeLease] = {}
+        self._nlease_counter = 0
+        # node_id -> deadline (time.time); a node that just spilled
+        # tasks back is skipped by the grant pass until this passes
+        self._nlease_backoff: Dict[str, float] = {}
+        self.node_lease_grants = 0
+        self.node_lease_extends = 0
+        self.node_lease_tasks = 0
+        self.spillbacks = 0
         # compiled-DAG controllers by dag_id (docs/DAG.md); acquires
         # queue here until the dispatcher can pin every stage's worker
         self.compiled_dags: Dict[str, Any] = {}
@@ -927,6 +985,7 @@ class DriverRuntime:
             self._update_builtin_gauges()
             self._check_node_heartbeats()
             self._check_lease_watchdog()
+            self._check_node_lease_watchdog()
             self._check_reattach_grace()
             if self._persist is not None and \
                     self._persist.maybe_snapshot(self._snapshot_tables):
@@ -1241,6 +1300,7 @@ class DriverRuntime:
         ns = NodeState(nid, info.get("hostname", "?"), info["resources"],
                        labels=info.get("labels"), conn=conn)
         ns.incarnation = inc
+        ns.lease_capable = bool(info.get("node_leases"))
         self.cluster_nodes[nid] = ns
         self.gcs.nodes[nid] = NodeEntry(
             node_id=nid, hostname=ns.hostname, resources=dict(ns.total),
@@ -1314,6 +1374,11 @@ class DriverRuntime:
             ns.last_heartbeat = time.time()
             ns.heartbeat_missed = False
         mtype = m[0]
+        if mtype != "batch":
+            # logical node-plane message accounting ("batch" recurses
+            # into its parts): the two-level scheduling tests assert
+            # driver-frame invariants over these deltas
+            self.ctrl_msgs[mtype] += 1
         if mtype == "heartbeat":
             # ack so the AGENT can tell a silent-dead driver host from
             # an idle one (node.py's RAY_TPU_DRIVER_SILENCE_S watchdog;
@@ -1406,6 +1471,22 @@ class DriverRuntime:
             sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
                              f"worker {m[1]}: {m[2]}\n")
             self.inbox.put(("worker_dead", m[1]))
+        elif mtype == "nlease_done":
+            # batched completions off a node-level bulk lease
+            for tid, wid, sealed, err in m[2]:
+                self._on_nlease_done(m[1], tid, wid, sealed, err)
+        elif mtype == "nlease_spill":
+            self._on_nlease_spill(nid, m[1], m[2], m[3])
+        elif mtype == "nlease_want":
+            self._on_nlease_want(nid, m[1], m[2])
+        elif mtype == "nlease_release":
+            # the agent drained a standing lease and went idle: its
+            # workers return to the pool
+            self._close_node_lease(m[1], notify=False)
+        elif mtype == "submit":
+            # agent-forwarded nested spillover (deps not node-local or
+            # no capacity arrived): enters the normal task queue
+            self._register_task(m[1])
 
     def _on_node_dead(self, nid: str, conn=None) -> None:
         ns = self.cluster_nodes.get(nid)
@@ -1435,6 +1516,22 @@ class DriverRuntime:
         self.cluster_metrics.drop_source({"node_id": nid})
         # location directory upkeep: the dead node serves no more pulls
         self.transfer_addrs.pop(nid, None)
+        # Bulk node leases die with their agent. Unstarted slots
+        # re-pend WITHOUT burning a retry, but up to one task per
+        # leased worker may have been EXECUTING when the node died —
+        # those (the oldest outstanding entries, by grant order)
+        # follow normal worker-death retry accounting so a started
+        # task can't silently re-run past its retry budget. (Conn is
+        # gone, so no result can race this; a rejoining agent is a
+        # fresh incarnation that dropped its lease state.) Close
+        # zeroes held_resources BEFORE the worker-death loop so the
+        # per-worker release below can't double-release.
+        for lid, lease in list(self.node_leases.items()):
+            if lease.node_id == nid:
+                self._revoke_node_lease(
+                    lid, reason="node_death",
+                    charge=min(len(lease.wids), len(lease.tasks)))
+                self._close_node_lease(lid, notify=False)
         # In-flight fetches against this node resolve via their timeout.
         for w in list(self.workers.values()):
             if w.node_id == nid and w.state != "dead":
@@ -2595,6 +2692,11 @@ class DriverRuntime:
         self.pending_restarts = still
 
         # 2. normal tasks
+        # 2.0 two-level scheduling: the head run of same-shape
+        # leaseable tasks goes to node agents in bulk; leftovers fall
+        # through to per-worker placement below
+        if self._node_leases_enabled:
+            self._grant_node_leases()
         still = collections.deque()
         # CPU tasks may fall back onto idle TPU workers only when no TPU
         # task is waiting — otherwise a CPU backlog ahead of a TPU task
@@ -3093,6 +3195,451 @@ class DriverRuntime:
             # a zombie's stray results are dropped via _revoked_set
             pass
 
+    # ---------------- node leases (two-level scheduling) ----------------
+    def _grant_node_leases(self) -> None:
+        """Phase-2 preamble (docs/SCHEDULING.md, two-level scheduling):
+        hand the head run of same-shape leaseable tasks to node AGENTS
+        in bulk — one frame per node carrying a worker set plus a task
+        batch — instead of per-worker lease grants. The agent fans the
+        batch across its local workers and streams completions back;
+        the driver only sees the ledger shrink. Tasks the agent can't
+        place spill back (nlease_spill) and re-enter this queue."""
+        self._settle_node_leases()
+        if not self.pending_tasks:
+            return
+        # agent-free cluster: don't pay the take/re-pend sweep of the
+        # whole head run on every pass — there is nobody to grant to
+        if not self.node_leases and not any(
+                ns.conn is not None and ns.lease_capable and ns.alive
+                for ns in self.cluster_nodes.values()):
+            return
+        head = self.pending_tasks[0]
+        if not sched_mod.node_leaseable(head):
+            return
+        te = self.gcs.tasks.get(head.task_id)
+        if te is not None and te.state == "CANCELLED":
+            return
+        if self._deps_ready(head.dep_object_ids) is not True:
+            return
+        shape = sched_mod.shape_key(head.resources)
+        take: collections.deque = collections.deque()
+        while self.pending_tasks:
+            spec = self.pending_tasks[0]
+            te = self.gcs.tasks.get(spec.task_id)
+            if te is not None and te.state == "CANCELLED":
+                self.pending_tasks.popleft()
+                continue
+            if (not sched_mod.node_leaseable(spec)
+                    or sched_mod.shape_key(spec.resources) != shape
+                    or self._deps_ready(spec.dep_object_ids) is not True):
+                break
+            take.append(self.pending_tasks.popleft())
+        if not take:
+            return
+        try:
+            now = time.time()
+            # extend open same-shape leases first: a hot lease refills
+            # without worker churn (the agent keeps its slots warm)
+            for lease in list(self.node_leases.values()):
+                if not take:
+                    break
+                ns = self.cluster_nodes.get(lease.node_id)
+                if (lease.need_key != shape or ns is None
+                        or not ns.alive or ns.conn is None):
+                    continue
+                # only workers that can actually make progress count
+                # toward refill capacity — extending onto a lease whose
+                # workers are all parked in get() would ping-pong the
+                # batch through spillback forever
+                active = 0
+                for wid in lease.wids:
+                    w = self.workers.get(wid)
+                    if (w is not None and w.state != "dead"
+                            and not w.blocked):
+                        active += 1
+                cap = (active * self._node_lease_slots
+                       - len(lease.tasks))
+                if cap <= 0:
+                    continue
+                specs = [take.popleft()
+                         for _ in range(min(cap, len(take)))]
+                if not self._send_node_lease(ns, lease, specs,
+                                             extend=True):
+                    take.extendleft(reversed(specs))
+            # new grants on agent-capable remote nodes with idle workers
+            for ns in self._alive_nodes():
+                if not take:
+                    break
+                if (ns.conn is None or not ns.lease_capable
+                        or self._nlease_backoff.get(ns.node_id, 0.0)
+                        > now):
+                    continue
+                need = dict(head.resources)
+                wids: List[str] = []
+                for w in self.workers.values():
+                    if (w.node_id != ns.node_id or w.state != "idle"
+                            or w.conn is None or w.tpu_capable
+                            or w.purpose is not None):
+                        continue
+                    if not res_mod.fits(ns.avail, need):
+                        break
+                    res_mod.acquire(ns.avail, need)
+                    wids.append(w.worker_id)
+                    if (len(wids) * self._node_lease_slots
+                            >= len(take)):
+                        break
+                if not wids:
+                    continue
+                lease = self._new_node_lease(ns, need, wids,
+                                             standing=False)
+                n = min(len(wids) * self._node_lease_slots, len(take))
+                specs = [take.popleft() for _ in range(n)]
+                if not self._send_node_lease(ns, lease, specs,
+                                             extend=False):
+                    take.extendleft(reversed(specs))
+        finally:
+            # whatever didn't fit stays at the queue head for the
+            # per-worker path below, order preserved
+            self.pending_tasks.extendleft(reversed(take))
+
+    def _settle_node_leases(self) -> None:
+        """Close drained non-standing leases whose shape no longer
+        matches the queue head — their workers return to the pool
+        instead of idling reserved for a shape that's gone."""
+        if not self.node_leases:
+            return
+        head_shape = None
+        if self.pending_tasks:
+            head = self.pending_tasks[0]
+            if sched_mod.node_leaseable(head):
+                head_shape = sched_mod.shape_key(head.resources)
+        for lid, lease in list(self.node_leases.items()):
+            if (not lease.standing and not lease.tasks
+                    and lease.need_key != head_shape):
+                self._close_node_lease(lid, notify=True)
+
+    def _new_node_lease(self, ns: NodeState, need: Dict[str, float],
+                        wids: List[str], standing: bool) -> NodeLease:
+        """Record a lease and mark its workers busy-for-the-lease: each
+        holds one `need` of the node's resources (acquired by the
+        caller) until the lease closes or the worker dies. w.lease
+        stays empty — the driver doesn't know which task runs where;
+        the agent owns per-worker assignment."""
+        self._nlease_counter += 1
+        lid = f"nlease-{ns.node_id[-6:]}-{self._nlease_counter}"
+        lease = NodeLease(lid, ns.node_id, need, wids, standing)
+        self.node_leases[lid] = lease
+        now = time.time()
+        for wid in wids:
+            w = self.workers.get(wid)
+            if w is None:
+                continue
+            w.state = "busy"
+            w.node_lease = lid
+            w.current_task = None
+            w.lease = collections.deque()
+            w.held_resources = dict(need)
+            w.last_progress = now
+        return lease
+
+    def _send_node_lease(self, ns: NodeState, lease: NodeLease,
+                         specs: List[TaskSpec], extend: bool) -> bool:
+        """One wire frame carrying a whole batch. False = conn died;
+        the caller re-queues `specs` (a fresh lease is also torn down —
+        its node is about to be declared dead)."""
+        lid = lease.lease_id
+        for s in specs:
+            s.lease_id = lid
+        try:
+            if extend:
+                ns.conn.send(("nlease_extend", lid, specs))
+            else:
+                ns.conn.send(("nlease_grant", lid, dict(lease.need),
+                              list(lease.wids), specs, lease.standing))
+        except ConnectionClosed:
+            if not extend:
+                self._close_node_lease(lid, notify=False)
+            return False
+        now = time.time()
+        lease.last_activity = now
+        for s in specs:
+            lease.tasks[s.task_id] = s
+            te = self.gcs.tasks[s.task_id]
+            # worker_id stays None until completion: the agent decides
+            # placement; death/cancel paths key off the lease ledger
+            te.state, te.worker_id, te.started_at = "RUNNING", None, now
+            if te.submitted_at:
+                _mcat().get("ray_tpu_task_sched_latency_s").observe(
+                    now - te.submitted_at)
+            self._emit("task.sched", task_id=s.task_id,
+                       node_id=ns.node_id, name=s.name)
+            self._pending_since.pop(s.task_id, None)
+        self.dispatch_frames += 1
+        self.dispatched_tasks += len(specs)
+        self.node_lease_tasks += len(specs)
+        if extend:
+            self.node_lease_extends += 1
+        else:
+            self.node_lease_grants += 1
+            self._emit("task.lease.node_grant",
+                       f"granted node lease {lid} to {ns.node_id}: "
+                       f"{len(lease.wids)} workers, {len(specs)} tasks"
+                       + (" (standing)" if lease.standing else ""),
+                       node_id=ns.node_id, lease_id=lid,
+                       slots=len(specs), workers=len(lease.wids))
+            try:
+                _mcat().get("ray_tpu_node_lease_grants_total").inc()
+            except Exception:
+                pass
+        if specs:
+            try:
+                _mcat().get("ray_tpu_agent_dispatch_batch_size").observe(
+                    len(specs))
+            except Exception:
+                pass
+        return True
+
+    def _close_node_lease(self, lid: str, notify: bool) -> None:
+        """Release the lease's worker claims. Outstanding ledger tasks
+        (if any) are the caller's problem — revoke first when they must
+        re-queue."""
+        lease = self.node_leases.pop(lid, None)
+        if lease is None:
+            return
+        for wid in lease.wids:
+            w = self.workers.get(wid)
+            if w is None or w.state == "dead" or w.node_lease != lid:
+                continue
+            if w.blocked:
+                # CPU already lent back while parked in a driver verb:
+                # only the non-CPU remainder is still held (mirrors
+                # _on_worker_dead / _on_task_done)
+                res_mod.release(self._wnode_avail(w),
+                                _non_cpu(w.held_resources))
+            else:
+                res_mod.release(self._wnode_avail(w), w.held_resources)
+            w.held_resources = {}
+            w.node_lease = None
+            w.state, w.current_task, w.blocked = "idle", None, False
+        if notify:
+            ns = self.cluster_nodes.get(lease.node_id)
+            if ns is not None and ns.alive and ns.conn is not None:
+                try:
+                    ns.conn.send(("nlease_close", lid))
+                except ConnectionClosed:
+                    pass
+
+    def _revoke_node_lease(self, lid: str, reason: str,
+                           fence: bool = False,
+                           charge: int = 0) -> None:
+        """Re-pend every outstanding ledger task WITHOUT burning a
+        retry — a revoked bulk lease means zero lost tasks, exactly
+        like a revoked per-worker lease (docs/FAULT_TOLERANCE.md). With
+        fence=True, late results from a zombie agent are dropped via
+        the (lease_id, task_id) revocation set. With charge=N, the N
+        OLDEST outstanding entries (grant order — the ones that can
+        have reached a worker's FIFO head and started executing)
+        follow normal worker-death retry accounting instead: burn a
+        retry, or FAIL when none remain. The driver can't see agent-
+        local worker assignment, so this is the same conservative
+        bound the per-worker path applies to its lease head."""
+        lease = self.node_leases.get(lid)
+        if lease is None or not lease.tasks:
+            return
+        n = 0
+        charged = 0
+        for tid, spec in list(lease.tasks.items()):
+            lease.tasks.pop(tid, None)
+            if fence:
+                self._revoked_add(lid, tid)
+            te = self.gcs.tasks.get(tid)
+            if te is None or te.state != "RUNNING":
+                continue
+            if charged < charge:
+                charged += 1
+                # Streaming tasks never retry: already-consumed items
+                # would replay and duplicate the stream.
+                streaming = getattr(spec, "streaming", False)
+                if not streaming and te.retries_left > 0:
+                    te.retries_left -= 1
+                    te.state, te.worker_id = "PENDING", None
+                    spec.lease_id = ""
+                    self.pending_tasks.append(spec)
+                    self._emit("task.retry",
+                               f"node lease {lid} revoked ({reason}) "
+                               f"while {te.name} may have started; "
+                               "resubmitting",
+                               task_id=tid, node_id=lease.node_id,
+                               name=te.name,
+                               retries_left=te.retries_left)
+                else:
+                    te.state = "FAILED"
+                    err = WorkerCrashedError(
+                        f"node {lease.node_id} died while running "
+                        f"{te.name}")
+                    self._emit("task.fail", str(err), task_id=tid,
+                               node_id=lease.node_id, name=te.name)
+                    for oid in self._return_ids_of(tid):
+                        self._fail_object(oid, err)
+                    self._gen_settle(tid, err)
+                continue
+            te.state, te.worker_id = "PENDING", None
+            spec.lease_id = ""
+            self.pending_tasks.append(spec)
+            n += 1
+        self.lease_revokes += 1
+        self._emit("task.lease.revoke",
+                   f"node lease {lid} revoked ({reason}); {n} granted "
+                   "tasks re-queued without burning a retry"
+                   + (f", {charged} possibly-started slots charged"
+                      if charged else ""),
+                   node_id=lease.node_id, lease_id=lid, slots=n,
+                   reason=reason)
+        try:
+            _mcat().get("ray_tpu_lease_revokes_total").inc(
+                tags={"reason": reason})
+        except Exception:
+            pass
+
+    def _check_node_lease_watchdog(self) -> None:
+        """Reaper-tick backstop for the agent plane: (a) standing
+        leases parked on capacity the driver now needs are reclaimed
+        when driver-visible work starves; (b) a lease whose agent stops
+        making progress entirely (wedged process that still heartbeats)
+        is force-revoked with fencing."""
+        if not self.node_leases:
+            return
+        now = time.time()
+        spill_s = knobs.get_float("RAY_TPU_NODE_LEASE_SPILL_S")
+        idle_s = knobs.get_float("RAY_TPU_NODE_LEASE_IDLE_S")
+        starving = any(now - t > 1.0
+                       for t in self._pending_since.values())
+        for lid, lease in list(self.node_leases.items()):
+            if not lease.tasks:
+                # drained: reclaim when queued work can't place, or
+                # when a standing lease outlives the agent's own idle
+                # release by a wide margin (lost nlease_release frame)
+                if starving or (lease.standing and now
+                                - lease.last_activity
+                                > max(10.0, 5 * idle_s)):
+                    self._close_node_lease(lid, notify=True)
+                continue
+            if now - lease.last_activity > max(10.0, 4 * spill_s):
+                self._revoke_node_lease(lid, "agent_stalled",
+                                        fence=True)
+                self._close_node_lease(lid, notify=True)
+
+    def _on_nlease_done(self, lid: str, tid: str, wid: str, sealed,
+                        error) -> None:
+        lease = self.node_leases.get(lid)
+        if (lid, tid) in self._revoked_set:
+            # force-revoked lease whose agent finished the task anyway:
+            # it was already re-queued — drop this result
+            self._revoked_set.discard((lid, tid))
+            if lease is not None:
+                lease.tasks.pop(tid, None)
+            return
+        if lease is not None:
+            # pop BEFORE the state guard: cancelled/stale tasks must
+            # still drain the ledger or the lease never closes
+            lease.tasks.pop(tid, None)
+            lease.last_activity = time.time()
+        te = self.gcs.tasks.get(tid)
+        if te is None or te.state != "RUNNING":
+            return
+        te.worker_id = wid
+        w = self.workers.get(wid)
+        if w is not None:
+            w.last_progress = time.time()
+        # release_worker=False: the worker stays claimed by the lease
+        # (the agent immediately refills it); resources release at
+        # lease close or worker death
+        self._on_task_done(wid, tid, sealed, error,
+                           release_worker=False)
+
+    def _on_nlease_spill(self, nid: str, lid: str, entries,
+                         reason: str) -> None:
+        """Agent couldn't place (or lost) granted tasks: re-queue them
+        here. started=False (never began executing) re-pends free;
+        started=True (its worker died mid-run) burns a retry — same
+        at-least-once contract as the per-worker death path."""
+        lease = self.node_leases.get(lid)
+        n = 0
+        for tid, started in entries:
+            spec = None
+            if lease is not None:
+                spec = lease.tasks.pop(tid, None)
+            if spec is None:
+                spec = self._respawnable_specs.get(tid)
+            te = self.gcs.tasks.get(tid)
+            if te is None or te.state != "RUNNING" or spec is None:
+                continue
+            if started:
+                if te.retries_left <= 0:
+                    te.state = "FAILED"
+                    err = WorkerCrashedError(
+                        f"worker died while running {te.name} under "
+                        f"node lease {lid}")
+                    self._emit("task.fail", str(err), task_id=tid,
+                               node_id=nid, name=te.name)
+                    for oid in self._return_ids_of(tid):
+                        self._fail_object(oid, err)
+                    self._gen_settle(tid, err)
+                    continue
+                te.retries_left -= 1
+            te.state, te.worker_id = "PENDING", None
+            spec.lease_id = ""
+            self.pending_tasks.append(spec)
+            n += 1
+        if lease is not None:
+            lease.last_activity = time.time()
+        if n:
+            self.spillbacks += n
+            # brief grant backoff: the node just told us it can't
+            # place this shape — don't re-grant into the same wall
+            self._nlease_backoff[nid] = time.time() + 1.0
+            self._emit("task.spillback",
+                       f"node {nid} spilled {n} tasks back "
+                       f"({reason}); re-queued",
+                       node_id=nid, lease_id=lid, slots=n,
+                       reason=reason)
+            try:
+                _mcat().get("ray_tpu_spillbacks_total").inc(
+                    n, tags={"reason": reason})
+            except Exception:
+                pass
+
+    def _on_nlease_want(self, nid: str, need: Dict[str, float],
+                        count: int) -> None:
+        """Agent asks for standing capacity to place nested
+        submissions locally. Granted only from workers the driver's
+        own queue doesn't need — driver work always wins."""
+        if not self._node_leases_enabled:
+            return
+        ns = self.cluster_nodes.get(nid)
+        if ns is None or not ns.alive or ns.conn is None:
+            return
+        now = time.time()
+        if any(now - t > 1.0 for t in self._pending_since.values()):
+            return   # driver-visible work is starving: refuse
+        need = dict(need)
+        wids: List[str] = []
+        for w in self.workers.values():
+            if len(wids) >= max(1, int(count)):
+                break
+            if (w.node_id != nid or w.state != "idle"
+                    or w.conn is None or w.tpu_capable
+                    or w.purpose is not None):
+                continue
+            if not res_mod.fits(ns.avail, need):
+                break
+            res_mod.acquire(ns.avail, need)
+            wids.append(w.worker_id)
+        if not wids:
+            return
+        lease = self._new_node_lease(ns, need, wids, standing=True)
+        self._send_node_lease(ns, lease, [], extend=False)
+
     def _wnode_avail(self, w: WorkerState) -> Dict[str, float]:
         """The avail dict of the worker's node (a throwaway dict if the
         node is gone — releases to dead nodes must not corrupt others)."""
@@ -3400,7 +3947,8 @@ class DriverRuntime:
                    workers=len(wids))
 
     # ---------------- completions ----------------
-    def _on_task_done(self, wid: str, task_id: str, sealed, error):
+    def _on_task_done(self, wid: str, task_id: str, sealed, error,
+                      release_worker: bool = True):
         te = self.gcs.tasks.get(task_id)
         w = self.workers.get(wid)
         if (wid, task_id) in self._revoked_set:
@@ -3457,7 +4005,7 @@ class DriverRuntime:
             gkey = (te.actor_id, getattr(te, "concurrency_group", None))
             self.actor_group_inflight[gkey] = max(
                 0, self.actor_group_inflight.get(gkey, 0) - 1)
-        elif w is not None:
+        elif w is not None and release_worker:
             w.last_progress = time.time()
             if task_id in w.lease:
                 try:
@@ -3540,6 +4088,19 @@ class DriverRuntime:
         # a dead worker's gauge series would otherwise report its last
         # "current state" forever (counters/histograms stay: history)
         self.cluster_metrics.drop_source({"worker_id": wid})
+        if w.node_lease is not None:
+            # node-leased worker: the AGENT owns its task assignment —
+            # it spills the in-flight task back (nlease_spill,
+            # started=True) and redistributes the rest, so the driver
+            # neither retries nor fails anything here (the lease
+            # watchdog backstops a wedged agent). Just drop the claim.
+            lease = self.node_leases.get(w.node_lease)
+            if lease is not None:
+                try:
+                    lease.wids.remove(wid)
+                except ValueError:
+                    pass
+            w.node_lease = None
         if w.blocked:
             # Blocked workers already returned their CPU when they entered
             # get() — release only the non-CPU remainder they still hold.
@@ -3891,6 +4452,21 @@ class DriverRuntime:
             te.state = "CANCELLED"
             self._respawnable_specs.pop(task_id, None)
             self._emit("task.cancel", "cancelled before dispatch",
+                       task_id=task_id, name=te.name)
+            err = TaskCancelledError(f"task {task_id} cancelled")
+            for oid in self._return_ids_of(task_id):
+                self._fail_object(oid, err)
+            self._gen_settle(task_id, err)
+        elif te.state == "RUNNING" and te.worker_id is None and any(
+                task_id in nl.tasks for nl in self.node_leases.values()):
+            # node-leased and not yet (knowably) started: the driver
+            # doesn't know which worker — if any — holds it. Mark it
+            # terminal and settle its objects now; _on_nlease_done
+            # drains the agent's eventual result via the ledger pop +
+            # state guard, so nothing double-settles.
+            te.state = "CANCELLED"
+            self._respawnable_specs.pop(task_id, None)
+            self._emit("task.cancel", "cancelled while node-leased",
                        task_id=task_id, name=te.name)
             err = TaskCancelledError(f"task {task_id} cancelled")
             for oid in self._return_ids_of(task_id):
@@ -4463,6 +5039,13 @@ class DriverRuntime:
             if self.submit_batches else None,
             "lease_grants": self.lease_grants,
             "lease_revokes": self.lease_revokes,
+            "node_leases_enabled": self._node_leases_enabled,
+            "node_lease_slots": self._node_lease_slots,
+            "node_lease_grants": self.node_lease_grants,
+            "node_lease_extends": self.node_lease_extends,
+            "node_lease_tasks": self.node_lease_tasks,
+            "node_leases_open": len(self.node_leases),
+            "spillbacks": self.spillbacks,
             "dispatch_frames": self.dispatch_frames,
             "dispatched_tasks": self.dispatched_tasks,
             "ctrl_frames_in": self.ctrl_frames,

@@ -65,6 +65,22 @@ def leaseable(spec) -> bool:
             and spec.resources.get("TPU", 0) <= 0)
 
 
+def node_leaseable(spec) -> bool:
+    """True when a task may ride a NODE-level bulk lease (two-level
+    scheduling, docs/SCHEDULING.md): everything `leaseable` requires,
+    plus a deserializable payload — the driver hands the whole batch to
+    the node agent sight-unseen, so a spec whose user blob failed the
+    wire must stay on the per-worker path where the dispatcher's
+    failure reporting sees it directly."""
+    return leaseable(spec) and not getattr(spec, "wire_error", None)
+
+
+def shape_key(resources) -> tuple:
+    """Canonical hashable key for a resource shape — node-lease batches
+    and the blocked-shape skip set group tasks by this."""
+    return tuple(sorted(resources.items()))
+
+
 def hard_affinity_node(strategy) -> Optional[str]:
     if (isinstance(strategy, NodeAffinitySchedulingStrategy)
             and not strategy.soft):

@@ -21,6 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from . import logging as logging_mod
+from . import scheduling as sched_mod
 from . import serialization
 from .ids import new_object_id
 from .object_ref import ObjectRef
@@ -223,6 +224,168 @@ class _DirectChannel:
             self.rt._direct_resolved(fut)
 
 
+# How long a get() on an agent-placed result stays silent before telling
+# the driver this worker is blocked (dwait CPU lend). Longer than the
+# direct-call grace: short fan-outs must finish with ZERO driver frames
+# (the two-level scheduling steady-state property), while anything slower
+# still lends its CPU so capacity-tight gangs keep their liveness.
+_AGENT_GRACE_S = 0.2
+
+
+class _AgentFuture:
+    """Local future for one task this worker submitted to its NODE AGENT
+    (two-level scheduling, docs/SCHEDULING.md — the driver never hears
+    about it). Resolves to a host-kind seal location in the node's
+    shared arena. `failover` flips when the result must resolve through
+    the driver instead: the agent forwarded the spec upward, or the
+    agent plane died and the spec was resubmitted."""
+    __slots__ = ("ev", "loc", "error", "failover", "publish",
+                 "_published", "spec")
+
+    def __init__(self, spec: TaskSpec):
+        self.ev = threading.Event()
+        self.loc = None                        # sealed ObjectLocation
+        self.error: Optional[BaseException] = None
+        self.failover = False
+        self.publish = False
+        self._published = False
+        self.spec = spec                       # retained for failover
+
+
+class _AgentPlane:
+    """Worker side of the node agent's local dispatch plane (two-level
+    scheduling, docs/SCHEDULING.md). One unix-socket connection to the
+    agent that spawned this worker: the agent pushes bulk-lease tasks
+    down (`aexec`) and this worker's own fan-outs go up (`asubmit`) for
+    node-local placement — zero driver messages steady-state. On plane
+    death every unresolved submission fails over to the driver path."""
+
+    def __init__(self, loop: "WorkerLoop", addr: str):
+        self.loop = loop
+        self.rt = loop.rt
+        self.conn = connect_address(addr)
+        self.dead = False
+        # completions coalesce like the worker->driver batcher does:
+        # a pipelined backlog of sub-millisecond tasks acks in one
+        # frame per window instead of one per task. urgent=True
+        # flushes in order, so routing every verb through the batcher
+        # keeps adone/asubmit ordering intact.
+        self._batch = _MsgBatcher(
+            self.conn,
+            max_n=knobs.get_int("RAY_TPU_BATCH_FLUSH_N"),
+            window=knobs.get_float("RAY_TPU_BATCH_FLUSH_S"),
+            enabled=knobs.get_bool("RAY_TPU_BATCH"))
+        self._batch.send(("aregister", loop.worker_id), urgent=True)
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name="agent-plane").start()
+
+    def _read_loop(self) -> None:
+        rt = self.rt
+        while True:
+            try:
+                # raylint: disable=RT003 node-local peer: agent death
+                # closes the socket, and _fail() fails every unresolved
+                # future over to the driver path
+                m = self.conn.recv()
+            except (ConnectionClosed, OSError):
+                self._fail()
+                return
+            k = m[0]
+            if k == "aexec":
+                # one frame carries the worker's whole refill batch
+                for spec, dep_locs, host_seal in m[1]:
+                    spec._via_agent = True
+                    spec._host_seal = bool(host_seal)
+                    if dep_locs:
+                        # pre-resolved dependency locations (node-local
+                        # results): arg resolution reads them straight
+                        # from the shared arena, no driver get_request
+                        rt._agent_locs_update(dep_locs)
+                    self.loop._task_q.put(("task", spec))
+            elif k == "aresult":
+                rt._agent_resolve(m[1], m[2], m[3])
+            elif k == "aspill":
+                rt._agent_spilled(m[1])
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        rt = self.rt
+        with rt._agent_lock:
+            for oid in spec.return_ids:
+                rt._register_agent_future(oid, _AgentFuture(spec))
+            rt._agent_tasks[spec.task_id] = list(spec.return_ids)
+        try:
+            # urgent: the child's placement latency is on the parent's
+            # critical path, and the ordered flush pushes any buffered
+            # adone (a dep the child needs recorded) out first
+            self._batch.send(("asubmit", [spec]), urgent=True)
+        except (ConnectionClosed, OSError):
+            self._fail()   # flips these futures to driver resubmission
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def task_done(self, tid: str, sealed, error) -> bool:
+        """Route one agent-dispatched completion back to the agent.
+        False when the plane is dead — the caller falls back to the
+        driver connection so the result is not lost."""
+        if self.dead:
+            return False
+        try:
+            # flush NOW only when the local backlog drained — the
+            # agent is waiting to refill; mid-backlog acks coalesce
+            self._batch.send(("adone", tid, sealed, error),
+                             urgent=self.loop._task_q.empty())
+            return True
+        except (ConnectionClosed, OSError):
+            self._fail()
+            return False
+
+    def _fail(self) -> None:
+        """Agent plane died: resubmit every unresolved agent-placed
+        spec through the driver (at-least-once, like a direct-call
+        channel death) and flip its futures to driver-path resolution."""
+        rt = self.rt
+        with rt._agent_lock:
+            if self.dead:
+                return
+            self.dead = True
+            pending = []
+            for tid, oids in rt._agent_tasks.items():
+                for oid in oids:
+                    f = rt._agent_results.get(oid)
+                    if f is not None and not f.ev.is_set():
+                        pending.append((tid, oids))
+                        break
+        if pending:
+            sys.stderr.write(
+                f"[ray_tpu worker] agent dispatch plane lost; "
+                f"{len(pending)} in-flight nested tasks fail over to "
+                f"the driver path\n")
+        for _tid, oids in pending:
+            spec = None
+            for oid in oids:
+                f = rt._agent_results.get(oid)
+                if f is not None and not f.ev.is_set():
+                    f.failover = True
+                    spec = spec or f.spec
+            if spec is not None:
+                try:
+                    rt._batch.send(("submit", spec), urgent=True)
+                except Exception:
+                    err = TaskError(
+                        "agent plane and driver connection both lost",
+                        "", spec.name)
+                    for oid in oids:
+                        f = rt._agent_results.get(oid)
+                        if f is not None and not f.ev.is_set():
+                            f.failover = False
+                            f.error = err
+            for oid in oids:
+                f = rt._agent_results.get(oid)
+                if f is not None:
+                    f.ev.set()
+        with rt._direct_cv:
+            rt._direct_cv.notify_all()
+
+
 class WorkerRuntime:
     """The runtime visible to user code running inside this worker.
 
@@ -282,6 +445,24 @@ class WorkerRuntime:
         self._no_direct = threading.local()
         self.direct_calls = 0
         self.direct_fallbacks = 0
+        # ---- agent-local dispatch (two-level scheduling) ----
+        # set by WorkerLoop when a node agent spawned this worker
+        self._agent_plane: Optional[_AgentPlane] = None
+        self._agent_lock = threading.Lock()
+        # oid -> _AgentFuture for fan-out tasks routed to the node agent
+        self._agent_results: "collections.OrderedDict[str, _AgentFuture]" \
+            = collections.OrderedDict()
+        # task_id -> its return oids (error fan-in, failover resubmit)
+        self._agent_tasks: "collections.OrderedDict[str, list]" \
+            = collections.OrderedDict()
+        self._agent_evicted: set = set()
+        # oids known node-resolvable (agent-placed results, agent-stamped
+        # dep locations): a fan-out whose ref args all live here may
+        # route to the agent without a cross-connection ordering hazard
+        # (the driver may not know these oids at all)
+        self._agent_known: set = set()
+        # oid -> host-kind location the agent stamped at dispatch
+        self._agent_locs: dict = {}
 
     def force_driver_path(self):
         """Context manager: actor calls from this thread take the
@@ -373,13 +554,19 @@ class WorkerRuntime:
     def on_ref_serialized(self, oid: str) -> None:
         """ObjectRef.__reduce__ hook: a ref leaving this process by
         serialization may reach readers that resolve through the
-        driver — publish direct-call results so they can."""
+        driver — publish direct-call and agent-placed results so they
+        can."""
         fut = self._direct_results.get(oid)
-        if fut is None or fut.publish or fut.failover:
+        if fut is not None and not fut.publish and not fut.failover:
+            fut.publish = True
+            if fut.ev.is_set():
+                self._publish_direct(oid, fut)
             return
-        fut.publish = True
-        if fut.ev.is_set():
-            self._publish_direct(oid, fut)
+        af = self._agent_results.get(oid)
+        if af is not None and not af.publish and not af.failover:
+            af.publish = True
+            if af.ev.is_set():
+                self._publish_agent(oid, af)
 
     def _register_direct_future(self, oid: str, fut: _DirectFuture) -> None:
         self._direct_results[oid] = fut
@@ -397,6 +584,150 @@ class WorkerRuntime:
             self._direct_evicted.add(old_oid)
             while len(self._direct_evicted) > 4 * self._DIRECT_RESULT_RETAIN:
                 self._direct_evicted.pop()
+
+    # ---- agent-local dispatch (two-level scheduling) ----------------------
+    def _register_agent_future(self, oid: str, fut: _AgentFuture) -> None:
+        """Caller holds _agent_lock. Same oldest-first resolution
+        retention as direct-call results; an evicted never-published
+        result raises ObjectLostError on a late get."""
+        self._agent_results[oid] = fut
+        while len(self._agent_results) > self._DIRECT_RESULT_RETAIN:
+            old_oid, old = next(iter(self._agent_results.items()))
+            if not old.ev.is_set():
+                break   # oldest still in flight: don't evict live tasks
+            del self._agent_results[old_oid]
+            self._agent_known.discard(old_oid)
+            if old._published or old.failover:
+                continue   # resolvable through the driver path
+            self._agent_evicted.add(old_oid)
+            while len(self._agent_evicted) > 4 * self._DIRECT_RESULT_RETAIN:
+                self._agent_evicted.pop()
+        while len(self._agent_tasks) > self._DIRECT_RESULT_RETAIN:
+            old_tid, oids = next(iter(self._agent_tasks.items()))
+            if any((f := self._agent_results.get(o)) is not None
+                   and not f.ev.is_set() for o in oids):
+                break
+            del self._agent_tasks[old_tid]
+
+    def _agent_locs_update(self, pairs) -> None:
+        locs = self._agent_locs
+        for oid, loc in pairs:
+            locs[oid] = loc
+            self._agent_known.add(oid)
+        while len(locs) > 8192:
+            # values still live in the node arena; a later get falls
+            # back to the driver path
+            del locs[next(iter(locs))]
+        while len(self._agent_known) > 8 * 8192:
+            self._agent_known.pop()
+
+    def _agent_resolve(self, tid: str, sealed, error) -> None:
+        """Agent-plane reader: one nested task this worker submitted
+        completed on a sibling worker."""
+        with self._agent_lock:
+            oids = list(self._agent_tasks.get(tid, ()))
+        err = None
+        if error is not None:
+            err = error if isinstance(error, BaseException) \
+                else TaskError(str(error), "", tid)
+        locs = dict(sealed or ())
+        to_publish = []
+        for oid in oids:
+            fut = self._agent_results.get(oid)
+            if fut is None or fut.ev.is_set():
+                continue
+            if err is not None:
+                fut.error = err
+            else:
+                fut.loc = locs.get(oid)
+                if fut.loc is None:
+                    fut.error = TaskError(
+                        f"agent-placed task sealed no location for {oid}",
+                        "", tid)
+                else:
+                    self._agent_known.add(oid)
+            fut.ev.set()
+            if fut.publish:
+                to_publish.append((oid, fut))
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+        for oid, fut in to_publish:
+            self._publish_agent(oid, fut)
+
+    def _agent_spilled(self, tids) -> None:
+        """The agent forwarded these worker-submitted specs to the
+        driver (deps not node-local, or no capacity in time): their
+        results resolve through the driver path. No resubmit here —
+        the agent already handed the spec up."""
+        for tid in tids:
+            for oid in self._agent_tasks.get(tid, ()):
+                fut = self._agent_results.get(oid)
+                if fut is not None and not fut.ev.is_set():
+                    fut.failover = True
+                    fut.ev.set()
+        with self._direct_cv:
+            self._direct_cv.notify_all()
+
+    def _publish_agent(self, oid: str, fut: _AgentFuture) -> None:
+        """Escape publication for an agent-placed result: its ref left
+        this process, so readers that resolve through the driver must
+        find it. The seal is host-kind (node arena / spill file), so
+        the location itself is globally resolvable — no byte copy."""
+        if fut._published or fut.failover:
+            return
+        fut._published = True
+        try:
+            # straight to the socket, NOT through the batcher — same
+            # re-entrancy rule as _publish_direct
+            if fut.error is not None:
+                self.conn.send(("put_error", oid, fut.error))
+            else:
+                self.conn.send(("put", oid, fut.loc))
+        except Exception:
+            pass   # driver gone: nothing to publish to
+
+    def _resolve_agent(self, oid: str, fut: _AgentFuture,
+                       deadline: Optional[float]) -> Any:
+        if not fut.ev.is_set():
+            # silent grace first (the zero-driver-frame steady state),
+            # then the same dwait CPU lend a blocked driver-path get
+            # performs — capacity-tight gangs rely on it for liveness
+            grace = _AGENT_GRACE_S if deadline is None \
+                else max(0.0, min(_AGENT_GRACE_S,
+                                  deadline - time.monotonic()))
+            if not fut.ev.wait(grace):
+                notified = False
+                try:
+                    self.conn.send(("dwait", True))
+                    notified = True
+                except Exception:
+                    pass
+                try:
+                    remaining = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    ok = fut.ev.wait(remaining)
+                finally:
+                    if notified:
+                        try:
+                            self.conn.send(("dwait", False))
+                        except Exception:
+                            pass
+                if not ok:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for agent-placed "
+                        f"task result {oid}")
+        if fut.failover:
+            remaining = None if deadline is None \
+                else max(0.1, deadline - time.monotonic())
+            return self._get_one_fresh(oid, remaining)
+        if fut.error is not None:
+            raise fut.error
+        try:
+            return self.store.get_value(fut.loc)
+        except ObjectLostError:
+            remaining = None if deadline is None \
+                else max(0.1, deadline - time.monotonic())
+            return self._get_one_fresh(oid, remaining)
 
     def _drop_direct_channel(self, actor_id: str,
                              ch: _DirectChannel) -> None:
@@ -512,6 +843,7 @@ class WorkerRuntime:
         from . import device_store  # noqa: PLC0415
         local = {}
         direct: Dict[str, _DirectFuture] = {}
+        agent: Dict[str, _AgentFuture] = {}
         for oid in oids:
             try:
                 local[oid] = device_store.get(oid)
@@ -521,15 +853,30 @@ class WorkerRuntime:
             fut = self._direct_results.get(oid)
             if fut is not None:
                 direct[oid] = fut
-            elif oid in self._direct_evicted:
+                continue
+            afut = self._agent_results.get(oid)
+            if afut is not None:
+                agent[oid] = afut
+                continue
+            aloc = self._agent_locs.get(oid)
+            if aloc is not None:
+                # agent-stamped dependency location: the value is in
+                # this node's arena, no driver round-trip
+                try:
+                    local[oid] = self.store.get_value(aloc)
+                    continue
+                except Exception:
+                    self._agent_locs.pop(oid, None)
+            if oid in self._direct_evicted or oid in self._agent_evicted:
                 raise ObjectLostError(
-                    f"direct-call result {oid} was evicted (held past "
+                    f"locally-owned result {oid} was evicted (held past "
                     f"the {self._DIRECT_RESULT_RETAIN}-entry retention "
                     f"bound without being read)")
         if len(local) == len(oids):
             return [local[oid] for oid in oids]
         remote_oids = [oid for oid in oids
-                       if oid not in local and oid not in direct]
+                       if oid not in local and oid not in direct
+                       and oid not in agent]
         results: Dict[str, tuple] = {}
         rid = None
         if remote_oids:
@@ -545,6 +892,10 @@ class WorkerRuntime:
             if oid in direct:
                 out.append(self._resolve_direct(oid, direct[oid],
                                                 deadline))
+                continue
+            if oid in agent:
+                out.append(self._resolve_agent(oid, agent[oid],
+                                               deadline))
                 continue
             kind, payload = results[oid]
             if kind == "error":
@@ -666,6 +1017,13 @@ class WorkerRuntime:
         direct = {r.id: f for r in refs
                   if (f := self._direct_results.get(r.id)) is not None
                   and not f.failover}
+        # agent-placed futures duck-type the direct ones here (ev +
+        # failover are all this loop reads), so they settle locally too
+        for r in refs:
+            if r.id not in direct:
+                af = self._agent_results.get(r.id)
+                if af is not None and not af.failover:
+                    direct[r.id] = af
         if not direct:
             return self._driver_wait(refs, num_returns, timeout)
         # Mixed wait: direct-call futures settle locally (errored counts
@@ -709,6 +1067,18 @@ class WorkerRuntime:
         return ready, not_ready
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        # Two-level scheduling (docs/SCHEDULING.md): a fan-out from a
+        # worker goes to its OWN node agent for local placement when the
+        # task is node-leaseable and every ref argument is known
+        # node-resolvable — the dependency gate also prevents a put/
+        # submit reorder across the two connections (the driver might
+        # see the submit before the put that feeds it).
+        ag = self._agent_plane
+        if (ag is not None and not ag.dead
+                and sched_mod.node_leaseable(spec)
+                and all(oid in self._agent_known
+                        for oid in spec.dep_object_ids)):
+            return ag.submit(spec)
         self._batch.send(("submit", spec))
         return [ObjectRef(oid) for oid in spec.return_ids]
 
@@ -946,6 +1316,17 @@ class WorkerLoop:
             window=knobs.get_float("RAY_TPU_BATCH_FLUSH_S"),
             enabled=knobs.get_bool("RAY_TPU_BATCH"))
         self.rt._batch = self._batch
+        # agent-local dispatch plane (two-level scheduling): connect
+        # BEFORE run() registers with the driver, so by the time the
+        # driver sees this worker idle the agent can dispatch to it
+        self._agent: Optional[_AgentPlane] = None
+        agent_addr = knobs.get_raw("RAY_TPU_AGENT_ADDR")
+        if agent_addr:
+            try:
+                self._agent = _AgentPlane(self, agent_addr)
+                self.rt._agent_plane = self._agent
+            except Exception:
+                self._agent = None   # agent gone: driver path only
         # direct-call plane listener (RAY_TPU_DIRECT_CALLS=0 disables)
         self._direct_server = None
         if self.rt._direct_enabled:
@@ -1246,22 +1627,35 @@ class WorkerLoop:
             min_interval=0.2 if self._heartbeat_on else 0.0)
 
     # ---- execution --------------------------------------------------------
-    def _seal_returns(self, spec: TaskSpec, result: Any):
+    def _seal_returns(self, spec: TaskSpec, result: Any,
+                      host: bool = False):
         """Pack return values; small ones ride inline in task_done.
 
         Values holding live jax.Arrays stay DEVICE-RESIDENT in this
         process (core/device_store.py): the sealed location is a device
         handle; same-worker consumers read the live value with no D2H,
         and the driver asks us to materialize only when a consumer
-        elsewhere needs the bytes."""
+        elsewhere needs the bytes.
+
+        `host=True` forces host-kind seals (shared arena / spill file):
+        agent-placed nested tasks use it because their consumer is a
+        SIBLING worker reading straight from the node arena — a device
+        handle pinned in this process would be unreadable there without
+        a driver materialize round-trip."""
         n = spec.num_returns
         values = (result,) if n == 1 else tuple(result)
         if n > 1 and len(values) != n:
             raise ValueError(
                 f"task {spec.name} declared num_returns={n} but returned "
                 f"{len(values)} values")
-        from . import device_store  # noqa: PLC0415
         sealed = []
+        if host:
+            from .spilling import put_value_or_spill  # noqa: PLC0415
+            for oid, val in zip(spec.return_ids, values):
+                sealed.append((oid, put_value_or_spill(
+                    self.store, oid, val)))
+            return sealed
+        from . import device_store  # noqa: PLC0415
         for oid, val in zip(spec.return_ids, values):
             sealed.append((oid, device_store.try_keep(
                 self.store, self.worker_id, oid, val)))
@@ -1313,6 +1707,17 @@ class WorkerLoop:
         if big:
             self._store_backpressure()
 
+    def _complete_task(self, spec: TaskSpec, sealed, error) -> None:
+        """Route a completion to the plane that dispatched the task:
+        agent-placed tasks (two-level scheduling) report to the node
+        agent, everything else to the driver. A dead agent plane falls
+        back to the driver connection — driver-granted lease tasks are
+        in its ledger, and its death handling fences any duplicate."""
+        if getattr(spec, "_via_agent", False) and self._agent is not None \
+                and self._agent.task_done(spec.task_id, sealed, error):
+            return
+        self._task_done(spec.task_id, sealed, error)
+
     def _store_backpressure(self, max_wait_s: float = 2.0) -> None:
         """Bounded wait for the driver's watermark spiller after a big
         seal. Pre-lease, production was paced by the dispatch round
@@ -1342,7 +1747,7 @@ class WorkerLoop:
             self._revoked.discard(spec.task_id)
             return
         if spec.task_id in self._cancelled:
-            self._task_done(spec.task_id, [], "cancelled")
+            self._complete_task(spec, [], "cancelled")
             return
         self.rt.current_task_id = spec.task_id
         # Dispatcher-assigned chip indices (disjoint across concurrent
@@ -1370,12 +1775,13 @@ class WorkerLoop:
                     self._task_done(spec.task_id, [],
                                     "cancelled" if cancelled else None)
                     return
-            sealed = self._seal_returns(spec, result)
-            self._task_done(spec.task_id, sealed, None)
+            sealed = self._seal_returns(
+                spec, result, host=getattr(spec, "_host_seal", False))
+            self._complete_task(spec, sealed, None)
         except BaseException as e:  # noqa: BLE001
             status = "error"
             err = TaskError(repr(e), traceback.format_exc(), spec.name)
-            self._task_done(spec.task_id, [], err)
+            self._complete_task(spec, [], err)
         finally:
             self.rt.current_task_id = None
             logging_mod.mark_current_task(None)

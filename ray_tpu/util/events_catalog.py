@@ -47,6 +47,17 @@ BUILTIN: Dict[str, _SPEC] = {
         "unstarted slots were reclaimed for other workers (zero lost "
         "tasks either way — unstarted slots re-queue without burning "
         "a retry)"),
+    "task.lease.node_grant": (
+        "info", "a node AGENT was granted a bulk lease (two-level "
+        "scheduling, docs/SCHEDULING.md): one frame carrying a worker "
+        "set plus a task batch; the agent fans the batch across its "
+        "local workers and refills them without driver round trips "
+        "(attrs carry worker and slot counts)"),
+    "task.spillback": (
+        "warning", "a node agent handed granted tasks back to the "
+        "driver queue (placement timeout, worker death, or a fenced "
+        "lease); unstarted tasks re-queue without burning a retry "
+        "(attrs carry the reason and count)"),
     "task.dispatch.local": (
         "info", "a direct worker->worker call channel was established "
         "via the sys.actor_addr directory; steady-state calls on it "

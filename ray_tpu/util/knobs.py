@@ -86,6 +86,36 @@ _declare("RAY_TPU_LEASE_HEAD_S", "float", 1.0,
          "Seconds a leased FIFO head may stay parked in get()/wait() "
          "before the driver reclaims its unstarted slots "
          "(0 disables).", "core dispatch")
+_declare("RAY_TPU_NODE_LEASES", "bool", True,
+         "Two-level scheduling (docs/SCHEDULING.md): the driver grants "
+         "whole batches of compatible queued tasks to a remote node "
+         "agent in one frame, and the agent fans them across its local "
+         "workers without driver round trips. 0 falls back to "
+         "per-worker leases.", "core dispatch")
+_declare("RAY_TPU_NODE_LEASE_SLOTS", "int", 128,
+         "Per-worker queue depth inside a node-level bulk lease (the "
+         "lease budget is leased-workers x this). Deep on purpose: "
+         "the agent owns its backlog, and a shallow budget starves it "
+         "into per-completion ack/extend chatter.", "core dispatch")
+_declare("RAY_TPU_NODE_LEASE_DEPTH", "int", 8,
+         "Tasks a node agent keeps in flight per local worker within "
+         "a bulk lease (FIFO at the worker). Depth >1 pipelines the "
+         "dispatch round trip so sub-millisecond tasks never leave a "
+         "worker idle; only the FIFO head can have started, so spill "
+         "accounting stays exact.", "core dispatch")
+_declare("RAY_TPU_NODE_LEASE_SPILL_S", "float", 5.0,
+         "Seconds a node agent may hold an unplaceable leased task "
+         "(all local workers busy/dead) before spilling it back to "
+         "the driver queue.", "core dispatch")
+_declare("RAY_TPU_NODE_LEASE_IDLE_S", "float", 2.0,
+         "Linger for a drained standing node lease (agent-local "
+         "nested submissions): workers release back to the driver "
+         "after this long with no agent-local traffic.",
+         "core dispatch")
+_declare("RAY_TPU_AGENT_ADDR", "str", "",
+         "Agent-local dispatch socket a node agent passes to the "
+         "workers it spawns (internal wiring).", "core dispatch",
+         wiring=True)
 _declare("RAY_TPU_DIRECT_CALLS", "bool", True,
          "Direct worker->worker actor-call channels (zero driver "
          "messages steady-state). 0 pins every call to the driver "

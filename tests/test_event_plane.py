@@ -103,6 +103,16 @@ def test_catalog_requires_dispatch_plane_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_node_lease_events():
+    """ISSUE 19's two-level scheduling chain (bulk node grant ->
+    agent-local fan-out -> spillback / revoke) is what the chaos and
+    zero-driver-frame tests key on — the catalog must keep carrying
+    it."""
+    for required in ("task.lease.node_grant", "task.spillback",
+                     "task.lease.revoke"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_catalog_requires_train_fault_tolerance_events():
     """ISSUE 11's elastic-training chain (rank death -> gang reform /
     reshard -> checkpoint restore) is what tests/test_train_ft.py and

@@ -108,6 +108,7 @@ def test_imported_gpt2_greedy_matches_hf_generate(hf_gpt2):
     assert got == expected, (got, expected)
 
 
+@pytest.mark.slow
 def test_imported_gpt2_serves_over_openai_api(hf_gpt2):
     """Full serving e2e: import -> OpenAI-compatible API -> completion
     equals HF greedy decode."""

@@ -129,6 +129,19 @@ def test_catalog_requires_dispatch_plane_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_node_lease_metrics():
+    """The two-level scheduling plane (bulk node leases, ISSUE 19):
+    grant volume, spillback accounting, and the driver->agent batch
+    size backing dispatch_summary and the core bench — the catalog
+    must keep carrying them."""
+    for required, kind in (
+            ("ray_tpu_node_lease_grants_total", "counter"),
+            ("ray_tpu_spillbacks_total", "counter"),
+            ("ray_tpu_agent_dispatch_batch_size", "histogram")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_catalog_requires_compiled_dag_metrics():
     """The compiled-DAG plane (docs/DAG.md): BENCH_DAG and the
     zero-ctrl-frame acceptance tests key on these series — the catalog

@@ -236,6 +236,7 @@ def test_stream_flushes_withheld_tail_on_length_finish(openai_app):
     assert chunks[-1]["choices"][0]["finish_reason"] == "length"
 
 
+@pytest.mark.slow
 def test_cached_prefix_served_identically(rt):
     """A deployment with cached_prefixes serves prompts starting with
     the prefix token-identically to a PLAIN deployment, while skipping

@@ -90,6 +90,7 @@ def test_kernel_replay_at_earlier_position_is_causal():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_engine_tokens_identical_with_kernel_forced(monkeypatch):
     """Greedy generation with the kernel forced on (interpret mode)
     matches the gather path token-for-token through the real engine."""

@@ -142,3 +142,28 @@ def test_per_metric_tolerance_override(tmp_path, capsys):
     rc, out = _run(capsys, str(old), str(new),
                    "--metric-tolerance", "noop_tasks_per_s=25")
     assert rc == 0, out
+
+
+def test_multi_agent_sweep_leg_is_gated(tmp_path, capsys):
+    """The core bench's tune-style sweep leg (two-level scheduling:
+    concurrent trial drivers fanning out via their node agents) must
+    participate in the gate as a higher-is-better throughput metric —
+    a drop in nested agent-local dispatch rates is a regression, not
+    an informational blip."""
+    path = "multi_agent_scaling.4_agents.sweep_tasks_per_s"
+    assert perfdiff.classify(path) == "higher"
+    old = tmp_path / "old"
+    new = tmp_path / "new"
+    old.mkdir()
+    new.mkdir()
+    base = {"ts": "x", "phase": "core", "command": "c", "result": {
+        "multi_agent_scaling": {"4_agents": {
+            "sweep_tasks_per_s": 2000.0, "sweep_trials": 24}}}}
+    cur = json.loads(json.dumps(base))
+    cur["result"]["multi_agent_scaling"]["4_agents"][
+        "sweep_tasks_per_s"] = 1200.0     # -40%: regression
+    (old / "BENCH_CORE.json").write_text(json.dumps(base))
+    (new / "BENCH_CORE.json").write_text(json.dumps(cur))
+    rc, out = _run(capsys, str(old), str(new))
+    assert rc == 1, out
+    assert "sweep_tasks_per_s" in out

@@ -497,6 +497,7 @@ def test_engine_deadline_rejected_and_queued_shed(tiny_llm):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_serve_wedge_failover_end_to_end(tiny_llm):
     """Full tentpole chain on a real (tiny) LLM deployment: wedge the
     engine via chaos -> watchdog fires -> in-flight stream errors typed

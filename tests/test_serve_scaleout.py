@@ -394,6 +394,7 @@ def _prefix_saved_by_replica(app, dep):
     return out
 
 
+@pytest.mark.slow
 def test_llm_prefix_affinity_beats_no_affinity_baseline(llm_3rep):
     """Acceptance: a shared-prefix session workload on 3 replicas keeps
     ALL prefix-cache savings on the affinity home replica — without

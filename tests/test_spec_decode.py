@@ -74,6 +74,7 @@ def test_spec_fewer_dispatches_than_tokens(model_params):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_spec_token_identical_paged(model_params):
     want = _baseline(model_params, REPETITIVE, 24, kv_page_size=16,
                      kv_pool_tokens=1024)

@@ -457,6 +457,7 @@ class _RecordingIter:
         self.i = n
 
 
+@pytest.mark.slow
 def test_resume_fast_forwards_consumed_batches(tmp_path):
     """SpmdTrainer.fit(resume_from=...) must not re-train on batches
     the crashed run already consumed: step i trains on batch i, so a
