@@ -1381,16 +1381,17 @@ def phase_obs() -> dict:
     """Observability fast-path overhead A/B (no jax in the measured
     path): no-op task round-trips/s AND compiled-DAG execs/s with the
     flight recorder + sampling profiler ON (RAY_TPU_FASTPATH_SPANS=1,
-    RAY_TPU_PROFILE_HZ=25) vs fully OFF. The acceptance bar is < 2%
-    throughput overhead on both legs; the result lands in
+    RAY_TPU_PROFILE_HZ=25) vs fully OFF, then a second A/B for the
+    wait plane (default ON vs RAY_TPU_WAITS=0). The acceptance bar is
+    < 2% throughput overhead on every leg; the result lands in
     BENCH_OBS.json and tests/test_perfdiff.py gates it thereafter."""
     import collections as _c
 
     import ray_tpu
     from ray_tpu.dag import InputNode
 
-    n = int(os.environ.get("RAY_TPU_BENCH_OBS_TASKS", "600"))
-    n_dag = int(os.environ.get("RAY_TPU_BENCH_OBS_DAG_EXECS", "300"))
+    n = int(os.environ.get("RAY_TPU_BENCH_OBS_TASKS", "1500"))
+    n_dag = int(os.environ.get("RAY_TPU_BENCH_OBS_DAG_EXECS", "1000"))
     window = 32
 
     def measure(label: str):
@@ -1446,23 +1447,58 @@ def phase_obs() -> dict:
         return tasks, execs
 
     # Interleaved A/B, best-of per arm (same discipline as
-    # phase_events: never let one arm ride a warmer process). The
-    # knobs are plain env reads, so each arm's fresh runtime — and its
+    # phase_events: never let one arm ride a warmer process), with the
+    # arm ORDER alternating per round — on a box whose speed drifts
+    # monotonically through the phase, a fixed order hands the later
+    # arm a systematic edge that reads as phantom overhead. The knobs
+    # are plain env reads, so each arm's fresh runtime — and its
     # forked workers — see them at init.
-    on_t = off_t = on_d = off_d = 0.0
+    rec = {"on": [0.0, 0.0], "off": [0.0, 0.0]}
+
+    def _rec_arm(on: bool) -> None:
+        os.environ["RAY_TPU_FASTPATH_SPANS"] = "1" if on else "0"
+        os.environ["RAY_TPU_PROFILE_HZ"] = "25" if on else "0"
+        t, d = measure("recorder+profiler " + ("ON" if on else "OFF"))
+        best = rec["on" if on else "off"]
+        best[0], best[1] = max(best[0], t), max(best[1], d)
+
     try:
-        for _round in range(3):
-            os.environ["RAY_TPU_FASTPATH_SPANS"] = "1"
-            os.environ["RAY_TPU_PROFILE_HZ"] = "25"
-            t, d = measure("recorder+profiler ON")
-            on_t, on_d = max(on_t, t), max(on_d, d)
-            os.environ["RAY_TPU_FASTPATH_SPANS"] = "0"
-            os.environ["RAY_TPU_PROFILE_HZ"] = "0"
-            t, d = measure("recorder+profiler OFF")
-            off_t, off_d = max(off_t, t), max(off_d, d)
+        for _round in range(4):
+            first = _round % 2 == 0
+            _rec_arm(first)
+            _rec_arm(not first)
     finally:
         os.environ.pop("RAY_TPU_FASTPATH_SPANS", None)
         os.environ.pop("RAY_TPU_PROFILE_HZ", None)
+    on_t, on_d = rec["on"]
+    off_t, off_d = rec["off"]
+
+    # Wait-plane A/B (same alternating-interleave discipline):
+    # park/unpark on every blocking edge + the 1s aged-delta ship vs
+    # RAY_TPU_WAITS=0. Workers are fresh subprocesses and read the env
+    # at import; the driver's waits module is already imported, so
+    # flip it directly there as well.
+    from ray_tpu.util import knobs as _knobs
+    from ray_tpu.util import waits as _waits
+    wres = {"on": [0.0, 0.0], "off": [0.0, 0.0]}
+
+    def _waits_arm(on: bool) -> None:
+        os.environ["RAY_TPU_WAITS"] = "1" if on else "0"
+        _waits.set_enabled(on)
+        t, d = measure("wait plane " + ("ON" if on else "OFF"))
+        best = wres["on" if on else "off"]
+        best[0], best[1] = max(best[0], t), max(best[1], d)
+
+    try:
+        for _round in range(4):
+            first = _round % 2 == 0
+            _waits_arm(first)
+            _waits_arm(not first)
+    finally:
+        os.environ.pop("RAY_TPU_WAITS", None)
+        _waits.set_enabled(_knobs.get_bool("RAY_TPU_WAITS"))
+    w_on_t, w_on_d = wres["on"]
+    w_off_t, w_off_d = wres["off"]
 
     result = {
         "noop_tasks_per_s_obs_on": round(on_t, 1),
@@ -1473,6 +1509,16 @@ def phase_obs() -> dict:
         if off_t else None,
         "dag_overhead_pct": round((off_d - on_d) / off_d * 100.0, 2)
         if off_d else None,
+        "noop_tasks_per_s_waits_on": round(w_on_t, 1),
+        "noop_tasks_per_s_waits_off": round(w_off_t, 1),
+        "dag_execs_per_s_waits_on": round(w_on_d, 1),
+        "dag_execs_per_s_waits_off": round(w_off_d, 1),
+        "waits_task_overhead_pct":
+        round((w_off_t - w_on_t) / w_off_t * 100.0, 2)
+        if w_off_t else None,
+        "waits_dag_overhead_pct":
+        round((w_off_d - w_on_d) / w_off_d * 100.0, 2)
+        if w_off_d else None,
         "n_calls": n, "n_dag_execs": n_dag, "profile_hz": 25,
         "platform": "cpu",
         "note": "overhead_pct < 0 means the ON run measured faster "

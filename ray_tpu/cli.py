@@ -475,6 +475,126 @@ def cmd_profile(args):
         print(json.dumps(data, indent=2))
 
 
+def cmd_stuck(args):
+    """`ray_tpu stuck [id]` — why is the cluster (or one task/actor/
+    worker) not making progress: detected deadlock cycles first, then
+    every wait chain with its resolved root cause, oldest first."""
+    from urllib.parse import urlencode
+    graph = _fetch(args.address, "/api/waitgraph")
+    params = {"min_age": args.min_age}
+    if args.id:
+        params["id"] = args.id
+    waits = _fetch(args.address,
+                   "/api/waits?" + urlencode(params)).get("waits", [])
+    if args.json:
+        print(json.dumps({"waitgraph": graph, "waits": waits},
+                         indent=2, default=str))
+        return
+    cycles = graph.get("cycles") or []
+    probe = graph.get("last_probe") or {}
+    if cycles:
+        print(f"DEADLOCK: {len(cycles)} waits-on cycle(s) detected")
+        labels = {n.get("key"): n for n in graph.get("nodes", [])}
+        for cyc in cycles:
+            print("  cycle:")
+            for k in cyc:
+                n = labels.get(k, {})
+                extra = ", ".join(str(n[f]) for f in
+                                  ("name", "state", "worker_id")
+                                  if n.get(f))
+                print(f"    {k}" + (f"  ({extra})" if extra else ""))
+            edges = [e for e in graph.get("edges", [])
+                     if e["src"] in cyc and e["dst"] in cyc]
+            for e in edges:
+                print(f"      {e['src']} -[{e['why']}]-> {e['dst']}")
+    for s in probe.get("stragglers") or []:
+        print(f"STRAGGLER: group {s.get('group')!r} seq "
+              f"{s.get('seq')} stuck {s.get('stuck_s')}s — missing "
+              f"ranks {s.get('missing_ranks')}, behind "
+              f"{s.get('behind_ranks')}")
+    if not waits:
+        if not cycles and not probe.get("stragglers"):
+            print("nothing is stuck: no wait records"
+                  + (f" touching {args.id!r}" if args.id else ""))
+        return
+    print(f"{len(waits)} wait(s)"
+          + (f" touching {args.id!r}" if args.id else "") + ":")
+    for w in waits:
+        who = w.get("waiter") or w.get("worker_id")
+        print(f"  [{w['age_s']:>7.1f}s] {who} on "
+              f"{w['kind']}:{w['rid']}")
+        print(f"            {w['root_cause']}")
+
+
+def cmd_stack(args):
+    """`ray_tpu stack` — one-shot stack dump of every live worker (the
+    in-process `py-spy dump` across the cluster, with task
+    attribution), riding the profile_ctl control plane."""
+    workers = _fetch(args.address, "/api/workers")
+    wids = [w["worker_id"] for w in workers
+            if w.get("state") not in ("dead",)]
+    if args.worker:
+        wids = [w for w in wids if w == args.worker]
+    dumps = []
+    for wid in wids:
+        try:
+            dumps.append(_post(args.address, "/api/profile",
+                               {"worker": wid, "action": "stack"}))
+        except SystemExit:
+            # a worker that died mid-iteration is a skip, not an abort
+            dumps.append({"worker_id": wid,
+                          "error": "unreachable"})
+    if args.format == "speedscope":
+        # each thread's current stack becomes one weight-1 sample
+        frames, fidx, samples = [], {}, []
+        for d in dumps:
+            for t in d.get("threads") or ():
+                parts = [f"worker:{d.get('worker_id')}",
+                         f"thread:{t.get('name')}"]
+                if t.get("task_id"):
+                    parts.append(f"task:{t['task_id']}")
+                parts.extend(p for p in (t.get("stack") or "")
+                             .split(";") if p)
+                row = []
+                for p in parts:
+                    if p not in fidx:
+                        fidx[p] = len(frames)
+                        frames.append({"name": p})
+                    row.append(fidx[p])
+                samples.append(row)
+        out = {"$schema":
+               "https://www.speedscope.app/file-format-schema.json",
+               "name": "ray_tpu stack",
+               "shared": {"frames": frames},
+               "profiles": [{"type": "sampled",
+                             "name": "ray_tpu stack", "unit": "none",
+                             "startValue": 0,
+                             "endValue": len(samples),
+                             "samples": samples,
+                             "weights": [1] * len(samples)}]}
+        text = json.dumps(out)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            print(f"wrote {args.output} "
+                  "(open at https://www.speedscope.app)")
+        else:
+            print(text)
+        return
+    for d in dumps:
+        wid = d.get("worker_id", "?")
+        if d.get("error"):
+            print(f"== {wid}: {d['error']}")
+            continue
+        print(f"== {wid} ({len(d.get('threads') or [])} threads)")
+        for t in d.get("threads") or ():
+            task = f"  [task {t['task_id']}]" if t.get("task_id") else ""
+            print(f"  -- {t.get('name')}{task}")
+            for fr in (t.get("stack") or "").split(";"):
+                if fr:
+                    print(f"       {fr}")
+
+
 def cmd_job(args):
     from .core.jobs import JobSubmissionClient
     # submit runs the entrypoint as a local child unless --remote sends
@@ -708,6 +828,30 @@ def main(argv=None):
                      help="`show`/`snapshot` output format")
     prp.add_argument("-o", "--output", default=None)
     prp.set_defaults(fn=cmd_profile)
+
+    stp = sub.add_parser(
+        "stuck", help="why is it stuck: deadlock cycles, stragglers, "
+                      "and every wait chain with its root cause")
+    stp.add_argument("id", nargs="?", default=None,
+                     help="restrict to chains touching this task/"
+                          "actor/worker/object id (prefix ok)")
+    stp.add_argument("--min-age", type=float, default=0.0,
+                     help="hide waits younger than this many seconds")
+    stp.add_argument("--json", action="store_true",
+                     help="raw waitgraph + chains as JSON")
+    stp.set_defaults(fn=cmd_stuck)
+
+    skp = sub.add_parser(
+        "stack", help="one-shot stack dump of every live worker "
+                      "(py-spy-dump equivalent, task-attributed)")
+    skp.add_argument("--worker", default=None,
+                     help="dump just this worker id")
+    skp.add_argument("--format", default="plain",
+                     choices=["plain", "speedscope"])
+    skp.add_argument("-o", "--output", default=None,
+                     help="write speedscope JSON here instead of "
+                          "stdout")
+    skp.set_defaults(fn=cmd_stack)
 
     svp = sub.add_parser("serve", help="serve an Application over HTTP")
     svsub = svp.add_subparsers(dest="serve_cmd", required=True)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import pickle
 import queue
+import select
 import threading
 import time
 from collections import deque
@@ -42,6 +43,7 @@ import cloudpickle
 
 from ..exceptions import CompiledDagError
 from ..util import knobs
+from ..util import waits as waits_mod
 from . import serialization
 from .object_store import INLINE_MAX, ChannelSegment, ChannelSegmentReader
 from .protocol import Connection, ConnectionClosed, connect_address
@@ -95,9 +97,11 @@ class ChannelWriter:
         if len(self._outstanding) <= max_outstanding:
             return
         t0 = time.monotonic()
+        wtok = [0]
         try:
-            self._drain_acks_blocking(max_outstanding)
+            self._drain_acks_blocking(max_outstanding, wtok)
         finally:
+            waits_mod.unpark(wtok[0])
             dt = time.monotonic() - t0
             self.stall_s += dt
             try:
@@ -105,9 +109,25 @@ class ChannelWriter:
             except Exception:
                 pass
 
-    def _drain_acks_blocking(self, max_outstanding: int) -> None:
+    def _drain_acks_blocking(self, max_outstanding: int,
+                             wtok=None) -> None:
         while len(self._outstanding) > max_outstanding:
             expect = self._outstanding[0]
+            if wtok is not None and not wtok[0]:
+                # Park only once the ack is genuinely late: in a
+                # healthy pipeline it has already arrived (or does
+                # within the grace), and a park per windowed send
+                # would tax every execution.
+                try:
+                    r, _, _ = select.select(
+                        [self._conn.fileno()], [], [],
+                        waits_mod.PARK_GRACE_S)
+                except (OSError, ValueError):
+                    r = [True]
+                if not r:
+                    wtok[0] = waits_mod.park(
+                        "dag-channel", self.ch_id, op="ack",
+                        dag_id=self.dag_id, seq=expect)
             try:
                 # raylint: disable=RT003 ack socket: a dead reader
                 # closes it (ConnectionClosed below) and teardown
@@ -197,11 +217,28 @@ class ChannelReader:
         instance itself for kind-"e" payloads. Consuming acks the seqno
         (the copy out of the shm window happens first, so the writer is
         free to overwrite)."""
+        # Park lazily: in a full pipeline the next item arrives within
+        # microseconds, and a park/unpark pair would tax every stage
+        # hop. Only a read still empty after the grace gets a record.
+        grace = waits_mod.PARK_GRACE_S if timeout is None \
+            else min(waits_mod.PARK_GRACE_S, timeout)
+        tok = 0
         try:
-            item = self.q.get(timeout=timeout)
+            try:
+                item = self.q.get(timeout=grace)
+            except queue.Empty:
+                if timeout is not None and timeout <= grace:
+                    raise
+                tok = waits_mod.park("dag-channel", self.ch_id,
+                                     op="read")
+                item = self.q.get(
+                    timeout=None if timeout is None
+                    else timeout - grace)
         except queue.Empty:
             raise ChannelClosed(f"channel {self.ch_id} read timeout") \
                 from None
+        finally:
+            waits_mod.unpark(tok)
         if item[0] is None:
             raise ChannelClosed(
                 f"channel {self.ch_id}: {item[1]}")

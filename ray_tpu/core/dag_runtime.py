@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..exceptions import CompiledDagError, GetTimeoutError, TaskError
 from ..util import knobs
 from ..util import tracing
+from ..util import waits as waits_mod
 from .dag_channel import (ChannelClosed, ChannelHost, ChannelReader,
                           ChannelWriter)
 from .protocol import ConnectionClosed
@@ -845,6 +846,19 @@ class DriverDagController:
     def get_slot(self, seq: int, slot: Tuple,
                  timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.time() + timeout
+        # wtok is a one-slot holder so the wait loop can park lazily —
+        # only when the slot is actually absent and we are about to
+        # sleep, not on the (common) already-settled fast path.
+        wtok = [0]
+        try:
+            return self._get_slot_locked(seq, slot, timeout, deadline,
+                                         wtok)
+        finally:
+            waits_mod.unpark(wtok[0])
+
+    def _get_slot_locked(self, seq, slot, timeout, deadline,
+                         wtok=None):
+        graced = False
         with self._cond:
             while True:
                 ent = self._inflight.get(seq)
@@ -869,6 +883,21 @@ class DriverDagController:
                     raise GetTimeoutError(
                         f"compiled DAG result (seq {seq}) not ready "
                         f"within {timeout}s")
+                if wtok is not None and not wtok[0]:
+                    # First sleep slice goes un-parked (grace): the
+                    # common case is a pipelined result that settles
+                    # within microseconds of the fetch.
+                    if not graced:
+                        graced = True
+                        self._cond.wait(
+                            timeout=waits_mod.PARK_GRACE_S
+                            if remaining is None
+                            else min(waits_mod.PARK_GRACE_S,
+                                     remaining))
+                        continue
+                    wtok[0] = waits_mod.park(
+                        "dag-channel", self.dag_id, op="slot",
+                        seq=seq, waiter="driver")
                 self._cond.wait(timeout=remaining
                                 if remaining is not None else 1.0)
         if isinstance(value, BaseException):

@@ -250,6 +250,14 @@ class NodeAgent:
             if (self._silence_timeout > 0 and self._in_recv
                     and time.monotonic() - self._last_driver_traffic
                     > self._silence_timeout):
+                from ..util import events as events_mod  # noqa: PLC0415
+                events_mod.emit_safe(
+                    "sched.hang.suspected",
+                    f"driver silent > {self._silence_timeout:.0f}s "
+                    "mid-frame (recv parked on a partial frame); "
+                    "closing the connection to enter the rejoin loop",
+                    node_id=self.node_id, kind="driver_silence",
+                    mid_frame=True)
                 try:
                     self.conn.close()   # recv raises; run() rejoins
                 except Exception:
@@ -259,6 +267,7 @@ class NodeAgent:
         from ..util.metrics import DeltaExporter  # noqa: PLC0415
         from ..util import metrics_catalog as mcat  # noqa: PLC0415
         from ..util import events as events_mod  # noqa: PLC0415
+        from ..util import waits as waits_mod  # noqa: PLC0415
         exporter = DeltaExporter()
         # Collected-but-unsent messages: collect()/drain() are
         # DESTRUCTIVE reads, so a send failure during the rejoin window
@@ -287,6 +296,17 @@ class NodeAgent:
                 evs = events_mod.drain()
                 if evs:
                     pending.append(("events", evs))
+                # wait-state plane: lease queues are data structures,
+                # not parked threads — re-synthesize the queue heads
+                # as lease-slot waits each tick, then ship the aged
+                # delta (None steady-state, like the workers)
+                try:
+                    self._synth_lease_waits(waits_mod)
+                    wts = waits_mod.collect()
+                    if wts is not None:
+                        pending.append(("waits", wts))
+                except Exception:  # noqa: BLE001
+                    pass
                 # one coalesced frame per interval (compact binary
                 # codec), not one frame per telemetry kind; a single
                 # leftover skips the envelope
@@ -303,6 +323,31 @@ class NodeAgent:
                 continue
             except Exception:
                 pass  # telemetry must never kill the agent
+
+    def _synth_lease_waits(self, waits_mod) -> None:
+        """Each lease FIFO's parked HEAD (and the nested queue's) is a
+        blocking edge: the head task waits on a local worker slot. The
+        tail behind it is context, not separate edges — one record per
+        queue keeps the table bounded by lease count."""
+        if not waits_mod.enabled():
+            return
+        recs = []
+        with self._sched_lock:
+            for lease in self._leases.values():
+                if not lease.queue:
+                    continue
+                spec, _owner, ts = lease.queue[0]
+                recs.append(("lease-slot", lease.lid, ts,
+                             {"task": getattr(spec, "task_id", ""),
+                              "name": getattr(spec, "name", ""),
+                              "queued": len(lease.queue)}))
+            if self._nested_q:
+                spec, _owner, ts = self._nested_q[0]
+                recs.append(("lease-slot", "nested", ts,
+                             {"task": getattr(spec, "task_id", ""),
+                              "name": getattr(spec, "name", ""),
+                              "queued": len(self._nested_q)}))
+        waits_mod.table().replace_synth("agent:", recs)
 
     # ---- transfer plane ---------------------------------------------------
     def _span_sink(self, span: dict) -> None:
@@ -378,6 +423,16 @@ class NodeAgent:
                               f"silent > {self._silence_timeout:.0f}s "
                               "(no frames or heartbeat acks); treating "
                               "the connection as dead", flush=True)
+                        from ..util import \
+                            events as events_mod  # noqa: PLC0415
+                        events_mod.emit_safe(
+                            "sched.hang.suspected",
+                            f"driver silent > "
+                            f"{self._silence_timeout:.0f}s (no frames "
+                            "or heartbeat acks); treating the "
+                            "connection as dead and rejoining",
+                            node_id=self.node_id,
+                            kind="driver_silence")
                         try:
                             self.conn.close()
                         except Exception:

@@ -68,6 +68,18 @@ def _ev():
         _ev_mod = events
     return _ev_mod
 
+
+_waits_mod = None
+
+
+def _waits():
+    # same lazy-import-then-cache rationale as _mcat
+    global _waits_mod
+    if _waits_mod is None:
+        from ..util import waits  # noqa: PLC0415
+        _waits_mod = waits
+    return _waits_mod
+
 _runtime: Optional[Any] = None
 _runtime_lock = threading.Lock()
 
@@ -548,6 +560,15 @@ class DriverRuntime:
         self._profile_counter = 0
         self._profile_lock = threading.Lock()
         self._profile_replies: Dict[int, Tuple[threading.Event, dict]] = {}
+
+        # cluster wait-state plane (util/waits.py): aged WaitRecord
+        # snapshots from every worker/agent fold here; the hang
+        # watchdog (observability/waitgraph.py) walks them together
+        # with the driver's own wait table and GCS tables at
+        # RAY_TPU_HANG_PROBE_S cadence
+        from ..util.waits import ClusterWaitStore  # noqa: PLC0415
+        self.cluster_waits = ClusterWaitStore()
+        self._hang_monitor = None   # built lazily by _start_hang_watchdog
         self._node_hb_timeout = knobs.get_float(
             "RAY_TPU_NODE_HEARTBEAT_TIMEOUT_S")
         # heartbeat-DECLARED death: a node silent past this long is
@@ -591,6 +612,7 @@ class DriverRuntime:
         self.report_handlers["sys.spans"] = self._on_worker_spans
         self.report_handlers["sys.events"] = self._on_worker_events
         self.report_handlers["sys.profile"] = self._on_worker_profile
+        self.report_handlers["sys.waits"] = self._on_worker_waits
         # control-plane actors (the serve controller's autoscaler) need
         # the node table and placement-group ops; both live only in the
         # driver, so workers reach them over report_sync channels
@@ -655,6 +677,39 @@ class DriverRuntime:
         if self._batch_enabled:
             threading.Thread(target=self._submit_flush_loop, daemon=True,
                              name="rtpu-submit-flush").start()
+        self._start_hang_watchdog()
+
+    def _start_hang_watchdog(self) -> None:
+        """The wait-graph watchdog: probe the cluster's wait records
+        for deadlocks, stale waits, and stragglers every
+        RAY_TPU_HANG_PROBE_S. Off when the wait plane is killed
+        (RAY_TPU_WAITS=0) or the cadence is <= 0; the records
+        themselves still flow for ad-hoc `ray_tpu stuck` queries."""
+        from ..util import waits as waits_mod
+        probe_s = knobs.get_float("RAY_TPU_HANG_PROBE_S")
+        if not waits_mod.enabled() or probe_s <= 0:
+            return
+        from ..observability.waitgraph import HangMonitor
+        self._hang_monitor = HangMonitor(self)
+
+        def loop() -> None:
+            while not self._shutdown.wait(probe_s):
+                try:
+                    self._hang_monitor.probe()
+                except Exception:
+                    pass    # a bad probe skips one tick, never kills
+                    # the watchdog
+
+        threading.Thread(target=loop, daemon=True,
+                         name="rtpu-hang-watchdog").start()
+
+    def hang_monitor(self):
+        """The live HangMonitor (building it on demand so state-API
+        callers can probe even when the watchdog thread is off)."""
+        if self._hang_monitor is None:
+            from ..observability.waitgraph import HangMonitor
+            self._hang_monitor = HangMonitor(self)
+        return self._hang_monitor
 
     # ================= driver restart / resume =================
     def _restore_from(self, rec) -> None:
@@ -1467,6 +1522,11 @@ class DriverRuntime:
             # agent-side lifecycle events (event plane delta batch)
             self.cluster_events.ingest(
                 {"node_id": nid, "worker_id": "node-agent"}, m[1])
+        elif mtype == "waits":
+            # agent-side wait records (synthesized lease-queue heads)
+            self.cluster_waits.ingest(
+                f"agent:{nid}",
+                {"node_id": nid, "worker_id": "node-agent"}, m[1])
         elif mtype == "worker_spawn_failed":
             sys.stderr.write(f"[ray_tpu driver] node {nid} failed to spawn "
                              f"worker {m[1]}: {m[2]}\n")
@@ -1514,6 +1574,9 @@ class DriverRuntime:
                    "over its workers, objects, and placement bundles",
                    node_id=nid)
         self.cluster_metrics.drop_source({"node_id": nid})
+        # drop the agent's wait snapshot too — a dead agent's lease
+        # queues are gone, and ghost waits would poison the waitgraph
+        self.cluster_waits.drop_source(f"agent:{nid}")
         # location directory upkeep: the dead node serves no more pulls
         self.transfer_addrs.pop(nid, None)
         # Bulk node leases die with their agent. Unstarted slots
@@ -4088,6 +4151,9 @@ class DriverRuntime:
         # a dead worker's gauge series would otherwise report its last
         # "current state" forever (counters/histograms stay: history)
         self.cluster_metrics.drop_source({"worker_id": wid})
+        # ghost waits from a dead process must not poison the wait
+        # graph (its waits died with it; the CAUSES live elsewhere)
+        self.cluster_waits.drop_source(wid)
         if w.node_lease is not None:
             # node-leased worker: the AGENT owns its task assignment —
             # it spills the in-flight task back (nlease_spill,
@@ -4695,7 +4761,13 @@ class DriverRuntime:
         self._flush_submits()   # no flush-window latency on submit->get
         waiter = Waiter(oids, None, cb)
         self.inbox.put(("api_waiter", waiter))
-        if not ev.wait(timeout):
+        wtok = _waits().park("object", oids[0] if oids else "",
+                             waiter="driver", n=len(oids))
+        try:
+            settled = ev.wait(timeout)
+        finally:
+            _waits().unpark(wtok)
+        if not settled:
             waiter.done = True
             raise GetTimeoutError(
                 f"get() timed out after {timeout}s on {len(oids)} objects")
@@ -4756,7 +4828,12 @@ class DriverRuntime:
                 ("waiter_timeout", waiter.waiter_id)))
             t.daemon = True
             t.start()
-        ev.wait(None if timeout is None else timeout + 1.0)
+        wtok = _waits().park("object", refs[0].id if refs else "",
+                             waiter="driver", op="wait", n=len(refs))
+        try:
+            ev.wait(None if timeout is None else timeout + 1.0)
+        finally:
+            _waits().unpark(wtok)
         ready_ids = set(box["ready"])
         ready = [r for r in refs if r.id in ready_ids]
         not_ready = [r for r in refs if r.id not in ready_ids]
@@ -4868,6 +4945,13 @@ class DriverRuntime:
 
     def _on_worker_profile(self, wid: str, payload) -> None:
         self.profile_store.ingest(wid, payload)
+
+    def _on_worker_waits(self, wid: str, payload) -> None:
+        w = self.workers.get(wid)
+        node = (w.node_id if w is not None and w.node_id else None) \
+            or self.node_id
+        self.cluster_waits.ingest(
+            wid, {"node_id": node, "worker_id": wid}, payload)
 
     def profile_ctl(self, worker_id: str, action: str,
                     arg: Any = None, timeout: float = 5.0) -> dict:

@@ -35,6 +35,7 @@ from ..util import metrics as metrics_mod
 from ..util import knobs
 from ..util import metrics_catalog as mcat
 from ..util import tracing
+from ..util import waits as waits_mod
 
 
 class _MsgBatcher:
@@ -114,13 +115,14 @@ class _DirectFuture:
     flips when the channel died and the spec was resubmitted through
     the driver — the oid then resolves via the normal get path."""
     __slots__ = ("ev", "payload", "error", "failover", "publish",
-                 "_published")
+                 "_published", "actor_id")
 
     def __init__(self):
         self.ev = threading.Event()
         self.payload: Optional[bytes] = None   # serialization.pack(...)
         self.error: Optional[BaseException] = None
         self.failover = False
+        self.actor_id: Optional[str] = None    # callee (wait-graph edge)
         # an escaped ref (serialized out of this process) must seal the
         # value driver-side so any reader anywhere can resolve it
         self.publish = False
@@ -702,11 +704,13 @@ class WorkerRuntime:
                     notified = True
                 except Exception:
                     pass
+                tok = waits_mod.park("object", oid, via="agent")
                 try:
                     remaining = None if deadline is None \
                         else max(0.0, deadline - time.monotonic())
                     ok = fut.ev.wait(remaining)
                 finally:
+                    waits_mod.unpark(tok)
                     if notified:
                         try:
                             self.conn.send(("dwait", False))
@@ -794,6 +798,7 @@ class WorkerRuntime:
             return False
         oid = spec.return_ids[0]
         fut = _DirectFuture()
+        fut.actor_id = spec.actor_id
         self._register_direct_future(oid, fut)
         if not ch.call(spec, fut):
             self._direct_results.pop(oid, None)
@@ -883,7 +888,12 @@ class WorkerRuntime:
             self._batch.flush()   # a buffered put/submit may feed this
             rid = self._new_req()
             self.conn.send(("get_request", rid, remote_oids, timeout))
-            results = self._take_reply(rid, timeout)
+            tok = waits_mod.park("object", remote_oids[0],
+                                 n=len(remote_oids))
+            try:
+                results = self._take_reply(rid, timeout)
+            finally:
+                waits_mod.unpark(tok)
         out = []
         for oid in oids:
             if oid in local:
@@ -938,11 +948,16 @@ class WorkerRuntime:
                     notified = True
                 except Exception:
                     pass
+                # the target actor rides the record so the wait graph
+                # can close cycles through calls the driver never saw
+                tok = waits_mod.park("actor-call", oid,
+                                     target_actor=fut.actor_id)
                 try:
                     remaining = None if deadline is None \
                         else max(0.0, deadline - time.monotonic())
                     ok = fut.ev.wait(remaining)
                 finally:
+                    waits_mod.unpark(tok)
                     if notified:
                         try:
                             self.conn.send(("dwait", False))
@@ -967,7 +982,11 @@ class WorkerRuntime:
         t0 = time.monotonic()
         rid = self._new_req()
         self.conn.send(("get_request", rid, [oid], timeout))
-        kind, payload = self._take_reply(rid, timeout)[oid]
+        tok = waits_mod.park("object", oid, fresh=True)
+        try:
+            kind, payload = self._take_reply(rid, timeout)[oid]
+        finally:
+            waits_mod.unpark(tok)
         if kind == "error":
             raise payload if isinstance(payload, BaseException) \
                 else TaskError(str(payload))
@@ -1008,7 +1027,12 @@ class WorkerRuntime:
         rid = self._new_req()
         self.conn.send(("wait_request", rid, [r.id for r in refs],
                         num_returns, timeout))
-        ready_ids = set(self._take_reply(rid, None))
+        tok = waits_mod.park("object", refs[0].id if refs else "",
+                             op="wait", n=len(refs))
+        try:
+            ready_ids = set(self._take_reply(rid, None))
+        finally:
+            waits_mod.unpark(tok)
         ready = [r for r in refs if r.id in ready_ids]
         not_ready = [r for r in refs if r.id not in ready_ids]
         return ready, not_ready
@@ -1034,6 +1058,20 @@ class WorkerRuntime:
             else time.monotonic() + (timeout or 0)
         others = [r for r in refs if r.id not in direct]
         ready_ids: set = set()
+        # one park across the whole mixed-wait loop (the inner driver
+        # slices are 0.2s — individually always younger than the ship
+        # age, so only this outer record can represent a stuck wait())
+        wtok = waits_mod.park("object", refs[0].id if refs else "",
+                              op="wait", n=len(refs))
+        try:
+            return self._mixed_wait_loop(refs, direct, others,
+                                         ready_ids, num_returns,
+                                         deadline)
+        finally:
+            waits_mod.unpark(wtok)
+
+    def _mixed_wait_loop(self, refs, direct, others, ready_ids,
+                         num_returns, deadline):
         while True:
             # a channel death mid-wait flips futures to failover (the
             # spec was resubmitted through the driver): migrate those
@@ -1527,6 +1565,13 @@ class WorkerLoop:
                 payload = prof.status()
             elif action == "snapshot":
                 payload = prof.snapshot()
+            elif action == "stack":
+                # one-shot cluster stack dump (`ray_tpu stack`): walk
+                # every thread's live frames with task attribution
+                from ..observability import \
+                    sampling_profiler as sp  # noqa: PLC0415
+                payload = sp.dump_stacks()
+                payload["worker_id"] = self.worker_id
             else:
                 payload = prof.status()
         except Exception as e:  # noqa: BLE001
@@ -1597,6 +1642,14 @@ class WorkerLoop:
             prof = self._profiler.collect_delta()
         except Exception:
             prof = None
+        # wait-state plane: collect() returns None unless the set of
+        # AGED waits changed — a healthy pipeline's micro-waits never
+        # produce a sys.waits frame (the zero-steady-state-frames
+        # property tests/test_waits.py counter-asserts)
+        try:
+            wts = waits_mod.collect()
+        except Exception:
+            wts = None
         try:
             if spans:
                 self.conn.send(("report", "sys.spans", spans))
@@ -1606,6 +1659,8 @@ class WorkerLoop:
                 self.conn.send(("report", "sys.events", events))
             if prof:
                 self.conn.send(("report", "sys.profile", prof))
+            if wts is not None:
+                self.conn.send(("report", "sys.waits", wts))
         except Exception:  # ConnectionClosed included: driver is gone
             pass
 

@@ -1324,54 +1324,71 @@ class DataServiceIterator:
         if self._done:
             raise StopIteration
         import uuid  # noqa: PLC0415
+        from ..util import waits as waits_mod  # noqa: PLC0415
         poll_s = _knob_float("RAY_TPU_DATA_SERVICE_POLL_S")
         stale_retries = 3
-        while True:
-            # per-request nonce: _call may retry the RPC after a lost
-            # reply — the same nonce makes the dispatcher replay the
-            # original grant instead of handing out a second block
-            req = uuid.uuid4().hex[:12]
-            out = _call("next_shard", self._job, self._cid,
-                        self._gen, self._pending_acks, req,
-                        name=self._name)
-            status = out.get("status")
-            if status == "grant":
-                self._pending_acks = []
-                try:
-                    value = self._fetch(out)
-                except _GrantRevoked:
-                    # revoked mid-fetch: nothing consumed — reconcile
-                    # returns the shard to pending and we re-request
+        # one park spans consecutive "wait" polls (registered lazily on
+        # the first wait status): a starved consumer surfaces as one
+        # aged "data-grant" record naming job/consumer, which the wait
+        # graph chains to the wedged producer via the dispatcher tables
+        wtok = 0
+        try:
+            while True:
+                # per-request nonce: _call may retry the RPC after a
+                # lost reply — the same nonce makes the dispatcher
+                # replay the original grant instead of handing out a
+                # second block
+                req = uuid.uuid4().hex[:12]
+                out = _call("next_shard", self._job, self._cid,
+                            self._gen, self._pending_acks, req,
+                            name=self._name)
+                status = out.get("status")
+                if status == "grant":
+                    self._pending_acks = []
+                    try:
+                        value = self._fetch(out)
+                    except _GrantRevoked:
+                        # revoked mid-fetch: nothing consumed —
+                        # reconcile returns the shard to pending and
+                        # we re-request
+                        stale_retries -= 1
+                        if stale_retries < 0:
+                            raise StaleConsumerError(
+                                f"consumer {self._cid} fenced "
+                                f"mid-fetch")
+                        self._reattach()
+                        continue
+                    b = out["bid"]
+                    self._consumed.append(b)
+                    self._pending_acks = [b]
+                    return value
+                if status == "wait":
+                    self._pending_acks = []
+                    if not wtok:
+                        wtok = waits_mod.park(
+                            "data-grant", self._job, job=self._job,
+                            consumer=self._cid, gen=self._gen)
+                    time.sleep(poll_s)
+                    continue
+                if status == "reconcile":
+                    self._reconcile()
+                    continue
+                if status == "stale":
                     stale_retries -= 1
                     if stale_retries < 0:
                         raise StaleConsumerError(
-                            f"consumer {self._cid} fenced mid-fetch")
+                            f"consumer {self._cid} fenced: "
+                            f"{out.get('why')}")
                     self._reattach()
                     continue
-                b = out["bid"]
-                self._consumed.append(b)
-                self._pending_acks = [b]
-                return value
-            if status == "wait":
-                self._pending_acks = []
-                time.sleep(poll_s)
-                continue
-            if status == "reconcile":
-                self._reconcile()
-                continue
-            if status == "stale":
-                stale_retries -= 1
-                if stale_retries < 0:
-                    raise StaleConsumerError(
-                        f"consumer {self._cid} fenced: "
-                        f"{out.get('why')}")
-                self._reattach()
-                continue
-            if status == "end":
-                self._pending_acks = []
-                self._done = True
-                raise StopIteration
-            raise RuntimeError(f"unexpected dispatcher reply {out!r}")
+                if status == "end":
+                    self._pending_acks = []
+                    self._done = True
+                    raise StopIteration
+                raise RuntimeError(
+                    f"unexpected dispatcher reply {out!r}")
+        finally:
+            waits_mod.unpark(wtok)
 
     # -- PR-11 resume hook --------------------------------------------------
 

@@ -18,6 +18,9 @@ panel the reference renders is available as JSON:
   GET /api/profile     — sampling-profiler aggregate
                          (?format=summary|collapsed|speedscope,
                           ?worker=<wid>, ?task=<task id>)
+  GET /api/waits       — cluster wait chains with root causes
+                         (?id=<subject>, ?min_age=<seconds>)
+  GET /api/waitgraph   — folded waits-on graph + watchdog findings
   GET /metrics         — Prometheus text exposition
 
 Job submission over HTTP (reference: python/ray/dashboard/modules/job/
@@ -142,6 +145,18 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     from . import forensics
                     self._json(forensics.build_post_mortem(sid))
+            elif route == "/api/waits":
+                sid = (q.get("id") or [None])[0]
+                try:
+                    min_age = float((q.get("min_age") or ["0"])[0])
+                except (ValueError, TypeError):
+                    self._json({"error": "min_age must be a number"},
+                               400)
+                    return
+                self._json({"waits": state_mod.wait_chains(
+                    subject_id=sid, min_age_s=min_age)})
+            elif route == "/api/waitgraph":
+                self._json(state_mod.waitgraph())
             elif route == "/api/timeline":
                 self._json(timeline_mod.timeline_events())
             elif route == "/api/profile":
@@ -204,6 +219,7 @@ class _Handler(BaseHTTPRequestHandler):
                                        "/api/events",
                                        "/api/post_mortem",
                                        "/api/jobs",
+                                       "/api/waits", "/api/waitgraph",
                                        "/api/timeline", "/api/profile",
                                        "/metrics"]})
             else:

@@ -188,6 +188,16 @@ def build_post_mortem(subject_id: str) -> Dict[str, Any]:
                 + "\n# ...truncated...\n"
     except Exception as e:  # noqa: BLE001
         metrics_text = f"# metrics snapshot failed: {e!r}\n"
+    # the wait plane's view: chains touching the subject first, else
+    # every live chain — a post-mortem on a HUNG subject leads with
+    # why it is (or was) not making progress
+    try:
+        from ..util import state as state_mod
+        wait_chains = state_mod.wait_chains(subject_id=subject_id)
+        if not wait_chains:
+            wait_chains = state_mod.wait_chains()
+    except Exception:  # noqa: BLE001
+        wait_chains = []
     bundle = {
         "subject_id": subject_id,
         "generated_at": time.time(),
@@ -195,6 +205,7 @@ def build_post_mortem(subject_id: str) -> Dict[str, Any]:
         "events": chain,
         "spans": spans,
         "log_tail": logs,
+        "wait_chains": wait_chains,
         "reconstruction": _reconstruction_chain(rt, subject_id),
         "metrics": metrics_text,
         "event_summary": rt.cluster_events.summarize(),

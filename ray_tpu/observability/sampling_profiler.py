@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..util import knobs
 
 __all__ = ["SamplingProfiler", "ClusterProfileStore", "mark_thread",
-           "fold_frame"]
+           "fold_frame", "dump_stacks"]
 
 # thread ident -> task_id currently attributed to that thread (same
 # last-marker-wins contract as the log markers). Plain dict ops are
@@ -68,6 +68,26 @@ def fold_frame(frame, depth: int) -> str:
         frame = frame.f_back
     parts.reverse()
     return ";".join(parts)
+
+
+def dump_stacks(depth: Optional[int] = None) -> dict:
+    """One-shot stack dump of every live thread in this process (the
+    `ray_tpu stack` payload — the in-process answer to py-spy attach).
+    Unlike the sampler this is on demand and exact: each thread's
+    current stack, folded root-first, with its name and the task id
+    currently attributed to it."""
+    if depth is None:
+        depth = knobs.get_int("RAY_TPU_PROFILE_DEPTH")
+    names = {t.ident: t.name for t in threading.enumerate()}
+    marks = dict(_marks)
+    threads: List[Dict[str, Any]] = []
+    for ident, frame in sys._current_frames().items():
+        threads.append({"ident": ident,
+                        "name": names.get(ident, f"thread-{ident}"),
+                        "task_id": marks.get(ident, ""),
+                        "stack": fold_frame(frame, depth)})
+    threads.sort(key=lambda t: t["name"])
+    return {"threads": threads, "ts": time.time()}
 
 
 class SamplingProfiler:

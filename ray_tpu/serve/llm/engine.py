@@ -1854,6 +1854,14 @@ class LLMEngine:
                 now = time.time()
                 if parked_since is None:
                     parked_since = now
+                    # flag the stall while it is still LIVE so hangs
+                    # the TTL will later mitigate show up in `stuck`
+                    # output and post-mortems as they happen
+                    self._event("sched.hang.suspected",
+                                "request output queue full; consumer "
+                                "stalled (TTL abort after "
+                                f"{self._CONSUMER_STALL_TTL_S:.0f}s)",
+                                req=req, kind="consumer_stalled")
                 elif now - parked_since > self._CONSUMER_STALL_TTL_S:
                     req.aborted = True
                     req.max_new_tokens = min(req.max_new_tokens,
@@ -1861,6 +1869,15 @@ class LLMEngine:
                     self._event("llm_engine.request_abort", req=req,
                                 generated=req.generated,
                                 reason="consumer_stalled")
+                    # hang-mitigation telemetry: the TTL abort IS a
+                    # resolved hang — make it visible to the wait
+                    # plane's post-mortems, not just the engine log
+                    self._event("sched.hang.resolved",
+                                f"consumer stalled "
+                                f"{now - parked_since:.0f}s; request "
+                                "aborted by the consumer-stall TTL",
+                                req=req, kind="consumer_stalled",
+                                stalled_s=round(now - parked_since, 1))
                     break
                 self._progress_ts = now
         if ((self.cfg.eos_token_id is not None

@@ -358,8 +358,14 @@ class Replica:
             return [], True
         chunks = []
         done = False
+        from ..util import waits as waits_mod  # noqa: PLC0415
+        wtok = waits_mod.park("serve-stream", stream_id,
+                              pending=q.qsize())
         try:
-            kind, payload = q.get(timeout=timeout_s)
+            try:
+                kind, payload = q.get(timeout=timeout_s)
+            finally:
+                waits_mod.unpark(wtok)
             while True:
                 if kind == "chunk":
                     chunks.append(payload)

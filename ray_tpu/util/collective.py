@@ -252,27 +252,40 @@ class CollectiveGroup:
         seq = self._seq.get(kind, 0)
         self._seq[kind] = seq + 1
         key = (self.epoch, kind, seq)
-        with _driver_path_cm():
-            try:
-                ray_tpu.get(
-                    self.actor.contribute.remote(key, self.rank, payload,
-                                                 self.generation))
-                deadline = time.monotonic() + timeout
-                delay = 0.001
-                while True:
-                    ready, result = ray_tpu.get(
-                        self.actor.poll.remote(key, op, self.rank,
-                                               self.generation))
-                    if ready:
-                        return result
-                    if time.monotonic() >= deadline:
-                        raise TimeoutError(
-                            f"collective {kind}#{seq} timed out "
-                            f"({self.world_size} ranks expected)")
-                    time.sleep(delay)
-                    delay = min(delay * 2, 0.02)
-            except TaskError as e:
-                _raise_typed(e)
+        # one park spans the whole round (contribute + poll loop): a
+        # stuck round surfaces as an aged "collective-round" record
+        # carrying group/rank/world/seq — the straggler detector
+        # compares these across ranks and names the missing ones
+        from . import waits as waits_mod  # noqa: PLC0415
+        wtok = waits_mod.park(
+            "collective-round", f"{self.group_name}:{kind}:{seq}",
+            group=self.group_name, rank=self.rank,
+            world=self.world_size, round=kind, seq=seq,
+            epoch=self.epoch, generation=self.generation)
+        try:
+            with _driver_path_cm():
+                try:
+                    ray_tpu.get(
+                        self.actor.contribute.remote(
+                            key, self.rank, payload, self.generation))
+                    deadline = time.monotonic() + timeout
+                    delay = 0.001
+                    while True:
+                        ready, result = ray_tpu.get(
+                            self.actor.poll.remote(key, op, self.rank,
+                                                   self.generation))
+                        if ready:
+                            return result
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                f"collective {kind}#{seq} timed out "
+                                f"({self.world_size} ranks expected)")
+                        time.sleep(delay)
+                        delay = min(delay * 2, 0.02)
+                except TaskError as e:
+                    _raise_typed(e)
+        finally:
+            waits_mod.unpark(wtok)
 
     def barrier(self, timeout: float = 60.0) -> None:
         self._round("barrier", None, "barrier", timeout)

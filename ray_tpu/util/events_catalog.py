@@ -169,6 +169,21 @@ BUILTIN: Dict[str, _SPEC] = {
     "scheduler.backpressure": (
         "warning", "task/actor pending past the stuck-warning window "
         "with nowhere to place it"),
+    # ---- wait-graph hang detection (observability/waitgraph.py) ----
+    "sched.deadlock.detected": (
+        "error", "the wait-graph watchdog found a cycle (e.g. two "
+        "actors ray.get-ing each other's pending calls): attrs name "
+        "every participant and edge of the cycle; the workload cannot "
+        "make progress without intervention"),
+    "sched.hang.suspected": (
+        "warning", "a wait older than RAY_TPU_HANG_WARN_S with its "
+        "live root cause attached (the far end of the wait chain), or "
+        "an existing hang mitigation firing (consumer-stall TTL, "
+        "driver-silence watchdog)"),
+    "sched.hang.resolved": (
+        "info", "a previously suspected hang's wait chain drained — "
+        "on its own, or via a mitigation like the consumer-stall TTL "
+        "(attrs carry how long it was stuck)"),
     # ---- serve LLM engine ----
     "llm_engine.request_admit": (
         "info", "request took a decode slot (prefill dispatching)"),

@@ -313,6 +313,21 @@ _declare("RAY_TPU_PROFILE_MAX_STACKS", "int", 2048,
 _declare("RAY_TPU_PROFILE_DEPTH", "int", 24,
          "Max frames kept per sampled stack (deepest frames beyond "
          "this are truncated).", "telemetry")
+_declare("RAY_TPU_WAITS", "bool", True,
+         "Wait-state plane (docs/OBSERVABILITY.md): every blocking "
+         "edge registers a WaitRecord; the driver folds them into the "
+         "cluster wait graph behind `ray_tpu stuck`, hang/deadlock/"
+         "straggler detection, and /api/waitgraph. 0 makes park a "
+         "no-op and disables the watchdog.", "telemetry")
+_declare("RAY_TPU_HANG_PROBE_S", "float", 5.0,
+         "Wait-graph watchdog cadence: the driver assembles the "
+         "cluster wait graph and probes it for cycles, stale waits, "
+         "and collective stragglers this often (<= 0 disables the "
+         "watchdog; the wait plane itself stays on).", "telemetry")
+_declare("RAY_TPU_HANG_WARN_S", "float", 30.0,
+         "Age past which a wait is flagged sched.hang.suspected with "
+         "its live root cause attached (deadlock cycles and "
+         "straggler detection do not wait for this).", "telemetry")
 
 # ---------------------------------------------------------------------------
 # serve plane (docs/SERVING.md)

@@ -153,6 +153,19 @@ BUILTIN: Dict[str, _SPEC] = {
     "ray_tpu_transfer_relay_bytes_total": (
         "counter", "object bytes that fell back to the driver-relay "
         "path (peer path unavailable or failed)", (), "bytes", None),
+    # ---- wait-state plane (util/waits.py, observability/waitgraph.py) ----
+    "ray_tpu_wait_records": (
+        "gauge", "in-progress waits registered in this process's wait "
+        "table (parked get/wait/collective/DAG/lease/data-grant "
+        "edges)", (), "waits", None),
+    "ray_tpu_wait_seconds": (
+        "counter", "seconds spent in completed waits, by waited-on "
+        "resource kind (object / actor-call / collective-round / "
+        "dag-channel / lease-slot / data-grant)", ("kind",), "seconds",
+        None),
+    "ray_tpu_hangs_detected_total": (
+        "counter", "wait-graph watchdog detections by kind (deadlock "
+        "/ stale / straggler)", ("kind",), "hangs", None),
     # ---- worker processes (shipped to the driver exposition) ----
     "ray_tpu_worker_task_run_s": (
         "histogram", "task execution latency measured IN the worker",

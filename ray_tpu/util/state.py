@@ -191,6 +191,57 @@ def summarize_events() -> Dict[str, Any]:
     return rt.cluster_events.summarize()
 
 
+def wait_chains(subject_id: Optional[str] = None,
+                min_age_s: float = 0.0) -> List[Dict[str, Any]]:
+    """Every in-progress wait the cluster knows about, each annotated
+    with its waits-on chain and a resolved root cause — the answer to
+    "why is X stuck" (`ray_tpu stuck`). `subject_id` restricts to
+    chains touching that task/actor/worker/object id."""
+    from ..observability import waitgraph as wg_mod
+    rt = get_runtime()
+    now = time.time()
+    records = wg_mod.gather_records(rt)
+    g = wg_mod.build_graph(records, rt.gcs, now=now)
+    rows: List[Dict[str, Any]] = []
+    for i, r in enumerate(records):
+        age = now - float(r.get("ts", now))
+        if age < min_age_s:
+            continue
+        chain = g.chain(i)
+        if subject_id is not None and not any(
+                k.split(":", 1)[-1].startswith(subject_id)
+                for k in chain):
+            continue
+        rows.append({
+            "kind": r.get("kind"), "rid": r.get("rid"),
+            "waiter": g.waiter_of.get(i),
+            "worker_id": r.get("worker_id"),
+            "node_id": r.get("node_id"),
+            "task_id": r.get("task_id"),
+            "age_s": round(age, 1),
+            "ctx": r.get("ctx") or {},
+            "chain": [g.label(k) for k in chain],
+            "root_cause": g.root_cause(i),
+        })
+    rows.sort(key=lambda r: -r["age_s"])
+    return rows
+
+
+def waitgraph() -> Dict[str, Any]:
+    """The folded cluster waits-on graph plus the watchdog's latest
+    findings (deadlocks / suspected hangs / stragglers)."""
+    from ..observability import waitgraph as wg_mod
+    rt = get_runtime()
+    records = wg_mod.gather_records(rt)
+    g = wg_mod.build_graph(records, rt.gcs)
+    out = g.to_dict()
+    out["sources"] = rt.cluster_waits.sources()
+    out["cycles"] = g.cycles()
+    mon = getattr(rt, "_hang_monitor", None)
+    out["last_probe"] = dict(mon.last_probe) if mon is not None else {}
+    return out
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Reference: `ray summary tasks` — counts per (name, state)."""
     rt = get_runtime()

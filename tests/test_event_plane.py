@@ -153,6 +153,16 @@ def test_catalog_requires_data_service_events():
         assert required in events_catalog.BUILTIN, required
 
 
+def test_catalog_requires_wait_plane_events():
+    """The hang watchdog's incident surface (deadlock cycles, stale
+    waits/stragglers, and their resolution) backs the chaos assertions
+    in tests/test_waits_chaos.py and the docs/OBSERVABILITY.md
+    wait-graph section — the catalog must keep carrying it."""
+    for required in ("sched.deadlock.detected", "sched.hang.suspected",
+                     "sched.hang.resolved"):
+        assert required in events_catalog.BUILTIN, required
+
+
 def test_no_uncataloged_event_literals():
     """Lint: every dotted event-type literal passed to an emit-style
     call inside the package must be cataloged (mirrors the metrics

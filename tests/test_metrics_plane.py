@@ -183,6 +183,19 @@ def test_catalog_requires_data_service_metrics():
         assert mcat.BUILTIN[required][0] == kind, required
 
 
+def test_catalog_requires_wait_plane_metrics():
+    """The wait plane's capacity/health surface (live record count,
+    per-kind blocked seconds, hang detections) backs the overhead gate
+    in bench.py --phase obs and the chaos legs in
+    tests/test_waits_chaos.py — the catalog must keep carrying it."""
+    for required, kind in (
+            ("ray_tpu_wait_records", "gauge"),
+            ("ray_tpu_wait_seconds", "counter"),
+            ("ray_tpu_hangs_detected_total", "counter")):
+        assert required in mcat.BUILTIN, required
+        assert mcat.BUILTIN[required][0] == kind, required
+
+
 def test_steady_state_workload_zero_wire_fallbacks(rt):
     """Every control frame a steady-state workload produces — task
     submits/dones, leases, seals, actor calls, AND the telemetry delta

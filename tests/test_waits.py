@@ -1,0 +1,482 @@
+"""Wait-graph introspection plane (util/waits.py +
+observability/waitgraph.py): park/unpark bookkeeping, the aged-delta
+shipping contract (zero steady-state frames), graph assembly and cycle
+/ straggler detection over synthetic GCS tables, the HangMonitor's
+once-per-incident emission contract, and the RAY_TPU_WAITS kill
+switch. Live deadlock/straggler/starvation chaos legs are in
+tests/test_waits_chaos.py."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.gcs import GCS, ActorEntry, ObjectEntry, TaskEntry
+from ray_tpu.observability import waitgraph as wg_mod
+from ray_tpu.util import waits
+
+
+# ---------- WaitTable ----------
+
+def test_park_unpark_roundtrip():
+    t = waits.WaitTable()
+    tok = t.park("object", "oid1", n=2)
+    assert tok and len(t) == 1
+    [rec] = t.snapshot()
+    assert rec["kind"] == "object" and rec["rid"] == "oid1"
+    assert rec["ctx"] == {"n": 2}
+    t.unpark(tok)
+    assert len(t) == 0
+    t.unpark(tok)            # double-unpark is a no-op
+    t.unpark(0)              # the disabled-plane token too
+
+
+def test_none_ctx_values_dropped():
+    t = waits.WaitTable()
+    t.park("object", "o", a=None, b=1)
+    [rec] = t.snapshot()
+    assert rec["ctx"] == {"b": 1}
+
+
+def test_overflow_drops_are_counted():
+    t = waits.WaitTable(maxlen=2)
+    toks = [t.park("object", f"o{i}") for i in range(4)]
+    assert len(t) == 2 and t.dropped == 2
+    assert all(toks), "park returns a token even when dropped"
+    for tok in toks:
+        t.unpark(tok)        # unpark of a dropped token: no-op
+    assert len(t) == 0
+
+
+def test_collect_ships_only_aged_changes():
+    t = waits.WaitTable()
+    # steady state of "no aged waits" ships nothing, even on the
+    # first collect of a fresh process
+    assert t.collect(min_age=0.5) is None
+    tok = t.park("object", "young")
+    assert t.collect(min_age=0.5) is None      # too young to ship
+    with t._lock:
+        t._recs[tok]["ts"] -= 10               # backdate: now aged
+    out = t.collect(min_age=0.5)
+    assert out is not None and len(out["records"]) == 1
+    assert t.collect(min_age=0.5) is None      # unchanged set: silent
+    t.touch(tok, phase="later")
+    out = t.collect(min_age=0.5)               # touch bumps the set
+    assert out is not None
+    assert out["records"][0]["ctx"]["phase"] == "later"
+    t.unpark(tok)
+    out = t.collect(min_age=0.5)
+    assert out is not None and out["records"] == []   # clears driver
+    assert t.collect(min_age=0.5) is None      # then silent again
+
+
+def test_unpark_accumulates_wait_seconds():
+    t = waits.WaitTable()
+    tok = t.park("collective-round", "g:allreduce:0")
+    with t._lock:
+        t._recs[tok]["ts"] -= 2.0
+    t.unpark(tok)
+    assert t._secs["collective-round"] == pytest.approx(2.0, abs=0.5)
+    t.collect()                                # flush resets
+    assert t._secs == {}
+
+
+def test_replace_synth_is_idempotent_per_prefix():
+    t = waits.WaitTable()
+    real = t.park("object", "o1")
+    t.replace_synth("agent:", [("lease-slot", "L1", 1.0, {"queued": 3})])
+    t.replace_synth("agent:", [("lease-slot", "L2", 2.0, {})])
+    recs = t.snapshot()
+    assert len(recs) == 2                      # real park + one synth
+    synth = [r for r in recs if isinstance(r["tok"], str)]
+    assert len(synth) == 1 and synth[0]["rid"] == "L2"
+    t.replace_synth("agent:", [])
+    assert len(t) == 1
+    t.unpark(real)
+
+
+def test_kill_switch_makes_park_a_noop():
+    t = waits.WaitTable()
+    waits.set_enabled(False)
+    try:
+        assert t.park("object", "o") == 0
+        assert len(t) == 0
+        t.replace_synth("agent:", [("lease-slot", "L", 1.0, {})])
+        assert len(t) == 0
+    finally:
+        waits.set_enabled(True)
+
+
+# ---------- ClusterWaitStore ----------
+
+def test_store_ingest_replaces_and_empty_clears():
+    s = waits.ClusterWaitStore()
+    s.ingest("w1", {"worker_id": "w1", "node_id": "n1"},
+             {"records": [{"kind": "object", "rid": "a", "tok": 1,
+                           "ts": 1.0}], "dropped": 0})
+    [rec] = s.snapshot()
+    assert rec["worker_id"] == "w1" and rec["node_id"] == "n1"
+    # full-snapshot semantics: the next payload REPLACES
+    s.ingest("w1", {"worker_id": "w1"},
+             {"records": [{"kind": "object", "rid": "b", "tok": 2,
+                           "ts": 2.0}]})
+    assert [r["rid"] for r in s.snapshot()] == ["b"]
+    assert s.sources() == {"w1": 1}
+    # an empty-records ship clears the source
+    s.ingest("w1", {"worker_id": "w1"}, {"records": []})
+    assert s.snapshot() == [] and s.sources() == {}
+
+
+def test_store_drop_source_and_garbage():
+    s = waits.ClusterWaitStore()
+    s.ingest("w1", None, {"records": [{"tok": 1, "ts": 1.0}]})
+    s.ingest("agent:n2", None, {"records": [{"tok": "a", "ts": 1.0}]})
+    s.ingest("w9", None, "not-a-dict")          # garbage is ignored
+    assert set(s.sources()) == {"w1", "agent:n2"}
+    s.drop_source("agent:n2")
+    assert set(s.sources()) == {"w1"}
+
+
+# ---------- graph assembly ----------
+
+def _cyclic_gcs_driver_path():
+    """A<->B call cycle as the DRIVER sees it: both call tasks pending
+    in the GCS, both running methods parked on their result objects."""
+    gcs = GCS()
+    gcs.actors["A"] = ActorEntry("A", None, "ns", "Ping",
+                                 state="ALIVE", worker_id="w1")
+    gcs.actors["B"] = ActorEntry("B", None, "ns", "Pong",
+                                 state="ALIVE", worker_id="w2")
+    gcs.tasks["tA"] = TaskEntry("tA", "Ping.call", state="RUNNING",
+                                worker_id="w1", actor_id="A")
+    gcs.tasks["tB"] = TaskEntry("tB", "Pong.call", state="RUNNING",
+                                worker_id="w2", actor_id="B")
+    gcs.tasks["tB2"] = TaskEntry("tB2", "Pong.call", state="PENDING",
+                                 actor_id="B")
+    gcs.tasks["tA2"] = TaskEntry("tA2", "Ping.call", state="PENDING",
+                                 actor_id="A")
+    gcs.objects["oB2"] = ObjectEntry("oB2", state="pending",
+                                     owner_task="tB2")
+    gcs.objects["oA2"] = ObjectEntry("oA2", state="pending",
+                                     owner_task="tA2")
+    now = time.time()
+    recs = [{"kind": "object", "rid": "oB2", "ts": now - 40, "tok": 1,
+             "task_id": "tA", "worker_id": "w1"},
+            {"kind": "object", "rid": "oA2", "ts": now - 40, "tok": 2,
+             "task_id": "tB", "worker_id": "w2"}]
+    return gcs, recs, now
+
+
+def test_graph_closes_driver_path_call_cycle():
+    gcs, recs, now = _cyclic_gcs_driver_path()
+    g = wg_mod.build_graph(recs, gcs, now=now)
+    cycles = g.cycles()
+    assert len(cycles) == 1
+    cyc = set(cycles[0])
+    # every participant is named: both actors, both running tasks,
+    # both pending calls, both result objects
+    for key in ("actor:A", "actor:B", "task:tA", "task:tB",
+                "task:tA2", "task:tB2", "object:oA2", "object:oB2"):
+        assert key in cyc, key
+    assert "cycle:" in g.root_cause(0)
+
+
+def test_graph_closes_direct_call_cycle_via_worker():
+    """Direct-call tasks never reach the GCS; the cycle must close
+    from ctx.target_actor + the record's worker (an actor's worker
+    runs only that actor's methods)."""
+    gcs = GCS()
+    gcs.actors["A"] = ActorEntry("A", None, "ns", "Ping",
+                                 state="ALIVE", worker_id="w1")
+    gcs.actors["B"] = ActorEntry("B", None, "ns", "Pong",
+                                 state="ALIVE", worker_id="w2")
+    now = time.time()
+    recs = [{"kind": "actor-call", "rid": "o1", "ts": now - 40,
+             "tok": 1, "task_id": "tA", "worker_id": "w1",
+             "ctx": {"target_actor": "B"}},
+            {"kind": "actor-call", "rid": "o2", "ts": now - 40,
+             "tok": 2, "task_id": "tB", "worker_id": "w2",
+             "ctx": {"target_actor": "A"}}]
+    g = wg_mod.build_graph(recs, gcs, now=now)
+    cycles = g.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {"actor:A", "actor:B",
+                              "task:tA", "task:tB"}
+
+
+def test_chain_terminates_at_executing_task():
+    """No cycle: a get() on an object whose producer is computing —
+    the root cause must say so, not just 'stuck'."""
+    gcs = GCS()
+    gcs.tasks["tp"] = TaskEntry("tp", "crunch", state="RUNNING",
+                                worker_id="w2")
+    gcs.objects["o1"] = ObjectEntry("o1", state="pending",
+                                    owner_task="tp")
+    now = time.time()
+    recs = [{"kind": "object", "rid": "o1", "ts": now - 40, "tok": 1,
+             "worker_id": "driver"}]
+    g = wg_mod.build_graph(recs, gcs, now=now)
+    assert g.cycles() == []
+    cause = g.root_cause(0)
+    assert "task:tp" in cause and "is executing" in cause
+
+
+def test_lease_and_grant_records_build_nodes():
+    gcs = GCS()
+    gcs.actors["dw"] = ActorEntry("dw", "_rtpu_data_worker_0", "ns",
+                                  "_DataWorker", state="ALIVE",
+                                  worker_id="w3")
+    now = time.time()
+    recs = [{"kind": "lease-slot", "rid": "L7", "ts": now - 5,
+             "tok": "agent:lease-slot:L7:0", "node_id": "n1",
+             "ctx": {"task": "tq", "queued": 4}},
+            {"kind": "data-grant", "rid": "job1", "ts": now - 5,
+             "tok": 3, "worker_id": "w5", "ctx": {"job": "job1"}}]
+    g = wg_mod.build_graph(recs, gcs, now=now)
+    assert "lease:L7@n1" in g.nodes
+    assert g.nodes["lease:L7@n1"]["queued"] == 4
+    # a queued task waits on the lease slot
+    assert "lease:L7@n1" in g.adj["task:tq"]
+    # the starved job chains to the producer pool
+    assert "actor:dw" in g.adj["grant:job1"]
+
+
+# ---------- straggler detection ----------
+
+def _round_rec(rank, seq, now, age=45, group="g", world=4):
+    return {"kind": "collective-round",
+            "rid": f"{group}:allreduce:{seq}", "ts": now - age,
+            "tok": 100 + rank, "worker_id": f"w{rank}",
+            "ctx": {"group": group, "rank": rank, "world": world,
+                    "round": "allreduce", "seq": seq, "epoch": 0,
+                    "generation": 0}}
+
+
+def test_straggler_missing_rank_named():
+    now = time.time()
+    recs = [_round_rec(r, 7, now) for r in (0, 1, 2)]   # rank 3 gone
+    [s] = wg_mod.detect_stragglers(recs, now, 30.0)
+    assert s["missing_ranks"] == [3]
+    assert s["parked_ranks"] == [0, 1, 2]
+    assert s["behind_ranks"] == []
+    assert s["seq"] == 7 and s["stuck_s"] >= 30
+
+
+def test_straggler_behind_rank_named():
+    now = time.time()
+    recs = [_round_rec(0, 7, now), _round_rec(1, 7, now),
+            _round_rec(2, 5, now), _round_rec(3, 7, now)]
+    [s] = wg_mod.detect_stragglers(recs, now, 30.0)
+    assert s["behind_ranks"] == [2] and s["missing_ranks"] == []
+
+
+def test_no_straggler_when_all_parked_same_round():
+    """Everyone parked on the same seq is not a straggler shape (the
+    round's completion is the collective actor's problem, and a true
+    deadlock surfaces via the stale-wait path instead)."""
+    now = time.time()
+    recs = [_round_rec(r, 7, now) for r in range(4)]
+    assert wg_mod.detect_stragglers(recs, now, 30.0) == []
+
+
+def test_no_straggler_before_warn_age():
+    now = time.time()
+    recs = [_round_rec(r, 7, now, age=5) for r in (0, 1)]
+    assert wg_mod.detect_stragglers(recs, now, 30.0) == []
+
+
+# ---------- HangMonitor ----------
+
+class _FakeRt:
+    def __init__(self, gcs, store):
+        self.gcs = gcs
+        self.cluster_waits = store
+        self.node_id = "n0"
+
+
+def _monitor_with(gcs, recs):
+    store = waits.ClusterWaitStore()
+    by_src = {}
+    for r in recs:
+        by_src.setdefault(r.get("worker_id", "w?"), []).append(r)
+    for src, rs in by_src.items():
+        store.ingest(src, {"worker_id": src}, {"records": rs})
+    return wg_mod.HangMonitor(_FakeRt(gcs, store))
+
+
+def test_monitor_detects_and_dedupes_deadlock(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HANG_WARN_S", "30")
+    gcs, recs, now = _cyclic_gcs_driver_path()
+    mon = _monitor_with(gcs, recs)
+    mon.max_snapshots = 0        # no forensics files from a unit test
+    s1 = mon.probe(now=now)
+    assert len(s1["deadlocks"]) == 1
+    assert len(mon._cycles_seen) == 1
+    s2 = mon.probe(now=now + 1)
+    assert len(s2["deadlocks"]) == 1             # still visible
+    assert len(mon._cycles_seen) == 1            # but emitted once
+
+
+def test_monitor_suspects_then_resolves(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HANG_WARN_S", "30")
+    gcs = GCS()
+    gcs.tasks["tp"] = TaskEntry("tp", "crunch", state="RUNNING",
+                                worker_id="w2")
+    gcs.objects["o1"] = ObjectEntry("o1", state="pending",
+                                    owner_task="tp")
+    now = time.time()
+    rec = {"kind": "object", "rid": "o1", "ts": now - 40, "tok": 1,
+           "worker_id": "w1", "task_id": "tw"}
+    mon = _monitor_with(gcs, [rec])
+    mon.max_snapshots = 0
+    s1 = mon.probe(now=now)
+    assert len(s1["suspected"]) == 1
+    assert "is executing" in s1["suspected"][0]["root_cause"]
+    assert mon.probe(now=now + 1)["suspected"]           # still stuck
+    assert len(mon._suspected) == 1                      # one incident
+    # the wait drains: its source ships an empty snapshot
+    mon.rt.cluster_waits.ingest("w1", None, {"records": []})
+    s3 = mon.probe(now=now + 2)
+    assert s3["suspected"] == []
+    [res] = s3["resolved"]
+    assert res["rid"] == "o1"
+    assert mon._suspected == {}
+
+
+def test_monitor_straggler_emits_once(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HANG_WARN_S", "30")
+    now = time.time()
+    recs = [_round_rec(r, 7, now) for r in (0, 1, 2)]
+    mon = _monitor_with(GCS(), recs)
+    mon.max_snapshots = 0
+    s1 = mon.probe(now=now)
+    assert len(s1["stragglers"]) == 1
+    n_incidents = len(mon._suspected)
+    mon.probe(now=now + 1)
+    assert len(mon._suspected) == n_incidents    # deduped
+
+
+# ---------- live runtime integration ----------
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.shutdown()
+    handle = ray_tpu.init(num_cpus=4)
+    yield handle
+    ray_tpu.shutdown()
+
+
+def test_zero_added_steady_state_frames(rt):
+    """THE cost-discipline invariant: with the wait plane ON (the
+    default), a 20-exec compiled-DAG workload still moves ZERO driver
+    control-plane messages — micro-waits never age past
+    SHIP_MIN_AGE_S, so sys.waits ships nothing."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dag import InputNode
+
+    assert waits.enabled()
+
+    @ray_tpu.remote
+    def _inc(x):
+        return x + 1
+
+    node = get_runtime()
+    with InputNode() as inp:
+        dag = _inc.bind(inp)
+    comp = dag.experimental_compile()
+    assert ray_tpu.get(comp.execute(1)) == 2        # warm-up
+    before = dict(node.ctrl_msgs)
+    for i in range(20):
+        assert ray_tpu.get(comp.execute(i)) == i + 1
+    after = dict(node.ctrl_msgs)
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in set(after) | set(before)
+             if after.get(k, 0) != before.get(k, 0)}
+    assert delta == {}, f"wait plane added control frames: {delta}"
+    comp.close()
+
+
+def test_driver_get_parks_and_unparks(rt):
+    """A blocking driver get() is visible in the local wait table
+    while it blocks, and gone after."""
+    @ray_tpu.remote
+    def _slow():
+        time.sleep(1.2)
+        return 42
+
+    ref = _slow.remote()
+    seen = []
+
+    import threading
+
+    def watch():
+        for _ in range(40):
+            if any(r["kind"] == "object" for r in waits.snapshot()):
+                seen.append(True)
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=watch)
+    t.start()
+    assert ray_tpu.get(ref) == 42
+    t.join()
+    assert seen, "blocking get never registered a wait record"
+    assert not [r for r in waits.snapshot() if r["kind"] == "object"]
+
+
+def test_wait_chains_surface_live_waits(rt):
+    from ray_tpu.util import state as state_mod
+
+    @ray_tpu.remote
+    def _slow2():
+        time.sleep(2.5)
+        return 1
+
+    ref = _slow2.remote()
+    time.sleep(1.3)         # worker ships records aged past 1s
+    rows = state_mod.wait_chains()
+    graph = state_mod.waitgraph()
+    assert ray_tpu.get(ref) == 1
+    # the driver was not blocked, but the graph APIs must respond and
+    # carry whatever the heartbeat had shipped by then
+    assert isinstance(rows, list)
+    assert "nodes" in graph and "cycles" in graph
+
+
+def test_kill_switch_subprocess():
+    """RAY_TPU_WAITS=0: park is a no-op end to end — a blocking get
+    leaves no record, and the watchdog never starts."""
+    code = """
+import time, threading
+import ray_tpu
+from ray_tpu.util import waits
+assert not waits.enabled()
+assert waits.park("object", "x") == 0
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def slow():
+    time.sleep(1.5)
+    return 7
+
+ref = slow.remote()
+snap = []
+t = threading.Thread(target=lambda: [time.sleep(0.7),
+                                     snap.extend(waits.snapshot())])
+t.start()
+assert ray_tpu.get(ref) == 7
+t.join()
+assert snap == [], snap
+from ray_tpu.core.runtime import get_runtime
+assert get_runtime()._hang_monitor is None
+assert not [th for th in threading.enumerate()
+            if th.name == "rtpu-hang-watchdog"]
+print("KILL_SWITCH_OK")
+"""
+    env = dict(os.environ, RAY_TPU_WAITS="0", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "KILL_SWITCH_OK" in out.stdout

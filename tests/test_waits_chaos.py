@@ -1,0 +1,220 @@
+"""Wait-graph chaos legs: the hangs the plane exists to diagnose,
+created for real. A two-actor call cycle must be detected and NAMED
+within 2x the probe cadence (with `ray_tpu stuck` printing the
+complete cycle); a SIGSTOP'd gang rank must be flagged as a collective
+straggler from its siblings' parked rounds; a data-service consumer
+starved by a wedged producer must get a chain that reaches the
+producer pool. Each leg builds its own runtime (hang knobs must be in
+the environment before init starts the watchdog)."""
+import io
+import json
+import os
+import signal
+import threading
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+import ray_tpu
+
+
+def _fresh_rt(monkeypatch, probe_s="1", warn_s="3", **env):
+    monkeypatch.setenv("RAY_TPU_HANG_PROBE_S", probe_s)
+    monkeypatch.setenv("RAY_TPU_HANG_WARN_S", warn_s)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    ray_tpu.shutdown()
+    return ray_tpu.init(num_cpus=4)
+
+
+def _events_of(rt_node, etype):
+    rows, _ = rt_node.cluster_events.query(types=[etype], limit=50)
+    return rows
+
+
+def test_cyclic_actor_deadlock_detected_and_named(monkeypatch):
+    """Two actors calling into each other deadlock; the watchdog must
+    emit sched.deadlock.detected naming both actors within
+    2x RAY_TPU_HANG_PROBE_S of the cycle becoming visible, and
+    `ray_tpu stuck` must print the complete cycle."""
+    from ray_tpu.core.runtime import get_runtime
+    _fresh_rt(monkeypatch)
+    try:
+        @ray_tpu.remote
+        class _P:
+            def setup(self, other):
+                self.other = other
+
+            def call(self, depth):
+                if depth <= 0:
+                    return 0
+                return ray_tpu.get(self.other.call.remote(depth - 1),
+                                   timeout=120)
+
+        a = _P.remote()
+        b = _P.remote()
+        ray_tpu.get(a.setup.remote(b))
+        ray_tpu.get(b.setup.remote(a))
+        a.call.remote(3)                     # forms the cycle
+
+        node = get_runtime()
+        # the cycle is visible once both sides' records age past
+        # SHIP_MIN_AGE_S (1s) and ship on the next 1s heartbeat
+        visible_by = time.time() + 2.5
+        probe_s = 1.0
+        deadline = visible_by + 2 * probe_s + 2.0   # slack for load
+        found = None
+        while time.time() < deadline:
+            evs = _events_of(node, "sched.deadlock.detected")
+            if evs:
+                found = evs
+                break
+            time.sleep(0.2)
+        assert found, "deadlock never detected"
+        ev = found[0]
+        aids = sorted(ae.actor_id for ae in node.gcs.actors.values()
+                      if ae.class_name == "_P")
+        assert len(aids) == 2
+        nodes = (ev.get("attrs") or {}).get("nodes") or []
+        for aid in aids:
+            assert f"actor:{aid}" in nodes, (aid, nodes)
+        assert ev["severity"] == "error"
+
+        # the metric moved
+        from ray_tpu.util import metrics_catalog as mcat
+        assert mcat.get("ray_tpu_hangs_detected_total").get(
+            {"kind": "deadlock"}) >= 1
+
+        # `ray_tpu stuck` prints the complete cycle
+        from ray_tpu.cli import main as cli_main
+        from ray_tpu.observability import start_dashboard, \
+            stop_dashboard
+        dash = start_dashboard()
+        try:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                cli_main(["--address", dash.url, "stuck"])
+            out = buf.getvalue()
+        finally:
+            stop_dashboard()
+        assert "DEADLOCK" in out, out
+        for aid in aids:
+            assert f"actor:{aid}" in out, out
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_sigstop_gang_rank_flagged_straggler(monkeypatch):
+    """Freeze one rank of a two-rank collective gang with SIGSTOP: the
+    frozen process ships nothing, so the straggler must be diagnosed
+    from the SIBLING's parked round record — and named."""
+    from ray_tpu.core.runtime import get_runtime
+    _fresh_rt(monkeypatch)
+    stopped_pid = None
+    try:
+        @ray_tpu.remote
+        class _Rank:
+            def run_rounds(self, rank, n):
+                from ray_tpu.util.collective import CollectiveGroup
+                g = CollectiveGroup("chaosgang", 2, rank)
+                for i in range(n):
+                    g.barrier(timeout=300.0)
+                    time.sleep(0.05)
+                return rank
+
+        r0 = _Rank.remote()
+        r1 = _Rank.remote()
+        ref0 = r0.run_rounds.remote(0, 400)
+        ref1 = r1.run_rounds.remote(1, 400)
+        time.sleep(1.5)                      # gang is rolling
+
+        node = get_runtime()
+        # freeze rank 1's worker process
+        ae1 = node.gcs.actors[r1._actor_id]
+        assert ae1.worker_id
+        stopped_pid = node.workers[ae1.worker_id].pid
+        os.kill(stopped_pid, signal.SIGSTOP)
+
+        deadline = time.time() + 25
+        straggler = None
+        while time.time() < deadline:
+            for ev in _events_of(node, "sched.hang.suspected"):
+                if (ev.get("attrs") or {}).get("group") == "chaosgang":
+                    straggler = ev
+                    break
+            if straggler:
+                break
+            time.sleep(0.3)
+        assert straggler, "straggler never flagged"
+        attrs = straggler.get("attrs") or {}
+        # the laggard is named — frozen-while-computing shows up as a
+        # missing rank; frozen-while-parked as a behind rank (its last
+        # shipped snapshot goes stale at an older seq)
+        lag = (attrs.get("missing_ranks") or []) \
+            + (attrs.get("behind_ranks") or [])
+        assert lag, attrs
+        assert attrs.get("round") is not None
+        from ray_tpu.util import metrics_catalog as mcat
+        assert mcat.get("ray_tpu_hangs_detected_total").get(
+            {"kind": "straggler"}) >= 1
+    finally:
+        if stopped_pid is not None:
+            try:
+                os.kill(stopped_pid, signal.SIGCONT)
+            except OSError:
+                pass
+        time.sleep(0.5)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_starved_data_consumer_chains_to_producer(monkeypatch):
+    """A data-service consumer starved because every producer is
+    wedged in user code: the suspected-hang chain must reach the
+    producer pool (the grant -> data-worker-actor edge), so the
+    on-call sees WHO to look at, not just 'no data'."""
+    from ray_tpu import data as rd
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.data import service
+    _fresh_rt(monkeypatch)
+    try:
+        def _wedge(b):
+            time.sleep(3600)
+            return b
+
+        ds = rd.range_(40, block_rows=10).map_batches(_wedge)
+        service.register(ds, "wedged_job", mode="fcfs",
+                         world_size=1, epochs=1)
+
+        def _consume():
+            it = service.iterator("wedged_job", rank=0,
+                                  consumer_id="c0")
+            for _ in it:
+                break
+
+        t = threading.Thread(target=_consume, daemon=True)
+        t.start()
+
+        node = get_runtime()
+        deadline = time.time() + 30
+        hit = None
+        while time.time() < deadline:
+            for ev in _events_of(node, "sched.hang.suspected"):
+                if (ev.get("attrs") or {}).get("wait_kind") \
+                        == "data-grant":
+                    hit = ev
+                    break
+            if hit:
+                break
+            time.sleep(0.3)
+        assert hit, "starved consumer never flagged"
+        cause = (hit.get("attrs") or {}).get("root_cause") or ""
+        # the chain reaches the producer pool, not just the grant
+        assert "actor:" in cause, cause
+        dw_aids = [ae.actor_id for ae in node.gcs.actors.values()
+                   if (ae.name or "").startswith("_rtpu_data_worker_")]
+        assert any(aid in cause for aid in dw_aids), (cause, dw_aids)
+    finally:
+        ray_tpu.shutdown()
